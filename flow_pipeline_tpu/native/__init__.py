@@ -1,0 +1,116 @@
+"""Native (C++) host-path acceleration.
+
+The host hot path — bulk protobuf decode into columnar batches — dominates at
+≥1M flows/sec (the reference's analogue is ClickHouse's C++ Kafka/Protobuf
+engine, ref: compose/clickhouse/create.sh:5-34). ``libflowdecode.so`` decodes a
+length-prefixed FlowMessage stream straight into struct-of-arrays buffers;
+this module loads it via ctypes and falls back to pure Python when unbuilt.
+
+Build with ``make native`` (see native/Makefile at the repo root).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SEARCH = [
+    os.path.join(_HERE, "libflowdecode.so"),
+    os.path.join(_HERE, "..", "..", "native", "libflowdecode.so"),
+]
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    for path in _SEARCH:
+        if os.path.exists(path):
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                continue
+            lib.flow_decode_stream.restype = ctypes.c_longlong
+            lib.flow_decode_stream.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_longlong,
+                ctypes.POINTER(ctypes.c_void_p),  # column buffer pointers
+                ctypes.c_longlong,  # capacity (rows)
+            ]
+            lib.flow_count_frames.restype = ctypes.c_longlong
+            lib.flow_count_frames.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+            lib.flow_encode_stream.restype = ctypes.c_longlong
+            lib.flow_encode_stream.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.c_longlong,
+                ctypes.c_char_p,
+                ctypes.c_longlong,
+            ]
+            _LIB = lib
+            break
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# Column order shared with native/flowdecode.cc — scalar uint32 columns in
+# schema order, then the three [N,4] address columns.
+def _column_order():
+    from ..schema.batch import COLUMNS, ADDR_COLUMNS
+
+    return list(COLUMNS), list(ADDR_COLUMNS)
+
+
+def decode_stream(data: bytes, capacity_hint: int = 0):
+    """Decode length-prefixed FlowMessage frames into a FlowBatch using the
+    native library. Raises RuntimeError if the library is not built."""
+    from ..schema.batch import FlowBatch
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("libflowdecode.so not built; run `make native`")
+    # Exact row count via a cheap native scan of the length prefixes (a frame
+    # can be as small as 1 byte — an all-default message).
+    cap = capacity_hint or max(1, int(lib.flow_count_frames(data, len(data))))
+    batch = FlowBatch.empty(cap)
+    scalar_names, addr_names = _column_order()
+    ptrs = (ctypes.c_void_p * (len(scalar_names) + len(addr_names)))()
+    for i, name in enumerate(scalar_names + addr_names):
+        arr = batch.columns[name]
+        assert arr.flags["C_CONTIGUOUS"]
+        ptrs[i] = arr.ctypes.data_as(ctypes.c_void_p).value
+    n = lib.flow_decode_stream(data, len(data), ptrs, cap)
+    if n < 0:
+        raise ValueError(f"native decode failed at frame {-n - 1}")
+    return batch.slice(0, int(n))
+
+
+def encode_stream(batch, out_capacity: int = 0) -> bytes:
+    """Encode a FlowBatch to length-prefixed frames using the native library."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("libflowdecode.so not built; run `make native`")
+    scalar_names, addr_names = _column_order()
+    n = len(batch)
+    # Worst case ~ 27 fields * (2 tag + 5 varint) + addresses + prefix.
+    cap = out_capacity or (n * 256 + 16)
+    out = ctypes.create_string_buffer(cap)
+    ptrs = (ctypes.c_void_p * (len(scalar_names) + len(addr_names)))()
+    for i, name in enumerate(scalar_names + addr_names):
+        arr = np.ascontiguousarray(batch.columns[name])
+        batch.columns[name] = arr
+        ptrs[i] = arr.ctypes.data_as(ctypes.c_void_p).value
+    written = lib.flow_encode_stream(ptrs, n, out, cap)
+    if written < 0:
+        raise ValueError("native encode: output buffer too small")
+    return out.raw[: int(written)]

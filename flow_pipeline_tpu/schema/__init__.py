@@ -1,0 +1,33 @@
+"""Schema core: the FlowMessage record, its protobuf wire codec, and the
+columnar (struct-of-arrays) FlowBatch layout that feeds the TPU.
+
+Wire-compatible with the reference schema (ref: pb-ext/flow.proto:7-65) so
+that producers/consumers of the reference pipeline interoperate unchanged.
+"""
+
+from .message import FlowMessage, FlowType, FIELDS
+from .wire import (
+    encode_message,
+    decode_message,
+    encode_frame,
+    decode_frames,
+    encode_stream,
+)
+from .batch import FlowBatch, COLUMNS
+from .keys import hash_words, hash_columns, pack_addr_words
+
+__all__ = [
+    "FlowMessage",
+    "FlowType",
+    "FIELDS",
+    "encode_message",
+    "decode_message",
+    "encode_frame",
+    "decode_frames",
+    "encode_stream",
+    "FlowBatch",
+    "COLUMNS",
+    "hash_words",
+    "hash_columns",
+    "pack_addr_words",
+]

@@ -1,0 +1,101 @@
+"""Key packing and hashing for sketch kernels.
+
+The sketch keys are tuples over flow columns — e.g. the 5-tuple
+(SrcAddr, DstAddr, SrcPort, DstPort, Proto) or the AS pair (SrcAS, DstAS)
+(ref: BASELINE.json configs; ClickHouse groups by (SrcAS, DstAS, EType),
+ref: compose/clickhouse/create.sh:96-110). On TPU we never materialize the
+38-byte tuple: each key column is already a uint32 word lane, and we mix the
+word lanes with a murmur3-style finalizer into one 32-bit hash per flow,
+re-seeded per sketch row. All arithmetic is uint32 with natural wraparound —
+pure VPU element-wise work that XLA fuses into the surrounding kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_FMIX1 = np.uint32(0x85EBCA6B)
+_FMIX2 = np.uint32(0xC2B2AE35)
+
+
+def _rotl(x, r):
+    return (x << r) | (x >> (32 - r))
+
+
+def hash_words(words, seed: int = 0):
+    """murmur3_x86_32 over uint32 word lanes.
+
+    words: [..., W] array (any integer dtype; bit-cast to uint32).
+    Returns uint32 [...] hash. Works under jit and inside Pallas kernels
+    (element-wise uint32 ops only).
+    """
+    w = jnp.asarray(words)
+    w = w.astype(jnp.uint32) if w.dtype != jnp.uint32 else w
+    h = jnp.full(w.shape[:-1], jnp.uint32(seed), dtype=jnp.uint32)
+    nwords = w.shape[-1]
+    for i in range(nwords):  # static unroll: W is a compile-time constant
+        k = w[..., i]
+        k = k * _C1
+        k = _rotl(k, 15)
+        k = k * _C2
+        h = h ^ k
+        h = _rotl(h, 13)
+        h = h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+    h = h ^ jnp.uint32(nwords * 4)
+    h = h ^ (h >> 16)
+    h = h * _FMIX1
+    h = h ^ (h >> 13)
+    h = h * _FMIX2
+    h = h ^ (h >> 16)
+    return h
+
+
+def hash_columns(cols: dict, names: Sequence[str], seed: int = 0):
+    """Hash a key tuple given device columns. Address columns ([N,4]) expand
+    to 4 words; scalar columns to 1. Word order is the tuple order, so the
+    same names+seed give identical hashes host- and device-side."""
+    lanes = []
+    for name in names:
+        arr = jnp.asarray(cols[name])
+        arr = arr.astype(jnp.uint32) if arr.dtype != jnp.uint32 else arr
+        if arr.ndim == 1:
+            lanes.append(arr[:, None])
+        else:
+            lanes.append(arr)
+    words = jnp.concatenate(lanes, axis=-1)
+    return hash_words(words, seed)
+
+
+def pack_addr_words(addr_words) -> np.ndarray:
+    """Host-side: [N,4] uint32 -> structured void view usable as dict keys /
+    np.unique input for exact oracles."""
+    a = np.ascontiguousarray(np.asarray(addr_words, dtype=np.uint32))
+    return a.view([("w", np.uint32, 4)]).reshape(-1)
+
+
+def hash_words_np(words: np.ndarray, seed: int = 0) -> np.ndarray:
+    """Numpy twin of hash_words for host-side verification."""
+    w = np.asarray(words, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        h = np.full(w.shape[:-1], np.uint32(seed), dtype=np.uint32)
+        nwords = w.shape[-1]
+        for i in range(nwords):
+            k = w[..., i].copy()
+            k *= _C1
+            k = ((k << np.uint32(15)) | (k >> np.uint32(17))).astype(np.uint32)
+            k *= _C2
+            h ^= k
+            h = ((h << np.uint32(13)) | (h >> np.uint32(19))).astype(np.uint32)
+            h = h * np.uint32(5) + np.uint32(0xE6546B64)
+        h ^= np.uint32(nwords * 4)
+        h ^= h >> np.uint32(16)
+        h *= _FMIX1
+        h ^= h >> np.uint32(13)
+        h *= _FMIX2
+        h ^= h >> np.uint32(16)
+    return h

@@ -1,0 +1,285 @@
+"""Schema core tests: wire codec round-trips, framing, columnar batches,
+hashing parity (device vs numpy), and — when protoc/google.protobuf are
+present — cross-validation against the canonical protobuf implementation."""
+
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.schema import (
+    FlowMessage,
+    FlowType,
+    FlowBatch,
+    encode_message,
+    decode_message,
+    encode_frame,
+    decode_frames,
+    encode_stream,
+    hash_words,
+    hash_columns,
+)
+from flow_pipeline_tpu.schema.keys import hash_words_np
+from flow_pipeline_tpu.schema.batch import addr_to_words, words_to_addr
+
+
+def sample_message(i=0):
+    return FlowMessage(
+        type=FlowType.SFLOW_5,
+        time_received=1700000000 + i,
+        sampling_rate=1000,
+        sequence_num=42 + i,
+        time_flow_start=1700000000 + i,
+        time_flow_end=1700000001 + i,
+        src_addr=bytes(range(16)),
+        dst_addr=bytes(range(16, 32)),
+        sampler_address=b"\x00" * 12 + b"\x0a\x00\x00\x01",
+        bytes=1499,
+        packets=99,
+        src_as=65000,
+        dst_as=65001,
+        in_if=1,
+        out_if=2,
+        proto=6,
+        src_port=443,
+        dst_port=51234,
+        ip_tos=0,
+        forwarding_status=0,
+        ip_ttl=64,
+        tcp_flags=0x18,
+        etype=0x86DD,
+        icmp_type=0,
+        icmp_code=0,
+        ipv6_flow_label=12345,
+        flow_direction=1,
+    )
+
+
+class TestWireCodec:
+    def test_roundtrip(self):
+        msg = sample_message()
+        assert decode_message(encode_message(msg)) == msg
+
+    def test_default_message_is_empty(self):
+        assert encode_message(FlowMessage()) == b""
+        assert decode_message(b"") == FlowMessage()
+
+    def test_zero_fields_omitted(self):
+        msg = FlowMessage(bytes=1)
+        data = encode_message(msg)
+        assert len(data) == 2  # one tag + one varint
+        assert decode_message(data) == msg
+
+    def test_large_varint(self):
+        msg = FlowMessage(time_received=2**40)
+        assert decode_message(encode_message(msg)).time_received == 2**40
+
+    def test_unknown_fields_skipped(self):
+        # field 12 (unused in schema) varint, then a known field
+        extra = bytes([12 << 3, 7]) + encode_message(FlowMessage(packets=5))
+        assert decode_message(extra).packets == 5
+
+    def test_framing_roundtrip(self):
+        msgs = [sample_message(i) for i in range(10)]
+        data = encode_stream(msgs)
+        assert decode_frames(data) == msgs
+
+    def test_frame_single(self):
+        msg = sample_message()
+        frame = encode_frame(msg)
+        body = encode_message(msg)
+        assert frame[0] == len(body)  # small message: 1-byte varint prefix
+        assert decode_frames(frame) == [msg]
+
+    def test_truncated_frame_raises(self):
+        data = encode_frame(sample_message())
+        with pytest.raises(ValueError):
+            decode_frames(data[:-1])
+
+    def test_truncated_fixed_fields_raise(self):
+        # unused field 12 with fixed32/fixed64 wire types, payload cut short
+        with pytest.raises(ValueError):
+            decode_message(bytes([(12 << 3) | 5, 0xAA, 0xBB]))
+        with pytest.raises(ValueError):
+            decode_message(bytes([(12 << 3) | 1, 0xAA]))
+
+
+@pytest.mark.skipif(shutil.which("protoc") is None, reason="protoc not found")
+class TestProtocCrossCheck:
+    """Our codec vs the canonical implementation, via protoc codegen."""
+
+    @pytest.fixture(scope="class")
+    def pb2(self):
+        pytest.importorskip("google.protobuf")
+        import os
+
+        proto_dir = os.path.join(
+            os.path.dirname(__file__), "..", "flow_pipeline_tpu", "schema"
+        )
+        with tempfile.TemporaryDirectory() as td:
+            r = subprocess.run(
+                ["protoc", f"-I{proto_dir}", f"--python_out={td}", "flow.proto"],
+                capture_output=True,
+                text=True,
+            )
+            if r.returncode != 0:
+                pytest.skip(f"protoc failed: {r.stderr}")
+            sys.path.insert(0, td)
+            try:
+                import flow_pb2  # noqa
+
+                yield flow_pb2
+            finally:
+                sys.path.remove(td)
+                sys.modules.pop("flow_pb2", None)
+
+    def test_decode_canonical_encoding(self, pb2):
+        ours = sample_message()
+        theirs = pb2.FlowMessage(
+            Type=int(ours.type),
+            TimeReceived=ours.time_received,
+            SamplingRate=ours.sampling_rate,
+            SequenceNum=ours.sequence_num,
+            TimeFlowStart=ours.time_flow_start,
+            TimeFlowEnd=ours.time_flow_end,
+            SrcAddr=ours.src_addr,
+            DstAddr=ours.dst_addr,
+            SamplerAddress=ours.sampler_address,
+            Bytes=ours.bytes,
+            Packets=ours.packets,
+            SrcAS=ours.src_as,
+            DstAS=ours.dst_as,
+            InIf=ours.in_if,
+            OutIf=ours.out_if,
+            Proto=ours.proto,
+            SrcPort=ours.src_port,
+            DstPort=ours.dst_port,
+            IPTTL=ours.ip_ttl,
+            TCPFlags=ours.tcp_flags,
+            Etype=ours.etype,
+            IPv6FlowLabel=ours.ipv6_flow_label,
+            FlowDirection=ours.flow_direction,
+        )
+        assert decode_message(theirs.SerializeToString()) == ours
+
+    def test_canonical_decodes_our_encoding(self, pb2):
+        ours = sample_message()
+        theirs = pb2.FlowMessage()
+        theirs.ParseFromString(encode_message(ours))
+        assert theirs.Bytes == ours.bytes
+        assert theirs.SrcAddr == ours.src_addr
+        assert theirs.Etype == ours.etype
+        assert theirs.TimeFlowStart == ours.time_flow_start
+
+
+class TestAddrWords:
+    def test_roundtrip_16(self):
+        addr = bytes(range(16))
+        assert words_to_addr(addr_to_words(addr)) == addr
+
+    def test_ipv4_lands_in_word3(self):
+        # IPv4 embedded in trailing 4 bytes (collector convention)
+        addr = b"\x00" * 12 + bytes([10, 1, 2, 3])
+        words = addr_to_words(addr)
+        assert words[3] == (10 << 24) | (1 << 16) | (2 << 8) | 3
+        assert words[:3].sum() == 0
+
+    def test_short_addr_left_padded(self):
+        words = addr_to_words(bytes([10, 1, 2, 3]))
+        assert words[3] == (10 << 24) | (1 << 16) | (2 << 8) | 3
+
+
+class TestFlowBatch:
+    def test_messages_roundtrip(self):
+        msgs = [sample_message(i) for i in range(7)]
+        batch = FlowBatch.from_messages(msgs)
+        assert len(batch) == 7
+        assert batch.to_messages() == msgs
+
+    def test_from_wire(self):
+        msgs = [sample_message(i) for i in range(5)]
+        batch = FlowBatch.from_wire(encode_stream(msgs))
+        assert batch.to_messages() == msgs
+
+    def test_pad_to(self):
+        batch = FlowBatch.from_messages([sample_message(i) for i in range(3)])
+        padded, mask = batch.pad_to(8)
+        assert len(padded) == 8
+        assert mask.sum() == 3
+        assert padded.columns["bytes"][3:].sum() == 0
+
+    def test_slice_offsets(self):
+        batch = FlowBatch.from_messages([sample_message(i) for i in range(10)])
+        batch.first_offset, batch.last_offset = 100, 109
+        s = batch.slice(2, 5)
+        assert (s.first_offset, s.last_offset) == (102, 104)
+        assert len(s) == 3
+
+    def test_device_columns_int32(self):
+        batch = FlowBatch.from_messages([sample_message()])
+        dev = batch.device_columns()
+        assert dev["bytes"].dtype == np.int32
+        assert dev["src_addr"].shape == (1, 4)
+
+    def test_uint64_fields_survive_host_and_saturate_on_device(self):
+        m = FlowMessage(bytes=2**40, time_received=1700000000)
+        batch = FlowBatch.from_messages([m])
+        assert batch.columns["bytes"][0] == 2**40  # host keeps 64 bits
+        dev = batch.device_columns(["bytes", "time_received"])
+        assert dev["bytes"].view(np.uint32)[0] == 0xFFFFFFFF  # saturated
+        assert dev["time_received"].view(np.uint32)[0] == 1700000000
+
+    def test_oversized_varint_masks_not_crashes(self):
+        # a peer sending >64-bit-looking values must not kill ingest
+        m = FlowMessage(src_as=2**40 + 7)  # uint32 wire field, oversized
+        batch = FlowBatch.from_messages([m])
+        assert batch.columns["src_as"][0] == 7
+
+    def test_concat(self):
+        a = FlowBatch.from_messages([sample_message(0)])
+        b = FlowBatch.from_messages([sample_message(1)])
+        c = FlowBatch.concat([a, b])
+        assert len(c) == 2
+        assert c.to_messages() == [sample_message(0), sample_message(1)]
+
+
+class TestHashing:
+    def test_device_matches_numpy(self, rng):
+        words = rng.integers(0, 2**32, size=(64, 9), dtype=np.uint32)
+        dev = np.asarray(hash_words(words, seed=7))
+        host = hash_words_np(words, seed=7)
+        np.testing.assert_array_equal(dev.view(np.uint32), host)
+
+    def test_seeds_decorrelate(self, rng):
+        words = rng.integers(0, 2**32, size=(256, 2), dtype=np.uint32)
+        h0 = np.asarray(hash_words(words, 0)).view(np.uint32)
+        h1 = np.asarray(hash_words(words, 1)).view(np.uint32)
+        assert (h0 == h1).mean() < 0.01
+
+    def test_distribution_roughly_uniform(self, rng):
+        words = rng.integers(0, 2**32, size=(20000, 1), dtype=np.uint32)
+        h = np.asarray(hash_words(words)).view(np.uint32)
+        buckets = np.bincount(h % 16, minlength=16)
+        assert buckets.min() > 20000 / 16 * 0.8
+
+    def test_hash_columns_addr_and_scalar(self, rng):
+        n = 32
+        cols = {
+            "src_addr": rng.integers(0, 2**32, (n, 4), dtype=np.uint32).astype(np.int32),
+            "proto": rng.integers(0, 256, n).astype(np.int32),
+        }
+        h = np.asarray(hash_columns(cols, ["src_addr", "proto"], seed=3))
+        # equals hashing the concatenated 5 words
+        words = np.concatenate(
+            [cols["src_addr"].view(np.uint32), cols["proto"].view(np.uint32)[:, None]],
+            axis=1,
+        )
+        np.testing.assert_array_equal(h.view(np.uint32), hash_words_np(words, 3))
+
+    def test_known_murmur3_vector(self):
+        # murmur3_x86_32(key=b"\x00\x00\x00\x00", seed=0) == 0x2362f9de
+        h = hash_words_np(np.zeros((1, 1), dtype=np.uint32), 0)
+        assert h[0] == 0x2362F9DE
