@@ -1,0 +1,24 @@
+"""Aggregation models — the framework's "model zoo".
+
+Each model is a functional (state, batch) -> state streaming aggregator with
+an ``init`` / ``update`` / ``flush`` surface, mirroring the role the
+reference delegates to ClickHouse materialized views
+(ref: compose/clickhouse/create.sh:92-110):
+
+- ``oracle``        exact numpy groupby — ground truth for parity gates
+- ``window_agg``    exact device aggregation: sort+segment-sum per batch,
+                    host merge per 5-min window (flows_5m semantics)
+- ``heavy_hitter``  count-min sketch + device top-K candidate table
+- ``ddos``          per-DstAddr EWMA + quantile spike detection
+"""
+
+from .oracle import exact_groupby, flows_5m, topk_exact
+from .window_agg import WindowAggregator, WindowAggConfig
+
+__all__ = [
+    "exact_groupby",
+    "flows_5m",
+    "topk_exact",
+    "WindowAggregator",
+    "WindowAggConfig",
+]

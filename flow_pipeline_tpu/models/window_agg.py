@@ -1,0 +1,152 @@
+"""Exact windowed aggregation model — BASELINE config #1.
+
+Device side: per-batch exact partial aggregates via ``ops.sort_groupby``
+keyed on (timeslot, *key columns). Host side: a window store merges partials
+into per-timeslot dicts with uint64 accumulators and flushes closed windows.
+
+Semantics match the reference's flows_5m materialized view exactly
+(5-minute tumbling windows over TimeReceived, keys (SrcAS, DstAS, EType),
+sums of Bytes/Packets plus count — ref: compose/clickhouse/create.sh:92-110),
+with a watermark: a window flushes once the stream has advanced
+``allowed_lateness`` seconds past its end (the reference's analogue is
+SummingMergeTree merge-time finalization, which is also not instantaneous —
+ref: README.md:164-183 OPTIMIZE TABLE).
+
+Late-data semantics: rows arriving for an already-flushed window reopen it,
+and the next flush emits the late contribution as additional PARTIAL rows
+for the same (timeslot, key). Sinks must therefore merge by key — summing
+partials exactly like the reference's SummingMergeTree does at merge time
+(ref: compose/clickhouse/create.sh:70-90). Sinks that cannot merge should
+set ``allowed_lateness`` high enough to make reopening impossible.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.segment import sort_groupby
+from ..schema.batch import FlowBatch
+from .oracle import SECONDS_PER_SLOT
+
+
+@dataclass(frozen=True)
+class WindowAggConfig:
+    key_cols: tuple[str, ...] = ("src_as", "dst_as", "etype")
+    value_cols: tuple[str, ...] = ("bytes", "packets")
+    window_seconds: int = SECONDS_PER_SLOT
+    allowed_lateness: int = 0
+    batch_size: int = 8192  # static shape; shorter batches are padded
+
+
+def _build_update(config: WindowAggConfig):
+    """One jitted device step: columns -> (keys, sums, counts, n_groups)."""
+
+    window = jnp.uint32(config.window_seconds)
+
+    @jax.jit
+    def update(cols: dict, valid):
+        ts = cols["time_received"].astype(jnp.uint32)
+        timeslot = ts - ts % window
+        lanes = [timeslot]
+        for name in config.key_cols:
+            arr = cols[name].astype(jnp.uint32)
+            if arr.ndim == 1:
+                lanes.append(arr)
+            else:
+                lanes.extend(arr[:, i] for i in range(arr.shape[1]))
+        keys = jnp.stack(lanes, axis=1)
+        values = jnp.stack(
+            [cols[name].astype(jnp.int32) for name in config.value_cols], axis=1
+        )
+        return sort_groupby(keys, values, valid)
+
+    return update
+
+
+class WindowAggregator:
+    """Streaming exact aggregator: update(batch) per batch, flush() yields
+    finalized window rows."""
+
+    def __init__(self, config: WindowAggConfig = WindowAggConfig()):
+        self.config = config
+        self._update = _build_update(config)
+        # windows: timeslot -> {key tuple -> uint64 [**values, count]}
+        self.windows: dict[int, dict[tuple, np.ndarray]] = {}
+        self.watermark = 0  # max time_received seen
+        self._key_width = None
+
+    def update(self, batch: FlowBatch) -> None:
+        if len(batch) == 0:
+            return
+        padded, mask = batch.pad_to(self.config.batch_size)
+        cols = {
+            name: jnp.asarray(arr)
+            for name, arr in padded.device_columns(
+                ["time_received", *self.config.key_cols, *self.config.value_cols]
+            ).items()
+        }
+        keys, sums, counts, n = self._update(cols, jnp.asarray(mask))
+        n = int(n)
+        keys = np.asarray(keys[:n]).astype(np.uint32)
+        sums = np.asarray(sums[:n]).astype(np.uint64)
+        counts = np.asarray(counts[:n]).astype(np.uint64)
+        self._key_width = keys.shape[1]
+        nvals = sums.shape[1]
+        for i in range(n):
+            slot = int(keys[i, 0])
+            key = tuple(int(x) for x in keys[i, 1:])
+            wstore = self.windows.setdefault(slot, {})
+            acc = wstore.get(key)
+            if acc is None:
+                acc = np.zeros(nvals + 1, dtype=np.uint64)
+                wstore[key] = acc
+            acc[:nvals] += sums[i]
+            acc[nvals] += counts[i]
+        wm = int(batch.columns["time_received"].max())
+        if wm > self.watermark:
+            self.watermark = wm
+
+    def closed_slots(self) -> list[int]:
+        limit = self.watermark - self.config.allowed_lateness
+        return sorted(
+            s for s in self.windows if s + self.config.window_seconds <= limit
+        )
+
+    def flush(self, force: bool = False) -> dict[str, np.ndarray]:
+        """Pop finalized windows (all, if force) as columnar rows."""
+        slots = sorted(self.windows) if force else self.closed_slots()
+        rows_ts, rows_key, rows_val = [], [], []
+        for slot in slots:
+            for key, acc in sorted(self.windows.pop(slot).items()):
+                rows_ts.append(slot)
+                rows_key.append(key)
+                rows_val.append(acc)
+        nvals = len(self.config.value_cols)
+        if not rows_ts:
+            empty = {"timeslot": np.zeros(0, np.uint64)}
+            for name in self.config.value_cols + ("count",):
+                empty[name] = np.zeros(0, np.uint64)
+            for name in self.config.key_cols:
+                empty[name] = np.zeros(0, np.uint64)
+            return empty
+        key_arr = np.asarray(rows_key, dtype=np.uint64)
+        val_arr = np.asarray(rows_val, dtype=np.uint64)
+        out = {"timeslot": np.asarray(rows_ts, dtype=np.uint64)}
+        col = 0
+        for name in self.config.key_cols:
+            # address columns occupy 4 lanes; scalars 1
+            width = 4 if name.endswith("addr") or name.endswith("address") else 1
+            if width == 1:
+                out[name] = key_arr[:, col]
+            else:
+                out[name] = key_arr[:, col : col + 4]
+            col += width
+        for j, name in enumerate(self.config.value_cols):
+            out[name] = val_arr[:, j]
+        out["count"] = val_arr[:, nvals]
+        return out

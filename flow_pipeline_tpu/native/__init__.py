@@ -6,7 +6,8 @@ engine, ref: compose/clickhouse/create.sh:5-34). ``libflowdecode.so`` decodes a
 length-prefixed FlowMessage stream straight into struct-of-arrays buffers;
 this module loads it via ctypes and falls back to pure Python when unbuilt.
 
-Build with ``make native`` (see native/Makefile at the repo root).
+Build with ``make native`` once ``native/`` (flowdecode.cc + Makefile) lands;
+until then ``available()`` is False and the pure-Python codec is used.
 """
 
 from __future__ import annotations
@@ -106,11 +107,13 @@ def encode_stream(batch, out_capacity: int = 0) -> bytes:
     cap = out_capacity or (n * 256 + 16)
     out = ctypes.create_string_buffer(cap)
     ptrs = (ctypes.c_void_p * (len(scalar_names) + len(addr_names)))()
+    keepalive = []  # hold contiguous copies for the duration of the call
     for i, name in enumerate(scalar_names + addr_names):
         arr = np.ascontiguousarray(batch.columns[name])
-        batch.columns[name] = arr
+        keepalive.append(arr)
         ptrs[i] = arr.ctypes.data_as(ctypes.c_void_p).value
     written = lib.flow_encode_stream(ptrs, n, out, cap)
+    del keepalive
     if written < 0:
         raise ValueError("native encode: output buffer too small")
     return out.raw[: int(written)]

@@ -1,0 +1,71 @@
+"""Sort-based exact groupby on device.
+
+TPU has no efficient general scatter-with-conflicts; the idiomatic exact
+grouping is: lexicographic multi-key sort (``lax.sort`` with num_keys=W,
+O(n log^2 n) bitonic network, all MXU/VPU-friendly) -> boundary detection ->
+segment reductions. Shapes are static: a batch of N rows yields N segment
+slots with a scalar count of how many are real.
+
+This one op gives the framework exact per-batch partial aggregates, which
+the host (or a psum across chips) merges per window — the same
+partial-merge trick ClickHouse's SummingMergeTree uses at merge time
+(ref: compose/clickhouse/create.sh:70-90), but batched and on-device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sort_groupby(keys, values, valid):
+    """Exact groupby-sum of ``values`` by row-tuples of ``keys``.
+
+    Args:
+      keys:   [N, W] integer lanes (bit-cast to uint32), lexicographic key.
+      values: [N, V] int32 per-row addends (e.g. bytes, packets).
+      valid:  [N] bool; invalid rows contribute nothing.
+
+    Returns:
+      unique_keys: [N, W] uint32 — row i < n_groups holds the i-th group key.
+      sums:        [N, V] int32 — per-group value sums.
+      counts:      [N] int32 — per-group row counts.
+      n_groups:    [] int32 — number of real groups; rows >= n_groups are
+                   padding (keys all-1s, sums/counts zero).
+
+    Caveat: invalid rows are sent to the all-0xFFFFFFFF key, so a *valid* row
+    whose whole key tuple is all-1s would be dropped; key layouts here always
+    lead with a timeslot lane, which never hits 2^32-1.
+    """
+    n, w = keys.shape
+    v = values.shape[1]
+    ku = keys.astype(jnp.uint32)
+    sentinel = jnp.uint32(0xFFFFFFFF)
+    ku = jnp.where(valid[:, None], ku, sentinel)
+    vals = jnp.where(valid[:, None], values.astype(jnp.int32), 0)
+    cnt = valid.astype(jnp.int32)
+
+    operands = [ku[:, i] for i in range(w)] + [vals[:, j] for j in range(v)] + [cnt]
+    sorted_ops = lax.sort(operands, num_keys=w)
+    sk = jnp.stack(sorted_ops[:w], axis=1)  # [N, W] sorted keys
+    sv = jnp.stack(sorted_ops[w : w + v], axis=1)  # [N, V]
+    sc = sorted_ops[w + v]  # [N]
+
+    prev = jnp.concatenate([jnp.full((1, w), sentinel, jnp.uint32), sk[:-1]], axis=0)
+    is_boundary = jnp.any(sk != prev, axis=1)
+    is_boundary = is_boundary.at[0].set(True)
+    seg_ids = jnp.cumsum(is_boundary.astype(jnp.int32)) - 1  # [N]
+
+    sums = jax.ops.segment_sum(sv, seg_ids, num_segments=n)
+    counts = jax.ops.segment_sum(sc, seg_ids, num_segments=n)
+    # Keys are constant within a segment: max == the key.
+    unique_keys = jax.ops.segment_max(sk, seg_ids, num_segments=n)
+
+    row_valid = sc > 0  # sorted invalid rows have cnt 0
+    n_groups = jnp.sum((is_boundary & row_valid).astype(jnp.int32))
+    # Zero out any group that contains no valid rows (the sentinel group).
+    group_real = counts > 0
+    sums = jnp.where(group_real[:, None], sums, 0)
+    unique_keys = jnp.where(group_real[:, None], unique_keys, sentinel)
+    return unique_keys, sums, counts, n_groups

@@ -14,7 +14,17 @@ from .wire import (
     encode_stream,
 )
 from .batch import FlowBatch, COLUMNS
-from .keys import hash_words, hash_columns, pack_addr_words
+
+
+def __getattr__(name):
+    # Lazy: .keys pulls in jax; pure wire-codec consumers (collector-side
+    # producers) must not pay a multi-second jax import.
+    if name in ("hash_words", "hash_columns", "pack_addr_words"):
+        from . import keys
+
+        return getattr(keys, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = [
     "FlowMessage",

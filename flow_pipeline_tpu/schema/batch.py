@@ -164,6 +164,7 @@ class FlowBatch:
         return out
 
     def slice(self, start: int, stop: int) -> "FlowBatch":
+        stop = min(stop, len(self))  # offsets must cover only real rows
         cols = {k: v[start:stop] for k, v in self.columns.items()}
         first = self.first_offset + start if self.first_offset >= 0 else -1
         last = self.first_offset + stop - 1 if self.first_offset >= 0 else -1

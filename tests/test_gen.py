@@ -1,0 +1,70 @@
+"""Generator tests: mocker behavior parity (bounds, address shape, sequence
+numbers) and Zipf heavy-tail properties."""
+
+import numpy as np
+
+from flow_pipeline_tpu.gen import FlowGenerator, MockerProfile, ZipfProfile
+from flow_pipeline_tpu.schema.batch import words_to_addr
+
+
+class TestMockerParity:
+    def test_field_bounds(self):
+        g = FlowGenerator(MockerProfile(), seed=1)
+        b = g.batch(2048)
+        c = b.columns
+        assert c["bytes"].max() < 1500 and c["packets"].max() < 100
+        assert set(np.unique(c["src_as"])) <= {65000, 65001, 65002}
+        assert set(np.unique(c["dst_as"])) <= {65000, 65001, 65002}
+        assert (c["etype"] == 0x86DD).all()
+        assert (c["sampling_rate"] == 1).all()
+        assert c["src_port"].max() < 2**16
+
+    def test_addresses_in_prefix(self):
+        g = FlowGenerator(MockerProfile(), seed=2)
+        b = g.batch(256)
+        addr = words_to_addr(b.columns["src_addr"][0])
+        assert addr[:8] == bytes([0x20, 0x01, 0x0D, 0xB8, 0, 0, 0, 1])
+        assert addr[8:15] == bytes(7)
+        # only the last byte varies -> at most 256 distinct addresses
+        distinct = {words_to_addr(w) for w in b.columns["src_addr"]}
+        assert 1 < len(distinct) <= 256
+
+    def test_sequence_and_time_monotonic(self):
+        g = FlowGenerator(MockerProfile(), seed=3, rate=1000.0)
+        b1, b2 = g.batch(100), g.batch(100)
+        assert b1.columns["sequence_num"][0] == 0
+        assert b2.columns["sequence_num"][0] == 100
+        assert b1.columns["time_flow_start"][0] == b1.columns["time_received"][0]
+        assert b2.columns["time_received"][0] >= b1.columns["time_received"][-1]
+
+    def test_seeded_determinism(self):
+        a = FlowGenerator(MockerProfile(), seed=7).batch(500)
+        b = FlowGenerator(MockerProfile(), seed=7).batch(500)
+        for name in a.columns:
+            np.testing.assert_array_equal(a.columns[name], b.columns[name])
+        c = FlowGenerator(MockerProfile(), seed=8).batch(500)
+        assert any((a.columns[n] != c.columns[n]).any() for n in ("bytes", "src_as"))
+
+
+class TestZipf:
+    def test_heavy_tail(self):
+        g = FlowGenerator(ZipfProfile(n_keys=1000, alpha=1.3), seed=5)
+        b = g.batch(20000)
+        # the hottest (src,dst) addr pair should dominate far beyond uniform
+        pair = np.concatenate([b.columns["src_addr"], b.columns["dst_addr"]], axis=1)
+        voided = np.ascontiguousarray(pair).view([("", np.uint32)] * 8).reshape(-1)
+        _, counts = np.unique(voided, return_counts=True)
+        assert counts.max() > 20000 / 1000 * 20  # >>20x the uniform share
+
+    def test_key_universe_bounded(self):
+        g = FlowGenerator(ZipfProfile(n_keys=50, alpha=1.0), seed=5)
+        b = g.batch(5000)
+        pair = np.concatenate([b.columns["src_addr"], b.columns["dst_addr"]], axis=1)
+        voided = np.ascontiguousarray(pair).view([("", np.uint32)] * 8).reshape(-1)
+        assert len(np.unique(voided)) <= 50
+
+    def test_rate_fills_windows(self):
+        g = FlowGenerator(ZipfProfile(), seed=5, t0=1_699_999_800, rate=100.0)  # 300-aligned
+        b = g.batch(60_000)  # 600 seconds of traffic
+        slots = np.unique(b.columns["time_received"] // 300)
+        assert len(slots) == 2

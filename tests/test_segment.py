@@ -1,0 +1,70 @@
+"""sort_groupby device op vs the numpy oracle, including padding/invalid rows
+and jit cache friendliness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.ops.segment import sort_groupby
+
+
+def np_groupby(keys, values, valid):
+    agg = {}
+    for i in range(len(keys)):
+        if not valid[i]:
+            continue
+        k = tuple(int(x) for x in keys[i])
+        s, c = agg.get(k, (np.zeros(values.shape[1], np.int64), 0))
+        agg[k] = (s + values[i], c + 1)
+    return agg
+
+
+class TestSortGroupby:
+    @pytest.mark.parametrize("n,w,vdim,card", [(64, 2, 1, 5), (256, 3, 2, 40), (512, 6, 2, 300)])
+    def test_matches_numpy(self, rng, n, w, vdim, card):
+        keys = rng.integers(0, card, size=(n, w)).astype(np.uint32)
+        values = rng.integers(0, 1000, size=(n, vdim)).astype(np.int32)
+        valid = rng.random(n) > 0.1
+        uk, sums, counts, ng = jax.jit(sort_groupby)(
+            jnp.asarray(keys), jnp.asarray(values), jnp.asarray(valid)
+        )
+        expect = np_groupby(keys, values, valid)
+        ng = int(ng)
+        assert ng == len(expect)
+        for i in range(ng):
+            k = tuple(int(x) for x in np.asarray(uk[i]))
+            s, c = expect[k]
+            np.testing.assert_array_equal(np.asarray(sums[i]), s)
+            assert int(counts[i]) == c
+
+    def test_all_invalid(self):
+        uk, sums, counts, ng = sort_groupby(
+            jnp.zeros((16, 2), jnp.uint32),
+            jnp.ones((16, 1), jnp.int32),
+            jnp.zeros(16, bool),
+        )
+        assert int(ng) == 0
+        assert int(jnp.sum(sums)) == 0
+
+    def test_single_group(self):
+        n = 32
+        uk, sums, counts, ng = sort_groupby(
+            jnp.ones((n, 3), jnp.uint32) * 7,
+            jnp.ones((n, 2), jnp.int32),
+            jnp.ones(n, bool),
+        )
+        assert int(ng) == 1
+        assert sums[0].tolist() == [n, n]
+        assert int(counts[0]) == n
+
+    def test_groups_lead_output(self, rng):
+        keys = rng.integers(0, 4, size=(128, 1)).astype(np.uint32)
+        valid = rng.random(128) > 0.5
+        uk, sums, counts, ng = sort_groupby(
+            jnp.asarray(keys), jnp.ones((128, 1), jnp.int32), jnp.asarray(valid)
+        )
+        ng = int(ng)
+        assert (np.asarray(counts[:ng]) > 0).all()
+        # rows at/after n_groups are padding or the sentinel group
+        assert (np.asarray(uk[ng + 1 :]) == 0xFFFFFFFF).all() or ng >= 127
