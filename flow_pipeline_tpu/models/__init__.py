@@ -14,6 +14,8 @@ reference delegates to ClickHouse materialized views
 
 from .oracle import exact_groupby, flows_5m, topk_exact
 from .window_agg import WindowAggregator, WindowAggConfig
+from .heavy_hitter import HeavyHitterModel, HeavyHitterConfig, hh_init, hh_update
+from .ddos import DDoSDetector, DDoSConfig
 
 __all__ = [
     "exact_groupby",
@@ -21,4 +23,10 @@ __all__ = [
     "topk_exact",
     "WindowAggregator",
     "WindowAggConfig",
+    "HeavyHitterModel",
+    "HeavyHitterConfig",
+    "hh_init",
+    "hh_update",
+    "DDoSDetector",
+    "DDoSConfig",
 ]

@@ -1,0 +1,181 @@
+"""DDoS spike detector: per-DstAddr EWMA + quantile sketch on Packets.
+
+BASELINE config #5: "sliding-window DDoS spike detect: per-DstAddr EWMA +
+quantile-sketch on Packets". Design:
+
+- DstAddr hashes into an [M] bucket array; each detection sub-window
+  scatter-adds per-flow Packets into the bucket rates.
+- At sub-window close: z-score of each bucket's rate against its EW
+  mean/variance baseline (ops.ewma), AND the rate's rank against the
+  population quantile sketch (ops.quantile). A bucket alarms when both
+  z >= z_threshold and rate >= quantile(q) — the quantile gate suppresses
+  "3 sigma above a tiny baseline" noise.
+- Bucket -> address inversion: a last-writer-wins [M, 4] address store
+  updated by scatter, good enough to name the attacked destination in the
+  alert (hash collisions can mislabel within a bucket; the alert carries
+  the bucket id for exact drill-down via the heavy-hitter model).
+
+All state is mergeable across chips: rates and the histogram sum (psum);
+the EW fold happens once per sub-window on the merged rates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import ewma as ewma_ops
+from ..ops.quantile import QuantileSketchSpec
+from ..schema.batch import FlowBatch
+
+
+@dataclass(frozen=True)
+class DDoSConfig:
+    n_buckets: int = 1 << 14  # 16384 dst buckets
+    sub_window_seconds: int = 10  # detection cadence
+    alpha: float = 0.3  # EW fold weight
+    z_threshold: float = 4.0
+    quantile: float = 0.99
+    min_sigma: float = 4.0
+    rel_sigma: float = 0.25  # sigma floor as a fraction of the EW mean
+    warmup_windows: int = 3  # no alerts until the baseline has folded this often
+    batch_size: int = 8192
+    value_col: str = "packets"
+    rel_err: float = 0.01
+
+
+class DDoSState(NamedTuple):
+    mean: jnp.ndarray  # [M]
+    var: jnp.ndarray  # [M]
+    seen: jnp.ndarray  # [M] bool
+    rates: jnp.ndarray  # [M] current sub-window accumulator
+    hist: jnp.ndarray  # [B] quantile sketch of historical rates
+    addrs: jnp.ndarray  # [M, 4] last-writer dst address per bucket
+
+
+def ddos_init(config: DDoSConfig, spec: QuantileSketchSpec) -> DDoSState:
+    mean, var, seen = ewma_ops.ewma_init(config.n_buckets)
+    return DDoSState(
+        mean=mean,
+        var=var,
+        seen=seen,
+        rates=jnp.zeros(config.n_buckets, jnp.float32),
+        hist=spec.init(),
+        addrs=jnp.zeros((config.n_buckets, 4), jnp.uint32),
+    )
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("state",))
+def ddos_accumulate(state: DDoSState, cols: dict, valid, *, config: DDoSConfig):
+    """Scatter one batch into the current sub-window."""
+    dst = cols["dst_addr"].astype(jnp.uint32)
+    buckets = ewma_ops.bucket_of(dst, config.n_buckets)
+    # uint32 reinterpretation keeps saturated counters (>2^31) positive
+    vals = cols[config.value_col].astype(jnp.uint32).astype(jnp.float32)
+    rates = ewma_ops.rate_accumulate(state.rates, buckets, vals, valid)
+    # Last-writer-wins address inversion. Invalid rows go to index
+    # n_buckets: out of range HIGH, which mode="drop" discards (a negative
+    # index would wrap to the last bucket before the drop check).
+    safe_buckets = jnp.where(valid, buckets, config.n_buckets)
+    addrs = state.addrs.at[safe_buckets].set(dst, mode="drop")
+    return state._replace(rates=rates, addrs=addrs)
+
+
+@partial(jax.jit, static_argnames=("config", "spec"), donate_argnames=("state",))
+def ddos_close_window(state: DDoSState, *, config: DDoSConfig, spec: QuantileSketchSpec):
+    """Close a sub-window: score, fold baseline, reset rates.
+
+    Returns (new_state, z [M], rates [M]).
+    """
+    z = ewma_ops.zscores((state.mean, state.var, state.seen), state.rates,
+                         config.min_sigma, config.rel_sigma)
+    active = state.rates > 0
+    hist = spec.add(state.hist, state.rates, valid=active)
+    mean, var, seen = ewma_ops.ewma_fold(
+        (state.mean, state.var, state.seen), state.rates, config.alpha
+    )
+    new_state = state._replace(
+        mean=mean, var=var, seen=seen,
+        rates=jnp.zeros_like(state.rates), hist=hist,
+    )
+    return new_state, z, state.rates
+
+
+class DDoSDetector:
+    """Host wrapper: feed batches; sub-windows close on time_received."""
+
+    def __init__(self, config: DDoSConfig = DDoSConfig()):
+        self.config = config
+        self.spec = QuantileSketchSpec(rel_err=config.rel_err)
+        self.state = ddos_init(config, self.spec)
+        self.current_sub = None  # sub-window start
+        self.folds = 0  # closed sub-windows; alerts suppressed during warmup
+        self.alerts: list[dict] = []
+
+    def update(self, batch: FlowBatch) -> None:
+        if len(batch) == 0:
+            return
+        # Split rows by sub-window (a batch may straddle boundaries; rows
+        # must not inflate the wrong window's rates). Row order within the
+        # batch is irrelevant to the scatter, so boolean selection is fine.
+        subs = (
+            batch.columns["time_received"].astype(np.int64)
+            // self.config.sub_window_seconds
+            * self.config.sub_window_seconds
+        )
+        for sub in np.unique(subs):
+            idx = np.flatnonzero(subs == sub)
+            part = FlowBatch(
+                {k: v[idx] for k, v in batch.columns.items()},
+                batch.partition,
+            )
+            sub = int(sub)
+            if self.current_sub is None:
+                self.current_sub = sub
+            elif sub > self.current_sub:
+                self.close_sub_window()
+                self.current_sub = sub
+            self._accumulate(part)
+
+    def _accumulate(self, batch: FlowBatch) -> None:
+        bs = self.config.batch_size
+        for start in range(0, len(batch), bs):  # chunk arbitrary batch sizes
+            padded, mask = batch.slice(start, start + bs).pad_to(bs)
+            cols = padded.device_columns(["dst_addr", self.config.value_col])
+            cols = {k: jnp.asarray(v) for k, v in cols.items()}
+            self.state = ddos_accumulate(
+                self.state, cols, jnp.asarray(mask), config=self.config
+            )
+
+    def close_sub_window(self) -> list[dict]:
+        """Score + roll the sub-window; returns (and records) new alerts."""
+        self.state, z, rates = ddos_close_window(
+            self.state, config=self.config, spec=self.spec
+        )
+        self.folds += 1
+        if self.folds <= self.config.warmup_windows:
+            return []
+        z = np.asarray(z)
+        rates = np.asarray(rates)
+        gate = self.spec.quantile(np.asarray(self.state.hist), self.config.quantile)
+        hot = np.nonzero((z >= self.config.z_threshold) & (rates >= max(gate, 1.0)))[0]
+        new = []
+        addrs = np.asarray(self.state.addrs)
+        for b in hot:
+            new.append(
+                {
+                    "sub_window": self.current_sub,
+                    "bucket": int(b),
+                    "dst_addr": addrs[b].astype(np.uint32),
+                    "rate": float(rates[b]),
+                    "zscore": float(z[b]),
+                    "baseline_quantile": float(gate),
+                }
+            )
+        self.alerts.extend(new)
+        return new

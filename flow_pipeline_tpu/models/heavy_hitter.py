@@ -1,0 +1,162 @@
+"""Heavy-hitter model: count-min sketch + top-K candidate table.
+
+The flagship sketch pipeline (BASELINE configs #2 and #3):
+
+    batch columns
+      -> sort_groupby on the key tuple        (exact per-batch pre-agg)
+      -> conservative count-min update        (bounded-error totals)
+      -> top-K table merge                    (identity tracking)
+
+State lives on device for the whole window; the host only sees the final
+top-K rows at window close. The key tuple is configurable — (SrcAddr,
+DstAddr) for config #2, the 5-tuple (SrcAddr, DstAddr, SrcPort, DstPort,
+Proto) "top talkers" for config #3. Estimates come from the CMS query
+(min over depth), which upper-bounds true totals by <= e/width * stream
+mass; ranking uses the table's accumulated sums.
+
+Window semantics mirror the exact aggregator: the model is windowed by the
+driver (engine/) which calls ``flush`` at watermark close — same tumbling
+5-minute windows as the reference's flows_5m rollup
+(ref: compose/clickhouse/create.sh:96).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import cms as cms_ops
+from ..ops import topk as topk_ops
+from ..ops.segment import sort_groupby_float
+from ..schema.batch import FlowBatch, lane_width
+
+
+@dataclass(frozen=True)
+class HeavyHitterConfig:
+    key_cols: tuple[str, ...] = ("src_addr", "dst_addr")
+    value_cols: tuple[str, ...] = ("bytes", "packets")  # plane 0 ranks
+    depth: int = 4
+    width: int = 1 << 16  # 65536, multiple of 128
+    capacity: int = 1024  # candidate table rows
+    batch_size: int = 8192
+    conservative: bool = True
+
+
+class HHState(NamedTuple):
+    """Device-resident sketch state (a pytree — psum/donate friendly)."""
+
+    cms: jnp.ndarray  # [P+1, depth, width] (value planes + count plane)
+    table_keys: jnp.ndarray  # [C, W]
+    table_vals: jnp.ndarray  # [C, P+1]
+
+
+def key_width(config: HeavyHitterConfig) -> int:
+    return sum(lane_width(name) for name in config.key_cols)
+
+
+def hh_init(config: HeavyHitterConfig) -> HHState:
+    planes = len(config.value_cols) + 1  # + count
+    tk, tv = topk_ops.topk_init(config.capacity, key_width(config), planes)
+    return HHState(
+        cms=cms_ops.cms_init(planes, config.depth, config.width),
+        table_keys=tk,
+        table_vals=tv,
+    )
+
+
+def _key_lanes(cols: dict, key_cols) -> jnp.ndarray:
+    lanes = []
+    for name in key_cols:
+        arr = cols[name].astype(jnp.uint32)
+        if arr.ndim == 1:
+            lanes.append(arr[:, None])
+        else:
+            lanes.append(arr)
+    return jnp.concatenate(lanes, axis=1)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("state",))
+def hh_update(state: HHState, cols: dict, valid, *, config: HeavyHitterConfig) -> HHState:
+    """One batch step, fully on device."""
+    keys = _key_lanes(cols, config.key_cols)
+    # Columns arrive as int32 bit-patterns of uint32 counters; reinterpret as
+    # unsigned before the float cast so saturated values (>2^31) stay
+    # positive — a negative addend would break the CMS upper-bound invariant.
+    values = jnp.stack(
+        [
+            cols[name].astype(jnp.uint32).astype(jnp.float32)
+            for name in config.value_cols
+        ]
+        + [jnp.ones(keys.shape[0], jnp.float32)],
+        axis=1,
+    )
+    uniq, sums, counts = sort_groupby_float(keys, values, valid)
+    row_valid = counts > 0
+    add = cms_ops.cms_add_conservative if config.conservative else cms_ops.cms_add
+    new_cms = add(state.cms, uniq, sums, row_valid)
+    tk, tv = topk_ops.topk_merge(
+        state.table_keys, state.table_vals, uniq, sums, row_valid
+    )
+    return HHState(cms=new_cms, table_keys=tk, table_vals=tv)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def hh_estimates(state: HHState, *, config: HeavyHitterConfig):
+    """CMS point estimates for every table key. [C, P+1] float32."""
+    return cms_ops.cms_query(state.cms, state.table_keys)
+
+
+class HeavyHitterModel:
+    """Host wrapper: feed batches, extract top-K at window close."""
+
+    def __init__(self, config: HeavyHitterConfig = HeavyHitterConfig()):
+        self.config = config
+        self.state = hh_init(config)
+
+    def update(self, batch: FlowBatch) -> None:
+        bs = self.config.batch_size
+        for start in range(0, len(batch), bs):  # chunk arbitrary batch sizes
+            padded, mask = batch.slice(start, start + bs).pad_to(bs)
+            cols = padded.device_columns(
+                [*self.config.key_cols, *self.config.value_cols]
+            )
+            cols = {k: jnp.asarray(v) for k, v in cols.items()}
+            self.state = hh_update(
+                self.state, cols, jnp.asarray(mask), config=self.config
+            )
+
+    def top(self, k: int | None = None) -> dict[str, np.ndarray]:
+        """Top-k rows: keys split back into columns + estimated sums.
+
+        ``table`` sums rank the rows; ``est`` columns are the CMS upper
+        bounds (tighter under conservative update)."""
+        k = k or self.config.capacity
+        keys, vals, valid = topk_ops.topk_extract(
+            self.state.table_keys, self.state.table_vals, k
+        )
+        ests = hh_estimates(self.state, config=self.config)[:k]
+        keys = np.asarray(keys)
+        vals = np.asarray(vals)
+        ests = np.asarray(ests)
+        valid = np.asarray(valid)
+        out: dict[str, np.ndarray] = {}
+        col = 0
+        for name in self.config.key_cols:
+            w = lane_width(name)
+            out[name] = keys[:, col : col + w] if w == 4 else keys[:, col]
+            col += w
+        for j, name in enumerate(self.config.value_cols):
+            out[name] = vals[:, j]
+            out[f"{name}_est"] = ests[:, j]
+        out["count"] = vals[:, -1]
+        out["count_est"] = ests[:, -1]
+        out["valid"] = valid
+        return out
+
+    def reset(self) -> None:
+        self.state = hh_init(self.config)

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.segment import sort_groupby
-from ..schema.batch import FlowBatch
+from ..schema.batch import FlowBatch, lane_width
 from .oracle import SECONDS_PER_SLOT
 
 
@@ -60,9 +60,16 @@ def _build_update(config: WindowAggConfig):
             else:
                 lanes.extend(arr[:, i] for i in range(arr.shape[1]))
         keys = jnp.stack(lanes, axis=1)
-        values = jnp.stack(
-            [cols[name].astype(jnp.int32) for name in config.value_cols], axis=1
-        )
+        # Exactness: each uint32 value column rides as two 16-bit planes so
+        # per-batch int32 segment sums cannot overflow (batch_size <= 32768
+        # guarantees plane sums < 2^31); the host recombines lo + (hi << 16)
+        # in uint64.
+        planes = []
+        for name in config.value_cols:
+            v = cols[name].astype(jnp.uint32)
+            planes.append((v & jnp.uint32(0xFFFF)).astype(jnp.int32))
+            planes.append((v >> jnp.uint32(16)).astype(jnp.int32))
+        values = jnp.stack(planes, axis=1)
         return sort_groupby(keys, values, valid)
 
     return update
@@ -73,6 +80,11 @@ class WindowAggregator:
     finalized window rows."""
 
     def __init__(self, config: WindowAggConfig = WindowAggConfig()):
+        if config.batch_size > 32768:
+            raise ValueError(
+                "batch_size must be <= 32768 (int32 exactness of the 16-bit "
+                "value planes)"
+            )
         self.config = config
         self._update = _build_update(config)
         # windows: timeslot -> {key tuple -> uint64 [**values, count]}
@@ -83,6 +95,14 @@ class WindowAggregator:
     def update(self, batch: FlowBatch) -> None:
         if len(batch) == 0:
             return
+        bs = self.config.batch_size
+        for start in range(0, len(batch), bs):  # chunk arbitrary batch sizes
+            self._update_chunk(batch.slice(start, start + bs))
+        wm = int(batch.columns["time_received"].max())
+        if wm > self.watermark:
+            self.watermark = wm
+
+    def _update_chunk(self, batch: FlowBatch) -> None:
         padded, mask = batch.pad_to(self.config.batch_size)
         cols = {
             name: jnp.asarray(arr)
@@ -93,10 +113,14 @@ class WindowAggregator:
         keys, sums, counts, n = self._update(cols, jnp.asarray(mask))
         n = int(n)
         keys = np.asarray(keys[:n]).astype(np.uint32)
-        sums = np.asarray(sums[:n]).astype(np.uint64)
+        plane_sums = np.asarray(sums[:n]).astype(np.uint64)
+        # recombine the (lo, hi) 16-bit planes of each value column
+        nvals = len(self.config.value_cols)
+        sums = np.empty((n, nvals), dtype=np.uint64)
+        for j in range(nvals):
+            sums[:, j] = plane_sums[:, 2 * j] + (plane_sums[:, 2 * j + 1] << 16)
         counts = np.asarray(counts[:n]).astype(np.uint64)
         self._key_width = keys.shape[1]
-        nvals = sums.shape[1]
         for i in range(n):
             slot = int(keys[i, 0])
             key = tuple(int(x) for x in keys[i, 1:])
@@ -107,9 +131,6 @@ class WindowAggregator:
                 wstore[key] = acc
             acc[:nvals] += sums[i]
             acc[nvals] += counts[i]
-        wm = int(batch.columns["time_received"].max())
-        if wm > self.watermark:
-            self.watermark = wm
 
     def closed_slots(self) -> list[int]:
         limit = self.watermark - self.config.allowed_lateness
@@ -139,8 +160,7 @@ class WindowAggregator:
         out = {"timeslot": np.asarray(rows_ts, dtype=np.uint64)}
         col = 0
         for name in self.config.key_cols:
-            # address columns occupy 4 lanes; scalars 1
-            width = 4 if name.endswith("addr") or name.endswith("address") else 1
+            width = lane_width(name)
             if width == 1:
                 out[name] = key_arr[:, col]
             else:

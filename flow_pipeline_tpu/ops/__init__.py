@@ -15,5 +15,33 @@ kernel", ref: compose/clickhouse/create.sh:70-110) with XLA/Pallas:
 """
 
 from .segment import sort_groupby
+from .cms import (
+    cms_init,
+    cms_add,
+    cms_add_conservative,
+    cms_query,
+    cms_merge,
+    cms_buckets,
+)
+from .topk import topk_init, topk_merge, topk_extract
+from .ewma import ewma_init, ewma_fold, zscores, bucket_of, rate_accumulate
+from .quantile import QuantileSketchSpec
 
-__all__ = ["sort_groupby"]
+__all__ = [
+    "sort_groupby",
+    "cms_init",
+    "cms_add",
+    "cms_add_conservative",
+    "cms_query",
+    "cms_merge",
+    "cms_buckets",
+    "topk_init",
+    "topk_merge",
+    "topk_extract",
+    "ewma_init",
+    "ewma_fold",
+    "zscores",
+    "bucket_of",
+    "rate_accumulate",
+    "QuantileSketchSpec",
+]

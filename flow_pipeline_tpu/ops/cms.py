@@ -1,0 +1,115 @@
+"""Count-min sketch ops.
+
+The CMS is the framework's replacement for ClickHouse's SummingMergeTree
+when key cardinality is too high for exact aggregation (the 38-byte 5-tuple
+space; ref north star: BASELINE.json). Layout is TPU-first:
+
+- counts: [planes, depth, width] float32. ``planes`` are the metrics
+  (bytes, packets, count). float32 keeps scatter-adds on native lanes;
+  integer sums stay exact below 2^24 per cell per batch and the parity gate
+  is 1%, far above float32's relative error. ``width`` should be a multiple
+  of 128 (lane tiling).
+- Updates are pre-aggregated: callers first collapse the batch to unique
+  keys (ops.segment.sort_groupby), so each key touches each depth row once
+  per batch. This slashes scatter conflicts and makes conservative update
+  meaningful within a batch.
+- Merge across chips is element-wise sum (count-min is a commutative
+  monoid), i.e. a plain ``psum`` over the mesh — the ICI replacement for
+  ClickHouse's merge-time partial-sum combine.
+
+Bucket choice per depth uses the murmur3 word-lane hash (schema.keys) with
+a distinct seed per row.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..schema.keys import hash_words
+
+
+def cms_init(planes: int, depth: int, width: int) -> jnp.ndarray:
+    """Fresh sketch. width should be a multiple of 128."""
+    return jnp.zeros((planes, depth, width), dtype=jnp.float32)
+
+
+def cms_buckets(keys, depth: int, width: int):
+    """Per-depth bucket indices for key word-lanes.
+
+    keys: [N, W] uint32 lanes. Returns [depth, N] int32 in [0, width).
+    Seeds 0..depth-1 give independent rows."""
+    cols = []
+    for d in range(depth):  # depth is small + static: unrolled
+        h = hash_words(keys, seed=d)
+        cols.append((h % jnp.uint32(width)).astype(jnp.int32))
+    return jnp.stack(cols, axis=0)
+
+
+def cms_add(counts, keys, values, valid=None):
+    """Linear (mergeable) update with pre-aggregated per-key values.
+
+    counts: [P, D, W] float32 sketch.
+    keys:   [N, W_k] uint32 unique key lanes.
+    values: [N, P] per-key addends (cast to float32).
+    valid:  [N] bool mask (e.g. rows < n_groups from sort_groupby).
+    """
+    p, d, w = counts.shape
+    buckets = cms_buckets(keys, d, w)  # [D, N]
+    vals = values.astype(jnp.float32)
+    if valid is not None:
+        vals = jnp.where(valid[:, None], vals, 0.0)
+    for di in range(d):
+        # [P, N] scatter-add into row di; XLA lowers to sorted scatter.
+        counts = counts.at[:, di, buckets[di]].add(vals.T)
+    return counts
+
+
+def cms_query(counts, keys):
+    """Point estimate: min over depth rows. Returns [N, P] float32 (upper
+    bound of the true sums for linear updates)."""
+    p, d, w = counts.shape
+    buckets = cms_buckets(keys, d, w)  # [D, N]
+    ests = []
+    for di in range(d):
+        ests.append(counts[:, di, buckets[di]])  # [P, N]
+    return jnp.min(jnp.stack(ests, axis=0), axis=0).T  # [N, P]
+
+
+def cms_add_conservative(counts, keys, values, valid=None):
+    """Conservative update: raise each cell only to (current min estimate +
+    addend). Tighter estimates than linear add; still an upper bound. Merge
+    by + remains a valid upper bound but loses the CU tightness.
+
+    Same shapes as cms_add. Keys must be unique within the call (use
+    sort_groupby first) — duplicate keys would under-count.
+    """
+    p, d, w = counts.shape
+    buckets = cms_buckets(keys, d, w)  # [D, N]
+    vals = values.astype(jnp.float32)
+    if valid is not None:
+        vals = jnp.where(valid[:, None], vals, 0.0)
+    # current estimate before update
+    est = cms_query(counts, keys)  # [N, P]
+    target = est + vals  # [N, P] the CU ceiling for this key
+    for di in range(d):
+        # cell must become at least `target`, but never decrease.
+        counts = counts.at[:, di, buckets[di]].max(target.T)
+    return counts
+
+
+def cms_merge(*sketches):
+    """Combine per-shard sketches (element-wise sum)."""
+    out = sketches[0]
+    for s in sketches[1:]:
+        out = out + s
+    return out
+
+
+def cms_relative_error(depth: int, width: int, total: float) -> float:
+    """Standard CMS guarantee: err <= e/width * total with prob 1-e^-depth."""
+    import math
+
+    return math.e / width * total

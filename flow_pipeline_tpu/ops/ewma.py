@@ -1,0 +1,68 @@
+"""Per-bucket EWMA baselines (mean + variance) for anomaly detection.
+
+Keys are hashed into a fixed bucket array (power-of-two size, 128-aligned);
+per-window rates are scatter-added, then the window close folds the rate
+into exponentially weighted mean/variance per bucket. z-scores against the
+EW baseline drive the DDoS spike detector (BASELINE.json config #5:
+"per-DstAddr EWMA + quantile-sketch on Packets").
+
+State is a pair of [M] float32 arrays (mean, var) plus the in-progress
+window's [M] rate accumulator — all psum/merge-friendly: rate accumulators
+sum across shards; mean/var fold happens once per window on the merged rate.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..schema.keys import hash_words
+
+
+def ewma_init(n_buckets: int):
+    """(mean, var, initialized) arrays."""
+    return (
+        jnp.zeros(n_buckets, jnp.float32),
+        jnp.zeros(n_buckets, jnp.float32),
+        jnp.zeros(n_buckets, jnp.bool_),
+    )
+
+
+def bucket_of(keys, n_buckets: int, seed: int = 0x5EED):
+    """[N, W] key lanes -> [N] int32 bucket ids."""
+    return (hash_words(keys, seed=seed) % jnp.uint32(n_buckets)).astype(jnp.int32)
+
+
+def rate_accumulate(rates, buckets, values, valid):
+    """Scatter-add per-flow values into the window's per-bucket rate array."""
+    v = jnp.where(valid, values.astype(jnp.float32), 0.0)
+    return rates.at[buckets].add(v)
+
+
+def ewma_fold(state, rates, alpha: float):
+    """Close a window: fold observed per-bucket rates into the EW baseline.
+
+    West's EW update: d = x - mean; mean += a*d; var = (1-a)*(var + a*d^2).
+    Buckets never seen before initialize mean to their first rate (no
+    cold-start alarm on the first observation).
+    """
+    mean, var, seen = state
+    a = jnp.float32(alpha)
+    d = rates - mean
+    new_mean = jnp.where(seen, mean + a * d, rates)
+    new_var = jnp.where(seen, (1.0 - a) * (var + a * d * d), jnp.zeros_like(var))
+    new_seen = seen | (rates > 0)
+    return new_mean, new_var, new_seen
+
+
+def zscores(state, rates, min_sigma: float = 1.0, rel_sigma: float = 0.25):
+    """Per-bucket z-score of the current window's rate vs the EW baseline.
+
+    The denominator is floored at both ``min_sigma`` (absolute; quiet
+    buckets) and ``rel_sigma * mean`` (relative; before the EW variance has
+    converged, natural fluctuation scales with the mean — without this floor
+    the first few windows alarm on noise)."""
+    mean, var, seen = state
+    sigma = jnp.maximum(jnp.sqrt(var), jnp.float32(min_sigma))
+    sigma = jnp.maximum(sigma, jnp.float32(rel_sigma) * mean)
+    z = (rates - mean) / sigma
+    return jnp.where(seen, z, 0.0)

@@ -1,0 +1,64 @@
+"""Log-bucket quantile histogram (DDSketch-flavored).
+
+Relative-error quantiles over a stream of non-negative values, as a fixed
+[B] counter array: value v lands in bucket floor(log_gamma(v)) + offset,
+clamped. Guarantees quantile estimates within a multiplicative
+(1 +/- rel_err) like DDSketch, with a TPU-trivial layout: updating is a
+scatter-add, merging is +, querying is a cumsum scan (host or device).
+
+Used by the DDoS model to turn "is this dst's packet rate extreme?" into a
+quantile threshold over the population of per-bucket rates.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class QuantileSketchSpec:
+    """Static parameters: relative error -> gamma and bucket count."""
+
+    def __init__(self, rel_err: float = 0.01, max_value: float = 1e12, n_buckets: int | None = None):
+        self.rel_err = rel_err
+        self.gamma = (1 + rel_err) / (1 - rel_err)
+        self.log_gamma = math.log(self.gamma)
+        # bucket 0 holds zeros/sub-1 values; buckets 1.. hold log ranges
+        need = int(math.ceil(math.log(max_value) / self.log_gamma)) + 2
+        self.n_buckets = n_buckets or need
+
+    def init(self):
+        return jnp.zeros(self.n_buckets, jnp.float32)
+
+    def bucket_of(self, values):
+        """[N] values -> [N] int32 bucket ids (device-safe)."""
+        v = jnp.maximum(values.astype(jnp.float32), 1e-9)
+        idx = jnp.ceil(jnp.log(v) / jnp.float32(self.log_gamma)).astype(jnp.int32) + 1
+        idx = jnp.where(values <= 1.0, 1, idx)  # [0,1] -> bucket 1
+        idx = jnp.where(values <= 0.0, 0, idx)  # zeros -> bucket 0
+        return jnp.clip(idx, 0, self.n_buckets - 1)
+
+    def add(self, hist, values, weights=None, valid=None):
+        w = jnp.ones_like(values, jnp.float32) if weights is None else weights.astype(jnp.float32)
+        if valid is not None:
+            w = jnp.where(valid, w, 0.0)
+        return hist.at[self.bucket_of(values)].add(w)
+
+    def value_of_bucket(self, idx):
+        """Representative (upper-bound) value of bucket idx (numpy/host)."""
+        idx = np.asarray(idx)
+        val = self.gamma ** (idx.astype(np.float64) - 1)
+        return np.where(idx <= 0, 0.0, np.where(idx == 1, 1.0, val))
+
+    def quantile(self, hist, q: float) -> float:
+        """Host-side quantile query: smallest bucket value covering q mass."""
+        h = np.asarray(hist, dtype=np.float64)
+        total = h.sum()
+        if total <= 0:
+            return 0.0
+        cum = np.cumsum(h)
+        idx = int(np.searchsorted(cum, q * total, side="left"))
+        idx = min(idx, self.n_buckets - 1)
+        return float(self.value_of_bucket(np.array([idx]))[0])

@@ -69,3 +69,51 @@ def sort_groupby(keys, values, valid):
     sums = jnp.where(group_real[:, None], sums, 0)
     unique_keys = jnp.where(group_real[:, None], unique_keys, sentinel)
     return unique_keys, sums, counts, n_groups
+
+
+def sort_groupby_float(keys, values, valid):
+    """sort_groupby with float32 value planes.
+
+    Value magnitudes beyond int32 (saturated uint32 byte counters, float
+    sketch sums) can't ride the int32 path; here the float planes travel
+    through the multi-key sort as bit-cast int32 payload lanes and are
+    segment-summed in float domain. Same return contract as sort_groupby
+    but sums is float32 and the n_groups scalar is replaced by per-row
+    ``counts > 0`` validity (the all-sentinel group is zeroed).
+
+    Returns (unique_keys [N,W] uint32, sums [N,P] float32, counts [N] int32).
+    """
+    n, w = keys.shape
+    p = values.shape[1]
+    sentinel = jnp.uint32(0xFFFFFFFF)
+    ku = jnp.where(valid[:, None], keys.astype(jnp.uint32), sentinel)
+    fv = jnp.where(valid[:, None], values.astype(jnp.float32), 0.0)
+    cnt = valid.astype(jnp.int32)
+
+    operands = (
+        [ku[:, i] for i in range(w)]
+        + [lax.bitcast_convert_type(fv[:, j], jnp.int32) for j in range(p)]
+        + [cnt]
+    )
+    sorted_ops = lax.sort(operands, num_keys=w)
+    sk = jnp.stack(sorted_ops[:w], axis=1)
+    sv = jnp.stack(
+        [lax.bitcast_convert_type(sorted_ops[w + j], jnp.float32) for j in range(p)],
+        axis=1,
+    )
+    sc = sorted_ops[w + p]
+
+    prev = jnp.concatenate([jnp.full((1, w), sentinel, jnp.uint32), sk[:-1]], axis=0)
+    is_boundary = jnp.any(sk != prev, axis=1)
+    is_boundary = is_boundary.at[0].set(True)
+    seg_ids = jnp.cumsum(is_boundary.astype(jnp.int32)) - 1
+
+    sums = jax.ops.segment_sum(sv, seg_ids, num_segments=n)
+    counts = jax.ops.segment_sum(sc, seg_ids, num_segments=n)
+    uniq = jax.ops.segment_max(sk, seg_ids, num_segments=n)
+
+    real = (counts > 0) & ~jnp.all(uniq == sentinel, axis=1)
+    sums = jnp.where(real[:, None], sums, 0.0)
+    uniq = jnp.where(real[:, None], uniq, sentinel)
+    counts = jnp.where(real, counts, 0)
+    return uniq, sums, counts

@@ -1,0 +1,72 @@
+"""Device-resident top-K candidate table.
+
+Heavy-hitter identity tracking with fixed-shape, sort-based merges — the
+TPU-idiomatic replacement for the (inherently sequential) space-saving
+algorithm. The table holds ``capacity`` (key, value-vector) rows; each batch
+round merges the batch's unique keys into the table:
+
+    concat(table, candidates) -> lexicographic sort by key
+    -> segment-sum duplicate keys -> rank by primary value -> keep top C
+
+Guarantee (Misra-Gries flavored): per-round dropped mass is bounded by the
+rank-C value, so any key whose true total dominates survives rounds. The
+paired CMS (ops.cms) provides count estimates with an eps*N bound, so the
+table only needs to not lose identities — the "invertible sketch"
+decomposition (candidate set + counter array) from the heavy-hitter
+literature (see PAPERS.md).
+
+Merging two tables (cross-chip, at window close) is the same op with the
+second table as candidates — associative up to ties, so it rides an
+all_gather + fold over the mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .segment import sort_groupby_float
+
+SENTINEL = jnp.uint32(0xFFFFFFFF)
+
+
+def topk_init(capacity: int, key_width: int, planes: int):
+    """Empty table: sentinel keys, zero values."""
+    keys = jnp.full((capacity, key_width), SENTINEL, dtype=jnp.uint32)
+    vals = jnp.zeros((capacity, planes), dtype=jnp.float32)
+    return keys, vals
+
+
+def topk_merge(table_keys, table_vals, cand_keys, cand_vals, cand_valid):
+    """Merge candidate rows into the table; returns (keys, vals) of the same
+    capacity, ranked by vals[:, 0] descending.
+
+    table_keys: [C, W] uint32 (sentinel rows = empty slots)
+    table_vals: [C, P] float32
+    cand_keys:  [N, W] uint32 unique keys (e.g. from sort_groupby)
+    cand_vals:  [N, P] values (summed per key); plane 0 is the ranking metric
+    cand_valid: [N] bool
+    """
+    c = table_keys.shape[0]
+    table_valid = jnp.any(table_keys != SENTINEL, axis=1)
+    all_keys = jnp.concatenate([table_keys, cand_keys.astype(jnp.uint32)], axis=0)
+    all_vals = jnp.concatenate(
+        [table_vals, cand_vals.astype(jnp.float32)], axis=0
+    )
+    all_valid = jnp.concatenate([table_valid, cand_valid], axis=0)
+
+    uniq, sums, counts = sort_groupby_float(all_keys, all_vals, all_valid)
+
+    real = counts > 0
+    primary = jnp.where(real, sums[:, 0], -jnp.inf)
+    top = jnp.argsort(-primary)[:c]
+    new_keys = jnp.where(real[top][:, None], uniq[top], SENTINEL)
+    new_vals = jnp.where(real[top][:, None], sums[top], 0.0)
+    return new_keys, new_vals
+
+
+def topk_extract(table_keys, table_vals, k: int):
+    """Host-facing: top-k rows (already ranked). Returns (keys, vals, valid)."""
+    valid = jnp.any(table_keys != SENTINEL, axis=1)
+    return table_keys[:k], table_vals[:k], valid[:k]

@@ -63,6 +63,12 @@ COLUMNS: dict[str, np.dtype] = {
 ADDR_COLUMNS = ("src_addr", "dst_addr", "sampler_address")
 
 
+def lane_width(name: str) -> int:
+    """Device lanes a column occupies: addresses are 4 uint32 words, scalars 1.
+    The single source of truth for key packing/unpacking widths."""
+    return 4 if name in ADDR_COLUMNS else 1
+
+
 def addr_to_words(addr: bytes) -> np.ndarray:
     """16-byte address -> 4 big-endian uint32 words. Short input (e.g. a raw
     IPv4) is left-padded to 16 bytes, matching the trailing-bytes embedding."""

@@ -1,0 +1,198 @@
+"""Model-level tests: heavy-hitter top-K vs exact oracle (the <=1% error
+gate from BASELINE.json) and DDoS spike detection on injected attacks."""
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.gen import FlowGenerator, MockerProfile, ZipfProfile
+from flow_pipeline_tpu.models import (
+    DDoSConfig,
+    DDoSDetector,
+    HeavyHitterConfig,
+    HeavyHitterModel,
+)
+from flow_pipeline_tpu.models.oracle import topk_exact
+from flow_pipeline_tpu.schema.batch import FlowBatch
+
+
+def key_tuple(row_keys, i):
+    return tuple(int(x) for x in np.atleast_1d(row_keys[i]).ravel())
+
+
+class TestHeavyHitterParity:
+    def run_model(self, config, batches):
+        model = HeavyHitterModel(config)
+        for b in batches:
+            model.update(b)
+        return model
+
+    def oracle_top(self, batches, key_cols, k):
+        return topk_exact(FlowBatch.concat(batches), list(key_cols), k)
+
+    def test_addr_pair_topk_within_1pct(self):
+        config = HeavyHitterConfig(
+            key_cols=("src_addr", "dst_addr"), batch_size=4096,
+            width=1 << 14, capacity=512,
+        )
+        g = FlowGenerator(ZipfProfile(n_keys=2000, alpha=1.2), seed=31)
+        batches = [g.batch(4096) for _ in range(6)]
+        model = self.run_model(config, batches)
+        k = 20
+        top = model.top(k)
+        oracle = self.oracle_top(batches, config.key_cols, k)
+
+        got = {
+            (key_tuple(top["src_addr"], i) + key_tuple(top["dst_addr"], i)):
+                float(top["bytes"][i])
+            for i in range(k)
+        }
+        errs = []
+        for i in range(k):
+            key = (key_tuple(oracle["src_addr"], i)
+                   + key_tuple(oracle["dst_addr"], i))
+            true = float(oracle["bytes"][i])
+            assert key in got, f"oracle top-{k} key {i} missing from sketch"
+            errs.append(abs(got[key] - true) / true)
+        assert max(errs) <= 0.01, f"max top-K bytes error {max(errs):.4f}"
+
+    def test_five_tuple_talkers(self):
+        config = HeavyHitterConfig(
+            key_cols=("src_addr", "dst_addr", "src_port", "dst_port", "proto"),
+            batch_size=2048, width=1 << 14, capacity=256,
+        )
+        g = FlowGenerator(ZipfProfile(n_keys=500, alpha=1.4), seed=32)
+        batches = [g.batch(2048) for _ in range(4)]
+        model = self.run_model(config, batches)
+        top = model.top(10)
+        oracle = self.oracle_top(
+            batches, config.key_cols, 10
+        )
+        # rank-0 talker identical, bytes within 1%
+        got_key = (key_tuple(top["src_addr"], 0) + key_tuple(top["dst_addr"], 0)
+                   + (int(top["src_port"][0]), int(top["dst_port"][0]),
+                      int(top["proto"][0])))
+        want_key = (key_tuple(oracle["src_addr"], 0)
+                    + key_tuple(oracle["dst_addr"], 0)
+                    + (int(oracle["src_port"][0]), int(oracle["dst_port"][0]),
+                       int(oracle["proto"][0])))
+        assert got_key == want_key
+        err = abs(float(top["bytes"][0]) - float(oracle["bytes"][0])) / float(
+            oracle["bytes"][0]
+        )
+        assert err <= 0.01
+
+    def test_counts_and_packets_planes(self):
+        config = HeavyHitterConfig(batch_size=1024, width=1 << 14, capacity=128)
+        g = FlowGenerator(ZipfProfile(n_keys=100, alpha=1.5), seed=33)
+        batches = [g.batch(1024) for _ in range(3)]
+        model = self.run_model(config, batches)
+        top = model.top(5)
+        oracle = topk_exact(
+            FlowBatch.concat(batches), ["src_addr", "dst_addr"], 5
+        )
+        # table sums for the hottest key are exact (never evicted)
+        assert float(top["bytes"][0]) == float(oracle["bytes"][0])
+        assert int(top["count"][0]) > 0
+        # CMS estimate plane is an upper bound of the table sum
+        assert float(top["bytes_est"][0]) >= float(top["bytes"][0]) - 1e-3
+
+    def test_oversized_and_odd_batches_chunked(self):
+        # update() must accept any batch size, not just config.batch_size
+        config = HeavyHitterConfig(batch_size=512, width=1 << 12, capacity=64)
+        g = FlowGenerator(ZipfProfile(n_keys=50, alpha=1.3), seed=35)
+        big = g.batch(1337)  # > batch_size and not a multiple
+        whole = HeavyHitterModel(config)
+        whole.update(big)
+        oracle = topk_exact(big, ["src_addr", "dst_addr"], 3)
+        top = whole.top(3)
+        assert float(top["bytes"][0]) == float(oracle["bytes"][0])
+
+    def test_saturated_counters_stay_positive(self):
+        # bytes >= 2^31 (int32-negative bit patterns) must rank first, not last
+        from flow_pipeline_tpu.schema.message import FlowMessage
+
+        msgs = [FlowMessage(bytes=3_000_000_000, packets=1,
+                            src_addr=b"\x01" * 16, dst_addr=b"\x02" * 16)]
+        msgs += [FlowMessage(bytes=100, packets=1,
+                             src_addr=bytes([i]) * 16, dst_addr=b"\x09" * 16)
+                 for i in range(3, 20)]
+        batch = FlowBatch.from_messages(msgs)
+        model = HeavyHitterModel(
+            HeavyHitterConfig(batch_size=64, width=1 << 10, capacity=32)
+        )
+        model.update(batch)
+        top = model.top(1)
+        assert float(top["bytes"][0]) == 3_000_000_000.0
+
+    def test_reset_clears_state(self):
+        model = HeavyHitterModel(HeavyHitterConfig(batch_size=256, width=1 << 10, capacity=32))
+        g = FlowGenerator(ZipfProfile(n_keys=50), seed=34)
+        model.update(g.batch(256))
+        model.reset()
+        top = model.top(5)
+        assert not top["valid"].any()
+
+
+class TestDDoS:
+    def make_traffic(self, seed, attack_dst=None, attack_mult=50):
+        """Baseline mocker traffic; optionally one dst under attack in the
+        last sub-windows."""
+        g = FlowGenerator(MockerProfile(), seed=seed, t0=1_699_999_800, rate=200.0)
+        batches = [g.batch(2000) for _ in range(8)]  # 80s = 8 sub-windows
+        if attack_dst is not None:
+            # amplify packets toward one dst in the final 2 sub-windows
+            for b in batches[-2:]:
+                dst = b.columns["dst_addr"]
+                hit = (dst[:, 3] & 0xFF) == attack_dst
+                b.columns["packets"][hit] = b.columns["packets"][hit] * attack_mult
+        return batches
+
+    def run(self, batches, config=None):
+        det = DDoSDetector(config or DDoSConfig(batch_size=2048, n_buckets=1 << 10,
+                                                sub_window_seconds=10))
+        for b in batches:
+            det.update(b)
+        det.close_sub_window()
+        return det
+
+    def test_no_alert_on_steady_traffic(self):
+        det = self.run(self.make_traffic(seed=41))
+        assert det.alerts == []
+
+    def test_attack_detected(self):
+        det = self.run(self.make_traffic(seed=42, attack_dst=7))
+        assert len(det.alerts) >= 1
+        # alerted address ends with the attacked host byte
+        assert any(int(a["dst_addr"][3]) & 0xFF == 7 for a in det.alerts)
+
+    def test_alert_carries_scores(self):
+        det = self.run(self.make_traffic(seed=43, attack_dst=9))
+        a = det.alerts[0]
+        assert a["zscore"] >= 4.0
+        assert a["rate"] > a["baseline_quantile"]
+
+    def test_boundary_straddling_batch_split(self):
+        # one batch spanning two sub-windows must fold rates separately
+        g = FlowGenerator(MockerProfile(), seed=44, t0=1_699_999_800, rate=100.0)
+        det = DDoSDetector(DDoSConfig(batch_size=2048, n_buckets=256,
+                                      sub_window_seconds=10))
+        det.update(g.batch(1500))  # 15 seconds -> straddles one boundary
+        assert det.folds == 1  # first sub-window closed by the straddle
+        assert det.current_sub == 1_699_999_810
+
+    def test_padding_rows_never_touch_last_bucket(self):
+        # regression: -1 "drop" index used to wrap to bucket n_buckets-1
+        import jax.numpy as jnp
+        from flow_pipeline_tpu.models.ddos import ddos_accumulate, ddos_init
+        from flow_pipeline_tpu.ops.quantile import QuantileSketchSpec
+
+        config = DDoSConfig(batch_size=8, n_buckets=16)
+        state = ddos_init(config, QuantileSketchSpec())
+        state = state._replace(addrs=state.addrs.at[15].set(jnp.uint32(7)))
+        cols = {
+            "dst_addr": jnp.zeros((8, 4), jnp.int32),
+            "packets": jnp.ones(8, jnp.int32),
+        }
+        state = ddos_accumulate(state, cols, jnp.zeros(8, bool), config=config)
+        assert np.asarray(state.addrs)[15].tolist() == [7, 7, 7, 7]
+        assert float(jnp.sum(state.rates)) == 0.0

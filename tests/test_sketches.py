@@ -1,0 +1,202 @@
+"""Sketch op unit tests against exact numpy counters (SURVEY.md §4:
+"unit-test sketch kernels against exact numpy counters")."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.ops import (
+    QuantileSketchSpec,
+    cms_add,
+    cms_add_conservative,
+    cms_init,
+    cms_merge,
+    cms_query,
+    ewma_fold,
+    ewma_init,
+    rate_accumulate,
+    bucket_of,
+    topk_extract,
+    topk_init,
+    topk_merge,
+    zscores,
+)
+
+
+def exact_counts(keys, values):
+    agg = {}
+    for k, v in zip(keys, values):
+        agg[tuple(k)] = agg.get(tuple(k), 0) + v
+    return agg
+
+
+class TestCMS:
+    def make(self, rng, n=512, n_keys=40, depth=4, width=1 << 12):
+        keys = rng.integers(0, 2**32, size=(n_keys, 2), dtype=np.uint32)
+        idx = rng.integers(0, n_keys, n)
+        vals = rng.integers(1, 100, n)
+        # pre-aggregate (the contract: unique keys per call)
+        agg = {}
+        for i, v in zip(idx, vals):
+            agg[i] = agg.get(i, 0) + int(v)
+        uk = np.array(sorted(agg))
+        ukeys = keys[uk]
+        uvals = np.array([[agg[i]] for i in uk], dtype=np.int32)
+        return keys, ukeys, uvals, agg, uk
+
+    @pytest.mark.parametrize("add_fn", [cms_add, cms_add_conservative])
+    def test_upper_bound_and_accuracy(self, rng, add_fn):
+        keys, ukeys, uvals, agg, uk = self.make(rng)
+        sk = cms_init(1, 4, 1 << 12)
+        sk = add_fn(sk, jnp.asarray(ukeys), jnp.asarray(uvals),
+                    jnp.ones(len(ukeys), bool))
+        est = np.asarray(cms_query(sk, jnp.asarray(ukeys)))[:, 0]
+        true = np.array([agg[i] for i in uk], dtype=np.float64)
+        assert (est >= true - 1e-3).all()  # upper bound
+        # wide sketch, few keys -> estimates essentially exact
+        np.testing.assert_allclose(est, true, rtol=1e-5)
+
+    def test_conservative_tighter_than_linear(self, rng):
+        # tiny width forces collisions; CU must never be looser
+        keys, ukeys, uvals, agg, uk = self.make(rng, n_keys=300, width=128)
+        lin = cms_add(cms_init(1, 2, 128), jnp.asarray(ukeys),
+                      jnp.asarray(uvals), jnp.ones(len(ukeys), bool))
+        con = cms_add_conservative(cms_init(1, 2, 128), jnp.asarray(ukeys),
+                                   jnp.asarray(uvals), jnp.ones(len(ukeys), bool))
+        e_lin = np.asarray(cms_query(lin, jnp.asarray(ukeys)))[:, 0]
+        e_con = np.asarray(cms_query(con, jnp.asarray(ukeys)))[:, 0]
+        true = np.array([agg[i] for i in uk])
+        assert (e_con >= true - 1e-3).all()
+        assert (e_con <= e_lin + 1e-3).all()
+        assert e_con.sum() < e_lin.sum()  # strictly tighter somewhere
+
+    def test_merge_equals_combined_stream(self, rng):
+        keys, ukeys, uvals, agg, uk = self.make(rng)
+        half = len(ukeys) // 2
+        a = cms_add(cms_init(1, 4, 1 << 12), jnp.asarray(ukeys[:half]),
+                    jnp.asarray(uvals[:half]), jnp.ones(half, bool))
+        b = cms_add(cms_init(1, 4, 1 << 12), jnp.asarray(ukeys[half:]),
+                    jnp.asarray(uvals[half:]), jnp.ones(len(ukeys) - half, bool))
+        both = cms_add(cms_init(1, 4, 1 << 12), jnp.asarray(ukeys),
+                       jnp.asarray(uvals), jnp.ones(len(ukeys), bool))
+        np.testing.assert_allclose(
+            np.asarray(cms_merge(a, b)), np.asarray(both), rtol=1e-6
+        )
+
+    def test_invalid_rows_ignored(self, rng):
+        keys, ukeys, uvals, agg, uk = self.make(rng)
+        valid = np.zeros(len(ukeys), bool)
+        sk = cms_add(cms_init(1, 4, 1 << 12), jnp.asarray(ukeys),
+                     jnp.asarray(uvals), jnp.asarray(valid))
+        assert float(jnp.sum(sk)) == 0.0
+
+
+class TestTopKTable:
+    def test_exact_when_capacity_sufficient(self, rng):
+        n_keys = 50
+        keys = rng.integers(0, 2**31, size=(n_keys, 3), dtype=np.uint32)
+        vals = rng.integers(1, 10_000, size=(n_keys, 1)).astype(np.float32)
+        tk, tv = topk_init(64, 3, 1)
+        # feed in 5 shuffled chunks of 10
+        order = rng.permutation(n_keys)
+        for c in range(5):
+            idx = order[c * 10 : (c + 1) * 10]
+            tk, tv = topk_merge(tk, tv, jnp.asarray(keys[idx]),
+                                jnp.asarray(vals[idx]), jnp.ones(10, bool))
+        out_k, out_v, valid = topk_extract(tk, tv, 64)
+        out_k, out_v = np.asarray(out_k), np.asarray(out_v)
+        assert np.asarray(valid).sum() == n_keys
+        expect = vals[:, 0]
+        top_true = keys[np.argsort(-expect)][:10]
+        np.testing.assert_array_equal(out_k[:10], top_true)
+        assert (np.diff(out_v[: n_keys, 0]) <= 0).all()
+
+    def test_duplicate_keys_summed(self, rng):
+        key = np.array([[7, 8]], dtype=np.uint32)
+        tk, tv = topk_init(8, 2, 1)
+        for v in (5.0, 10.0, 2.5):
+            tk, tv = topk_merge(tk, tv, jnp.asarray(key),
+                                jnp.asarray([[v]], np.float32), jnp.ones(1, bool))
+        assert float(tv[0, 0]) == 17.5
+        assert np.asarray(tk[0]).tolist() == [7, 8]
+
+    def test_heavy_key_survives_eviction(self, rng):
+        # one dominant key fed early, then floods of one-off keys
+        tk, tv = topk_init(16, 1, 1)
+        tk, tv = topk_merge(tk, tv, jnp.asarray([[42]], np.uint32),
+                            jnp.asarray([[1e6]], np.float32), jnp.ones(1, bool))
+        for c in range(8):
+            noise_k = (rng.integers(100, 2**30, size=(32, 1))).astype(np.uint32)
+            noise_v = rng.integers(1, 50, size=(32, 1)).astype(np.float32)
+            tk, tv = topk_merge(tk, tv, jnp.asarray(noise_k),
+                                jnp.asarray(noise_v), jnp.ones(32, bool))
+        assert int(tk[0, 0]) == 42
+        assert float(tv[0, 0]) == 1e6
+
+    def test_empty_candidates_noop(self):
+        tk, tv = topk_init(8, 2, 1)
+        tk2, tv2 = topk_merge(tk, tv, jnp.zeros((4, 2), jnp.uint32),
+                              jnp.ones((4, 1), jnp.float32), jnp.zeros(4, bool))
+        np.testing.assert_array_equal(np.asarray(tk), np.asarray(tk2))
+
+
+class TestEWMA:
+    def test_fold_matches_scalar_recurrence(self, rng):
+        m = 8
+        state = ewma_init(m)
+        series = rng.integers(0, 100, size=(20, m)).astype(np.float32)
+        for t in range(20):
+            state = ewma_fold(state, jnp.asarray(series[t]), 0.3)
+        # scalar reference for bucket 0
+        mean = series[0, 0]
+        var = 0.0
+        for t in range(1, 20):
+            d = series[t, 0] - mean
+            mean = mean + 0.3 * d
+            var = 0.7 * (var + 0.3 * d * d)
+        assert abs(float(state[0][0]) - mean) < 1e-3
+        assert abs(float(state[1][0]) - var) < 1e-2
+
+    def test_zscore_flags_spike_only(self):
+        m = 4
+        state = ewma_init(m)
+        for _ in range(30):
+            state = ewma_fold(state, jnp.full(m, 100.0), 0.2)
+        rates = jnp.asarray([100.0, 100.0, 3000.0, 100.0])
+        z = np.asarray(zscores(state, rates, min_sigma=1.0))
+        assert z[2] > 100
+        assert abs(z[0]) < 1 and abs(z[3]) < 1
+
+    def test_rate_accumulate_scatter(self, rng):
+        keys = rng.integers(0, 2**32, size=(64, 4), dtype=np.uint32)
+        b = np.asarray(bucket_of(jnp.asarray(keys), 128))
+        vals = rng.integers(1, 10, 64).astype(np.int32)
+        rates = rate_accumulate(jnp.zeros(128, jnp.float32), jnp.asarray(b),
+                                jnp.asarray(vals), jnp.ones(64, bool))
+        expect = np.zeros(128)
+        np.add.at(expect, b, vals)
+        np.testing.assert_allclose(np.asarray(rates), expect)
+
+
+class TestQuantile:
+    def test_quantiles_within_relative_error(self, rng):
+        spec = QuantileSketchSpec(rel_err=0.01)
+        data = rng.lognormal(8, 2, size=5000)
+        hist = spec.init()
+        hist = spec.add(hist, jnp.asarray(data))
+        for q in (0.5, 0.9, 0.99):
+            est = spec.quantile(np.asarray(hist), q)
+            true = np.quantile(data, q)
+            assert abs(est - true) / true < 0.05
+
+    def test_merge_is_sum(self, rng):
+        spec = QuantileSketchSpec()
+        a = spec.add(spec.init(), jnp.asarray(rng.uniform(1, 1e6, 100)))
+        b = spec.add(spec.init(), jnp.asarray(rng.uniform(1, 1e6, 100)))
+        assert float(jnp.sum(a + b)) == 200.0
+
+    def test_zeros_bucketed_separately(self):
+        spec = QuantileSketchSpec()
+        hist = spec.add(spec.init(), jnp.asarray([0.0, 0.0, 5.0]))
+        assert float(hist[0]) == 2.0
+        assert spec.quantile(np.asarray(hist), 0.5) == 0.0
