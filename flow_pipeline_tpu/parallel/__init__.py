@@ -1,0 +1,35 @@
+"""Multi-chip scaling: device meshes, sharded sketch pipelines, ICI merges.
+
+The reference scales by Kafka partitions consumed by a sarama consumer group
+(2 partitions -> N inserter processes, ref: inserter/inserter.go:238-256,
+compose/docker-compose-postgres-mock.yml:28) and merges partial aggregates
+inside ClickHouse at merge time. The TPU-native equivalent:
+
+- flow batches shard across chips over a 1-D ``data`` mesh axis (the analogue
+  of Kafka partitions);
+- every chip runs the same sketch update on its shard (SPMD via shard_map);
+- sketch states are commutative monoids, so cross-chip merge is an XLA
+  collective over ICI: ``psum`` for count-min / rates / histograms, and an
+  ``all_gather`` + fold of top-K candidate tables — the analogue of
+  SummingMergeTree merge-time combination, at ICI bandwidth.
+
+Multi-host runs extend the same mesh over DCN: jax.distributed.initialize()
++ the same NamedSharding specs; nothing in the kernels changes.
+"""
+
+from .mesh import make_mesh, shard_batch_columns
+from .sharded import (
+    ShardedHeavyHitter,
+    ShardedWindowAggregator,
+    sharded_hh_update,
+    sharded_hh_merge,
+)
+
+__all__ = [
+    "make_mesh",
+    "shard_batch_columns",
+    "ShardedHeavyHitter",
+    "ShardedWindowAggregator",
+    "sharded_hh_update",
+    "sharded_hh_merge",
+]

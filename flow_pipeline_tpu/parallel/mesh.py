@@ -1,0 +1,39 @@
+"""Mesh construction and batch sharding helpers."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = DATA_AXIS) -> Mesh:
+    """1-D mesh over the first n devices (all by default).
+
+    Flow aggregation is pure data parallelism — sketches are replicated
+    monoid accumulators, not split tensors — so a single ``data`` axis is
+    the whole story; there is no tensor/pipeline dimension to carve
+    (SURVEY.md §2: TP/PP/EP are N/A for this workload).
+    """
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def shard_batch_columns(mesh: Mesh, cols: dict, valid, axis: str = DATA_AXIS):
+    """Place a global batch's columns row-sharded across the mesh.
+
+    Rows must be divisible by the mesh size (pad the batch to
+    n_devices * per_chip_batch first). On multi-host, replace device_put
+    with jax.make_array_from_process_local_data with the same sharding.
+    """
+    row_sharding = NamedSharding(mesh, P(axis))
+    out = {k: jax.device_put(v, row_sharding) for k, v in cols.items()}
+    return out, jax.device_put(valid, row_sharding)

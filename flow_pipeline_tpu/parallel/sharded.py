@@ -1,0 +1,212 @@
+"""Sharded sketch pipelines: shard_map update + collective merges.
+
+Per-chip sketch state is stacked on a leading device axis ([n_dev, ...],
+sharded on axis 0), batches are row-sharded, and the hot update loop runs
+with ZERO cross-chip communication — collectives happen only at window
+close:
+
+    cms / rates / histograms : psum over ICI (exact: monoid merge)
+    top-K candidate tables   : all_gather + static fold of topk_merge
+
+This is the design SURVEY.md §5 calls for: "shard the stream across chips,
+per-chip count-min/space-saving sketches, psum-style merge across ICI —
+sketches are commutative monoids, so merge == allreduce".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..models import heavy_hitter as hh
+from ..models.window_agg import WindowAggConfig, WindowAggregator
+from ..ops import topk as topk_ops
+from ..schema.batch import FlowBatch
+from .mesh import DATA_AXIS, make_mesh, shard_batch_columns
+
+
+# ---------------------------------------------------------------------------
+# Heavy hitter, sharded
+# ---------------------------------------------------------------------------
+
+
+def stack_state(state: hh.HHState, n_dev: int) -> hh.HHState:
+    """Replicate a fresh single-chip state onto a leading device axis."""
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (n_dev,) + x.shape), state
+    )
+
+
+def sharded_hh_update(mesh: Mesh, config: hh.HeavyHitterConfig):
+    """Build the jitted SPMD update: (stacked_state, global cols, valid) ->
+    stacked_state. No collectives — pure per-chip work."""
+
+    def per_chip(state, cols, valid):
+        state = jax.tree.map(lambda x: x[0], state)  # strip device axis
+        new = hh.hh_update.__wrapped__(state, cols, valid, config=config)
+        return jax.tree.map(lambda x: x[None], new)
+
+    state_spec = hh.HHState(
+        cms=P(DATA_AXIS), table_keys=P(DATA_AXIS), table_vals=P(DATA_AXIS)
+    )
+    fn = shard_map(
+        per_chip,
+        mesh=mesh,
+        in_specs=(state_spec, P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=state_spec,
+        check_vma=False,
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def sharded_hh_merge(mesh: Mesh, config: hh.HeavyHitterConfig):
+    """Build the jitted window-close merge: stacked per-chip states ->
+    one replicated merged state. psum for the CMS, all_gather + fold for
+    the candidate table."""
+    n_dev = mesh.devices.size
+
+    def per_chip(state):
+        cms = lax.psum(state.cms[0], DATA_AXIS)
+        tk = lax.all_gather(state.table_keys[0], DATA_AXIS)  # [n_dev, C, W]
+        tv = lax.all_gather(state.table_vals[0], DATA_AXIS)
+        mk, mv = tk[0], tv[0]
+        for d in range(1, n_dev):  # static fold: n_dev is compile-time
+            cand_valid = jnp.any(tk[d] != topk_ops.SENTINEL, axis=1)
+            mk, mv = topk_ops.topk_merge(mk, mv, tk[d], tv[d], cand_valid)
+        return hh.HHState(cms=cms, table_keys=mk, table_vals=mv)
+
+    state_spec = hh.HHState(
+        cms=P(DATA_AXIS), table_keys=P(DATA_AXIS), table_vals=P(DATA_AXIS)
+    )
+    out_spec = hh.HHState(cms=P(), table_keys=P(), table_vals=P())
+    fn = shard_map(
+        per_chip, mesh=mesh, in_specs=(state_spec,), out_specs=out_spec,
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+class ShardedHeavyHitter:
+    """Multi-chip heavy-hitter model.
+
+    Same surface as models.HeavyHitterModel, but update() consumes a global
+    batch sharded over the mesh and top() runs the ICI merge first.
+    """
+
+    def __init__(self, config: hh.HeavyHitterConfig, mesh: Mesh | None = None):
+        self.config = config
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_dev = self.mesh.devices.size
+        self._update = sharded_hh_update(self.mesh, config)
+        self._merge = sharded_hh_merge(self.mesh, config)
+        self.state = stack_state(hh.hh_init(config), self.n_dev)
+        # stacked state starts replicated; reshard onto the device axis
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        self.state = jax.tree.map(
+            lambda x: jax.device_put(x, sharding), self.state
+        )
+
+    @property
+    def global_batch(self) -> int:
+        return self.config.batch_size * self.n_dev
+
+    def update(self, batch: FlowBatch) -> None:
+        gb = self.global_batch
+        for start in range(0, len(batch), gb):
+            padded, mask = batch.slice(start, start + gb).pad_to(gb)
+            cols = padded.device_columns(
+                [*self.config.key_cols, *self.config.value_cols]
+            )
+            cols, valid = shard_batch_columns(self.mesh, cols, mask)
+            self.state = self._update(self.state, cols, valid)
+
+    def merged_state(self) -> hh.HHState:
+        return self._merge(self.state)
+
+    def top(self, k: int | None = None) -> dict[str, np.ndarray]:
+        merged = self.merged_state()
+        single = hh.HeavyHitterModel.__new__(hh.HeavyHitterModel)
+        single.config = self.config
+        single.state = merged
+        return hh.HeavyHitterModel.top(single, k)
+
+    def reset(self) -> None:
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        self.state = jax.tree.map(
+            lambda x: jax.device_put(x, sharding),
+            stack_state(hh.hh_init(self.config), self.n_dev),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Exact window aggregation, sharded
+# ---------------------------------------------------------------------------
+
+
+class ShardedWindowAggregator(WindowAggregator):
+    """Exact windowed aggregation over a mesh.
+
+    The device step runs per-chip sort_groupby under shard_map and returns
+    stacked per-chip partials; the host merge (which already combines
+    arbitrary partial aggregates into per-window dicts) treats the extra
+    device axis as more partial rows. Exactness is unaffected — partial-sum
+    merge is associative, the same property SummingMergeTree leans on.
+    """
+
+    def __init__(self, config: WindowAggConfig = WindowAggConfig(),
+                 mesh: Mesh | None = None):
+        super().__init__(config)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_dev = self.mesh.devices.size
+        base = self._update  # single-chip jitted step
+
+        def per_chip(cols, valid):
+            keys, sums, counts, n = base.__wrapped__(cols, valid)
+            return keys[None], sums[None], counts[None], n[None]
+
+        self._sharded = jax.jit(
+            shard_map(
+                per_chip,
+                mesh=self.mesh,
+                in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                check_vma=False,
+            )
+        )
+
+    @property
+    def global_batch(self) -> int:
+        return self.config.batch_size * self.n_dev
+
+    def update(self, batch: FlowBatch) -> None:
+        if len(batch) == 0:
+            return
+        gb = self.global_batch
+        for start in range(0, len(batch), gb):
+            self._update_sharded_chunk(batch.slice(start, start + gb))
+        wm = int(batch.columns["time_received"].max())
+        if wm > self.watermark:
+            self.watermark = wm
+
+    def _update_sharded_chunk(self, batch: FlowBatch) -> None:
+        padded, mask = batch.pad_to(self.global_batch)
+        cols = padded.device_columns(
+            ["time_received", *self.config.key_cols, *self.config.value_cols]
+        )
+        cols, valid = shard_batch_columns(self.mesh, cols, mask)
+        keys, sums, counts, ns = self._sharded(cols, valid)
+        keys = np.asarray(keys)
+        plane_sums = np.asarray(sums)
+        counts_np = np.asarray(counts)
+        ns = np.asarray(ns)
+        for d in range(self.n_dev):
+            self._merge_partials(
+                keys[d], plane_sums[d], counts_np[d], int(ns[d])
+            )
