@@ -1,0 +1,25 @@
+# flow_pipeline_tpu build entry points.
+#
+# The reference drives protoc through make (ref: Makefile:1-4); here make
+# additionally builds the native host-path library and runs the suite.
+
+.PHONY: all native test bench proto clean
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+# Regenerate canonical protobuf bindings (optional; the framework ships its
+# own dependency-free codec — this is for interop consumers who want _pb2).
+proto:
+	protoc -Iflow_pipeline_tpu/schema --python_out=flow_pipeline_tpu/schema flow.proto
+
+clean:
+	$(MAKE) -C native clean

@@ -1,0 +1,409 @@
+"""Command-line entry points with the reference's dotted-flag surface.
+
+Subcommands (``python -m flow_pipeline_tpu.cli <cmd> [-flags...]``):
+
+- ``mocker``     synthetic flow producer (ref: mocker/mocker.go) — to a
+                 frames file, or a real Kafka broker when a client exists.
+- ``processor``  the TPU aggregation worker (the "new service" slot in the
+                 reference architecture, ref: README.md:26-47) with
+                 ``-processor.backend=tpu|cpu`` (BASELINE.json flag parity).
+- ``inserter``   raw-row sink service (ref: inserter/inserter.go): consumes
+                 flows and lands them in SQLite/Postgres unaggregated.
+- ``pipeline``   single-process end-to-end demo: mocker -> in-process bus ->
+                 processor -> sinks + /metrics, no external services.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from .obs import MetricsServer, get_logger, set_level
+from .utils.flags import FlagSet
+
+log = get_logger("cli")
+
+
+def _common_flags(fs: FlagSet) -> FlagSet:
+    fs.string("loglevel", "info", "Log level")
+    fs.string("kafka.topic", "flows", "Bus topic to use")
+    fs.string("kafka.brokers", "127.0.0.1:9092,[::1]:9092",
+              "Kafka brokers list separated by commas")
+    fs.boolean("proto.fixedlen", False, "Enable fixed length protobuf")
+    return fs
+
+
+def _gen_flags(fs: FlagSet) -> FlagSet:
+    fs.integer("produce.count", 100_000, "Flows to generate (0 = endless)")
+    fs.number("produce.rate", 100_000.0, "Modeled flows/sec for timestamps")
+    fs.integer("produce.seed", 0, "Generator seed")
+    fs.string("produce.profile", "mocker", "mocker | zipf")
+    fs.integer("zipf.keys", 10_000, "Distinct keys in zipf mode")
+    fs.number("zipf.alpha", 1.2, "Zipf exponent")
+    return fs
+
+
+def _make_generator(vals):
+    from .gen import FlowGenerator, MockerProfile, ZipfProfile
+
+    profile = (
+        ZipfProfile(n_keys=vals["zipf.keys"], alpha=vals["zipf.alpha"])
+        if vals["produce.profile"] == "zipf"
+        else MockerProfile()
+    )
+    return FlowGenerator(profile, seed=vals["produce.seed"],
+                         rate=vals["produce.rate"])
+
+
+def mocker_main(argv=None) -> int:
+    fs = _common_flags(FlagSet("mocker"))
+    _gen_flags(fs)
+    fs.string("out", "", "Write length-prefixed frames to this file instead "
+                         "of Kafka")
+    fs.integer("produce.batch", 4096, "Frames per write")
+    vals = fs.parse(argv if argv is not None else sys.argv[2:])
+    set_level(vals["loglevel"])
+    gen = _make_generator(vals)
+    total = vals["produce.count"]
+    from .schema import wire
+
+    if vals["out"]:
+        written = 0
+        with open(vals["out"], "wb") as f:
+            while total == 0 or written < total:
+                n = min(vals["produce.batch"], total - written) if total else vals["produce.batch"]
+                batch = gen.batch(n)
+                for m in batch.to_messages():
+                    f.write(wire.encode_frame(m))
+                written += n
+                if total == 0 and written % (vals["produce.batch"] * 64) == 0:
+                    log.info("produced %d frames", written)
+        log.info("wrote %d frames to %s", written, vals["out"])
+        return 0
+    from .transport import kafka as tkafka
+
+    if not tkafka.available():
+        log.error("no Kafka client in this environment; use -out FILE "
+                  "or the in-process `pipeline` command")
+        return 2
+    producer = tkafka.KafkaProducerAdapter(
+        vals["kafka.brokers"], vals["kafka.topic"], vals["proto.fixedlen"]
+    )
+    sent = 0
+    while total == 0 or sent < total:
+        n = min(4096, total - sent) if total else 4096
+        for m in gen.batch(n).to_messages():
+            producer.send(m)
+        sent += n
+    producer.flush()
+    log.info("produced %d flows to %s", sent, vals["kafka.topic"])
+    return 0
+
+
+def _build_models(vals):
+    from .engine import WindowedHeavyHitter
+    from .models import (
+        DDoSConfig,
+        DDoSDetector,
+        HeavyHitterConfig,
+        WindowAggConfig,
+        WindowAggregator,
+    )
+
+    batch = vals["processor.batch"]
+    models = {}
+    if vals["model.flows5m"]:
+        models["flows_5m"] = WindowAggregator(
+            WindowAggConfig(batch_size=batch,
+                            allowed_lateness=vals["window.lateness"])
+        )
+    if vals["model.talkers"]:
+        models["top_talkers"] = WindowedHeavyHitter(
+            HeavyHitterConfig(
+                key_cols=("src_addr", "dst_addr", "src_port", "dst_port",
+                          "proto"),
+                batch_size=batch,
+                width=vals["sketch.width"],
+                capacity=vals["sketch.capacity"],
+            ),
+            k=vals["sketch.topk"],
+        )
+    if vals["model.ddos"]:
+        models["ddos_alerts"] = DDoSDetector(DDoSConfig(batch_size=batch))
+    return models
+
+
+def _processor_flags(fs: FlagSet) -> FlagSet:
+    fs.string("processor.backend", "tpu", "tpu | cpu (jax platform hint)")
+    fs.integer("processor.batch", 8192, "Device batch rows")
+    fs.boolean("model.flows5m", True, "Exact 5m rollup model")
+    fs.boolean("model.talkers", True, "5-tuple top-K talkers model")
+    fs.boolean("model.ddos", True, "DDoS spike detector")
+    fs.integer("sketch.width", 1 << 16, "Count-min width")
+    fs.integer("sketch.capacity", 1024, "Top-K table capacity")
+    fs.integer("sketch.topk", 100, "Rows emitted per window")
+    fs.integer("window.lateness", 0, "Allowed lateness seconds")
+    fs.string("checkpoint.path", "", "Snapshot directory")
+    fs.integer("flush.count", 50, "Batches between snapshots")
+    fs.string("metrics.addr", "127.0.0.1:8081", "host:port for /metrics "
+                                                "(empty disables)")
+    fs.string("sink", "stdout", "stdout | sqlite:PATH | postgres:DSN | "
+                                "clickhouse:URL (comma separated)")
+    fs.string("in", "", "Read frames from file instead of Kafka")
+    return fs
+
+
+def _apply_backend(backend: str) -> None:
+    if backend == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _make_sinks(spec: str):
+    from .sink import ClickHouseSink, PostgresSink, SQLiteSink, StdoutSink
+
+    sinks = []
+    for part in filter(None, spec.split(",")):
+        kind, _, arg = part.partition(":")
+        if kind == "stdout":
+            sinks.append(StdoutSink())
+        elif kind == "sqlite":
+            sinks.append(SQLiteSink(arg or ":memory:"))
+        elif kind == "postgres":
+            sinks.append(PostgresSink(arg))
+        elif kind == "clickhouse":
+            sinks.append(ClickHouseSink(arg or "http://localhost:8123"))
+        else:
+            raise ValueError(f"unknown sink {part!r}")
+    return sinks
+
+
+def _load_frames_bus(path: str, topic: str, partitions: int = 2):
+    """Preload a frames file onto an in-process bus (the -in path)."""
+    from .schema import wire
+    from .transport import InProcessBus
+
+    bus = InProcessBus()
+    bus.create_topic(topic, partitions)
+    data = open(path, "rb").read()
+    for msg in wire.decode_frames(data):
+        bus.produce(topic, wire.encode_frame(msg))
+    return bus
+
+
+def processor_main(argv=None) -> int:
+    fs = _processor_flags(_common_flags(FlagSet("processor")))
+    vals = fs.parse(argv if argv is not None else sys.argv[2:])
+    set_level(vals["loglevel"])
+    _apply_backend(vals["processor.backend"])
+    from .engine import StreamWorker, WorkerConfig
+    from .transport import Consumer
+
+    if vals["in"]:
+        bus = _load_frames_bus(vals["in"], vals["kafka.topic"])
+        consumer = Consumer(bus, vals["kafka.topic"], fixedlen=True)
+        stop_when_idle = True
+    else:
+        from .transport import kafka as tkafka
+
+        if not tkafka.available():
+            log.error("no Kafka client; use -in FILE or `pipeline`")
+            return 2
+        consumer = tkafka.KafkaConsumerAdapter(
+            vals["kafka.brokers"], vals["kafka.topic"],
+            fixedlen=vals["proto.fixedlen"],
+        )
+        stop_when_idle = False
+    server = None
+    if vals["metrics.addr"]:
+        host, _, port = vals["metrics.addr"].partition(":")
+        server = MetricsServer(int(port or 8081), host=host or "127.0.0.1").start()
+        log.info("metrics on http://%s:%d/metrics", host, server.port)
+    worker = StreamWorker(
+        consumer,
+        _build_models(vals),
+        _make_sinks(vals["sink"]),
+        WorkerConfig(
+            poll_max=vals["processor.batch"],
+            snapshot_every=vals["flush.count"],
+            checkpoint_path=vals["checkpoint.path"] or None,
+        ),
+    )
+    if vals["checkpoint.path"]:
+        if worker.restore():
+            log.info("restored checkpoint from %s", vals["checkpoint.path"])
+    try:
+        worker.run(stop_when_idle=stop_when_idle)
+    except KeyboardInterrupt:
+        log.info("interrupt: draining")
+        worker.finalize()
+    finally:
+        if server:
+            server.stop()
+    log.info("processed %d flows in %d batches",
+             worker.flows_seen, worker.batches_seen)
+    return 0
+
+
+def inserter_main(argv=None) -> int:
+    """Raw-row sink service (reference inserter parity, ref:
+    inserter/inserter.go): flows land unaggregated in the `flows` table."""
+    fs = _common_flags(FlagSet("inserter"))
+    fs.string("in", "", "Read frames from file instead of Kafka")
+    fs.string("postgres.dsn", "", "Postgres DSN (enables PostgresSink)")
+    fs.string("postgres.pass", "", "Postgres password", )
+    fs.string("sqlite", "", "SQLite path (default sink)")
+    fs.integer("flush.count", 100, "Rows per flush")
+    vals = fs.parse(argv if argv is not None else sys.argv[2:])
+    set_level(vals["loglevel"])
+    import os
+
+    from .schema.batch import FlowBatch
+    from .sink import PostgresSink, SQLiteSink
+    from .sink.base import rows_to_records  # noqa: F401 (re-export for sinks)
+
+    if vals["postgres.dsn"]:
+        dsn = vals["postgres.dsn"]
+        password = vals["postgres.pass"] or os.environ.get("POSTGRES_PASSWORD")
+        if password and "password" not in dsn:
+            dsn += f" password={password}"
+        sink = PostgresSink(dsn)
+    else:
+        sink = SQLiteSink(vals["sqlite"] or ":memory:")
+    if not vals["in"]:
+        log.error("this environment has no Kafka client; use -in FILE")
+        return 2
+    bus = _load_frames_bus(vals["in"], vals["kafka.topic"])
+    from .transport import Consumer
+
+    consumer = Consumer(bus, vals["kafka.topic"], group="postgres-inserter",
+                        fixedlen=True)
+    total = 0
+    while True:
+        batch = consumer.poll(vals["flush.count"])
+        if batch is None:
+            break
+        rows = _raw_rows(batch)
+        sink.write("flows", rows)
+        consumer.commit(batch.partition, batch.last_offset + 1)
+        total += len(batch)
+    log.info("inserted %d raw rows", total)
+    return 0
+
+
+def _raw_rows(batch) -> list[dict]:
+    from .sink.base import _addr_str
+
+    c = batch.columns
+    return [
+        {
+            "time_flow": int(c["time_received"][i]),
+            "type": int(c["type"][i]),
+            "sampling_rate": int(c["sampling_rate"][i]),
+            "src_as": int(c["src_as"][i]),
+            "dst_as": int(c["dst_as"][i]),
+            "src_ip": _addr_str(c["src_addr"][i]),
+            "dst_ip": _addr_str(c["dst_addr"][i]),
+            "bytes": int(c["bytes"][i]),
+            "packets": int(c["packets"][i]),
+            "etype": int(c["etype"][i]),
+            "proto": int(c["proto"][i]),
+            "src_port": int(c["src_port"][i]),
+            "dst_port": int(c["dst_port"][i]),
+        }
+        for i in range(len(batch))
+    ]
+
+
+def pipeline_main(argv=None) -> int:
+    """In-process end-to-end demo (the compose *-mock topology equivalent)."""
+    fs = _processor_flags(_gen_flags(_common_flags(FlagSet("pipeline"))))
+    fs.integer("bus.partitions", 2, "Bus partitions (reference default 2)")
+    vals = fs.parse(argv if argv is not None else sys.argv[2:])
+    set_level(vals["loglevel"])
+    _apply_backend(vals["processor.backend"])
+    from .engine import StreamWorker, WorkerConfig
+    from .schema import wire
+    from .transport import Consumer, InProcessBus
+
+    bus = InProcessBus()
+    bus.create_topic(vals["kafka.topic"], vals["bus.partitions"])
+    gen = _make_generator(vals)
+    t0 = time.perf_counter()
+    produced = 0
+    while produced < vals["produce.count"]:
+        n = min(8192, vals["produce.count"] - produced)
+        for m in gen.batch(n).to_messages():
+            bus.produce(vals["kafka.topic"], wire.encode_frame(m))
+        produced += n
+    log.info("produced %d flows in %.2fs", produced, time.perf_counter() - t0)
+
+    consumer = Consumer(bus, vals["kafka.topic"], fixedlen=True)
+    server = None
+    if vals["metrics.addr"]:
+        host, _, port = vals["metrics.addr"].partition(":")
+        server = MetricsServer(int(port or 8081), host=host or "127.0.0.1").start()
+        log.info("metrics on http://%s:%s/metrics", host or "127.0.0.1",
+                 server.port)
+    worker = StreamWorker(
+        consumer,
+        _build_models(vals),
+        _make_sinks(vals["sink"]),
+        WorkerConfig(poll_max=vals["processor.batch"],
+                     snapshot_every=vals["flush.count"],
+                     checkpoint_path=vals["checkpoint.path"] or None),
+    )
+    t0 = time.perf_counter()
+    worker.run(stop_when_idle=True)
+    dt = time.perf_counter() - t0
+    log.info("aggregated %d flows in %.2fs (%.0f flows/sec)",
+             worker.flows_seen, dt, worker.flows_seen / max(dt, 1e-9))
+    if server:
+        server.stop()
+    return 0
+
+
+_COMMANDS = {
+    "mocker": mocker_main,
+    "processor": processor_main,
+    "inserter": inserter_main,
+    "pipeline": pipeline_main,
+}
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv or argv[0] in ("-h", "-help", "--help"):
+        print("usage: flow_pipeline_tpu.cli <mocker|processor|inserter|"
+              "pipeline> [-flags]\nRun '<cmd> -help' for flags.")
+        return 0 if argv else 2
+    cmd = _COMMANDS.get(argv[0])
+    if cmd is None:
+        print(f"unknown command {argv[0]!r}", file=sys.stderr)
+        return 2
+    try:
+        return cmd(argv[1:]) or 0
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+def mocker_entry() -> None:  # console-script shims
+    sys.exit(main(["mocker"] + sys.argv[1:]))
+
+
+def processor_entry() -> None:
+    sys.exit(main(["processor"] + sys.argv[1:]))
+
+
+def inserter_entry() -> None:
+    sys.exit(main(["inserter"] + sys.argv[1:]))
+
+
+def pipeline_entry() -> None:
+    sys.exit(main(["pipeline"] + sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,28 @@
+"""Streaming engine: the "processor" stage.
+
+The reference's architecture reserves a processor slot between Kafka and
+the database — "a _processor_ that would enrich the data by consuming from
+Kafka and re-injecting the data ... or directly into the database"
+(ref: README.md:44-47). This package is that service, TPU-backed:
+
+    consumer.poll -> columnar decode -> model.update (device sketches)
+      -> window close -> rows -> sinks -> snapshot -> offset commit
+
+Delivery contract: offsets commit only after the covering flush/snapshot
+(at-least-once; the reference inserter loses up to flush.count-1 rows by
+marking first, ref: inserter/inserter.go:188). Snapshot/restore covers the
+open-window sketch state so a restarted worker resumes without double
+counting (SURVEY.md §5 checkpoint/resume).
+"""
+
+from .worker import StreamWorker, WorkerConfig
+from .windowed import WindowedHeavyHitter
+from .checkpoint import save_checkpoint, load_checkpoint
+
+__all__ = [
+    "StreamWorker",
+    "WorkerConfig",
+    "WindowedHeavyHitter",
+    "save_checkpoint",
+    "load_checkpoint",
+]

@@ -1,0 +1,116 @@
+"""Sketch-state snapshot / restore.
+
+Kafka offsets are the reference's only checkpoint ("it fetches from the
+current offset", ref: README.md:115); a sketch worker additionally needs
+the open-window device state so a restart resumes without double counting
+(SURVEY.md §5). A checkpoint is a directory with:
+
+- ``arrays.npz``   every device/host array leaf (numpy, compressed)
+- ``meta.json``    consumer positions, window dicts, scalars, tree layout
+
+Writes are atomic (tmp dir + rename) so a crash mid-write leaves the
+previous checkpoint intact. Only numpy/json are used — no pickle, so a
+checkpoint directory is safe to share between trust domains.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Any
+
+import numpy as np
+
+
+def _encode(obj: Any, arrays: dict[str, np.ndarray], path: str) -> Any:
+    """Recursively split a state object into JSON-able structure + arrays."""
+    if isinstance(obj, dict):
+        return {
+            "__kind__": "dict",
+            "items": [
+                [_encode(k, arrays, f"{path}.k{i}"),
+                 _encode(v, arrays, f"{path}.v{i}")]
+                for i, (k, v) in enumerate(obj.items())
+            ],
+        }
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # NamedTuple
+        return {
+            "__kind__": "namedtuple",
+            "name": type(obj).__name__,
+            "fields": {
+                f: _encode(getattr(obj, f), arrays, f"{path}.{f}")
+                for f in obj._fields
+            },
+        }
+    if isinstance(obj, (list, tuple)):
+        return {
+            "__kind__": "list" if isinstance(obj, list) else "tuple",
+            "items": [_encode(v, arrays, f"{path}.{i}") for i, v in enumerate(obj)],
+        }
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    # array-like (jax or numpy): materialize to host
+    arr = np.asarray(obj)
+    arrays[path] = arr
+    return {"__kind__": "array", "ref": path}
+
+
+def _decode(spec: Any, arrays) -> Any:
+    if isinstance(spec, dict) and "__kind__" in spec:
+        kind = spec["__kind__"]
+        if kind == "dict":
+            return {
+                _freeze(_decode(k, arrays)): _decode(v, arrays)
+                for k, v in spec["items"]
+            }
+        if kind == "namedtuple":
+            return {f: _decode(v, arrays) for f, v in spec["fields"].items()}
+        if kind in ("list", "tuple"):
+            items = [_decode(v, arrays) for v in spec["items"]]
+            return items if kind == "list" else tuple(items)
+        if kind == "array":
+            return arrays[spec["ref"]]
+        raise ValueError(f"unknown kind {kind}")
+    return spec
+
+
+def _freeze(key):
+    return tuple(key) if isinstance(key, list) else key
+
+
+def save_checkpoint(path: str, state: Any) -> None:
+    """Atomically write ``state`` (nested dicts/lists/NamedTuples/arrays)."""
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    arrays: dict[str, np.ndarray] = {}
+    meta = _encode(state, arrays, "r")
+    tmp = tempfile.mkdtemp(prefix=".ckpt-", dir=parent)
+    try:
+        np.savez_compressed(os.path.join(tmp, "arrays.npz"), **arrays)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        if os.path.isdir(path):
+            old = path + ".old"
+            # a crash between the renames below can leave a stale .old;
+            # clear it or every future snapshot fails with ENOTEMPTY
+            if os.path.isdir(old):
+                shutil.rmtree(old)
+            os.rename(path, old)
+            os.rename(tmp, path)
+            shutil.rmtree(old)
+        else:
+            os.rename(tmp, path)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def load_checkpoint(path: str) -> Any:
+    """Load a checkpoint. NamedTuples come back as field dicts — callers
+    rebuild their concrete state types (see StreamWorker.restore)."""
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    arrays = np.load(os.path.join(path, "arrays.npz"))
+    return _decode(meta, arrays)

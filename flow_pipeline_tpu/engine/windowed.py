@@ -1,0 +1,79 @@
+"""Window-driving wrapper for the heavy-hitter model.
+
+HeavyHitterModel aggregates an unbounded stream; this wrapper gives it the
+same tumbling-window lifecycle as the exact aggregator: at watermark close
+it extracts the window's top-K rows and resets the sketch — the streaming
+equivalent of flows_5m's per-timeslot grouping, for key spaces too large to
+aggregate exactly (the north-star 5-tuple configs, BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.heavy_hitter import HeavyHitterConfig, HeavyHitterModel
+from ..models.oracle import SECONDS_PER_SLOT
+from ..schema.batch import FlowBatch
+
+
+class WindowedHeavyHitter:
+    """Tumbling-window top-K: update(batch) per batch; flush() yields rows
+    for closed windows (one reset sketch per window)."""
+
+    def __init__(self, config: HeavyHitterConfig = HeavyHitterConfig(),
+                 window_seconds: int = SECONDS_PER_SLOT, k: int = 100,
+                 model_cls=HeavyHitterModel, **model_kw):
+        self.config = config
+        self.window_seconds = window_seconds
+        self.k = k
+        self.model = model_cls(config, **model_kw)
+        self.current_slot: int | None = None
+        self._pending: list[dict] = []
+        # Sketch windows cannot reopen (the sketch was reset at close), so
+        # rows older than the current slot are DROPPED and counted — unlike
+        # the exact aggregator, which emits late partials. Size
+        # window_seconds/upstream batching so lateness cannot occur, or
+        # monitor this counter.
+        self.late_flows_dropped = 0
+
+    def update(self, batch: FlowBatch) -> None:
+        if len(batch) == 0:
+            return
+        # split rows by window slot so each sketch covers exactly one window
+        slots = (
+            batch.columns["time_received"].astype(np.int64)
+            // self.window_seconds * self.window_seconds
+        )
+        for slot in np.unique(slots):
+            idx = np.flatnonzero(slots == slot)
+            part = FlowBatch(
+                {k: v[idx] for k, v in batch.columns.items()}, batch.partition
+            )
+            slot = int(slot)
+            if self.current_slot is None:
+                self.current_slot = slot
+            elif slot > self.current_slot:
+                self._close()
+                self.current_slot = slot
+            elif slot < self.current_slot:
+                # late rows for a closed (reset) window: drop, never
+                # misattribute them to the current window's timeslot
+                self.late_flows_dropped += len(part)
+                continue
+            self.model.update(part)
+
+    def _close(self) -> None:
+        top = self.model.top(self.k)
+        top["timeslot"] = np.full(
+            len(top["valid"]), self.current_slot, dtype=np.uint64
+        )
+        self._pending.append(top)
+        self.model.reset()
+
+    def flush(self, force: bool = False) -> list[dict]:
+        """Rows for closed windows (and the open one too, when force)."""
+        if force and self.current_slot is not None:
+            self._close()
+            self.current_slot = None
+        out, self._pending = self._pending, []
+        return out

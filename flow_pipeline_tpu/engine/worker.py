@@ -1,0 +1,217 @@
+"""StreamWorker: the TPU processor service loop.
+
+Wires consumer -> models -> sinks with at-least-once offset commits and
+periodic snapshots. One worker owns one consumer (one partition subset) and
+any number of aggregation models; scale-out is more workers on more
+partitions — the sarama consumer-group model (ref: inserter/inserter.go:
+238-256) — and/or a device mesh inside one worker (parallel/).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+from ..models.ddos import DDoSDetector
+from ..models.heavy_hitter import HHState
+from ..models.window_agg import WindowAggregator
+from ..obs import REGISTRY, get_logger
+from .checkpoint import load_checkpoint, save_checkpoint
+from .windowed import WindowedHeavyHitter
+
+log = get_logger("worker")
+
+
+@dataclass
+class WorkerConfig:
+    poll_max: int = 8192
+    snapshot_every: int = 50  # batches between snapshots (0 = never)
+    checkpoint_path: Optional[str] = None
+    idle_sleep: float = 0.05
+
+
+class StreamWorker:
+    """Drives models from a consumer; emits rows to sinks.
+
+    models: {"name": model} — models expose update(batch) and one of
+      flush(force)->rows-dict (WindowAggregator), flush(force)->list of
+      row-dicts (WindowedHeavyHitter), or close_sub_window/alerts
+      (DDoSDetector).
+    sinks: objects with write(table: str, rows) -> None.
+    """
+
+    def __init__(self, consumer, models: dict[str, Any],
+                 sinks: Sequence[Any] = (), config: WorkerConfig = WorkerConfig()):
+        self.consumer = consumer
+        self.models = models
+        self.sinks = list(sinks)
+        self.config = config
+        self.batches_seen = 0
+        self.flows_seen = 0
+        # offsets covered by state (committable after next snapshot/flush)
+        self._covered: dict[int, int] = {}
+        self.m_flows = REGISTRY.counter("flows_processed_total",
+                                        "flows decoded and aggregated")
+        self.m_batches = REGISTRY.counter("batches_processed_total",
+                                          "batches pulled off the bus")
+        self.m_rows = REGISTRY.counter("insert_count",
+                                       "rows flushed to sinks")
+        self.m_lag = REGISTRY.gauge("consumer_lag", "bus messages behind")
+        self.m_proc = REGISTRY.summary("flow_processing_time_us",
+                                       "per-batch processing time")
+
+    # ---- main loop --------------------------------------------------------
+
+    def run_once(self) -> bool:
+        """Poll one batch through the pipeline. Returns False when idle."""
+        batch = self.consumer.poll(self.config.poll_max)
+        if batch is None or len(batch) == 0:
+            return False
+        t0 = time.perf_counter()
+        for model in self.models.values():
+            model.update(batch)
+        self.batches_seen += 1
+        self.flows_seen += len(batch)
+        self.m_flows.inc(len(batch))
+        self.m_batches.inc()
+        self.m_proc.observe((time.perf_counter() - t0) * 1e6)
+        if batch.last_offset >= 0:
+            prev = self._covered.get(batch.partition, 0)
+            self._covered[batch.partition] = max(prev, batch.last_offset + 1)
+        self.flush_closed()
+        if (
+            self.config.snapshot_every
+            and self.batches_seen % self.config.snapshot_every == 0
+        ):
+            self.snapshot_and_commit()
+        return True
+
+    def run(self, max_batches: Optional[int] = None,
+            stop_when_idle: bool = False) -> None:
+        done = 0
+        while max_batches is None or done < max_batches:
+            if self.run_once():
+                done += 1
+            elif stop_when_idle:
+                break
+            else:
+                time.sleep(self.config.idle_sleep)
+        self.finalize()
+
+    # ---- flushing ---------------------------------------------------------
+
+    def flush_closed(self, force: bool = False) -> None:
+        """Emit rows for closed (or all, when force) windows to the sinks."""
+        for name, model in self.models.items():
+            if isinstance(model, WindowAggregator):
+                rows = model.flush(force)
+                if len(rows["timeslot"]):
+                    self._emit(f"{name}", rows, len(rows["timeslot"]))
+            elif isinstance(model, WindowedHeavyHitter):
+                for top in model.flush(force):
+                    n = int(top["valid"].sum())
+                    self._emit(f"{name}", top, n)
+            elif isinstance(model, DDoSDetector):
+                if force:
+                    model.close_sub_window()
+                if model.alerts:
+                    alerts, model.alerts = model.alerts, []
+                    self._emit(f"{name}", alerts, len(alerts))
+
+    def _emit(self, table: str, rows, n: int) -> None:
+        for sink in self.sinks:
+            sink.write(table, rows)
+        self.m_rows.inc(n)
+        log.info("flushed table=%s rows=%d", table, n)
+
+    def finalize(self) -> None:
+        """Drain everything (end of stream / shutdown)."""
+        self.flush_closed(force=True)
+        self.snapshot_and_commit()
+        if hasattr(self.consumer, "lag"):
+            self.m_lag.set(self.consumer.lag())
+
+    # ---- checkpoint / offsets --------------------------------------------
+
+    def snapshot_and_commit(self) -> None:
+        """Snapshot open state, then commit covered offsets. Order matters:
+        state must be durable before the bus forgets the input."""
+        if self.config.checkpoint_path:
+            save_checkpoint(self.config.checkpoint_path, self._state())
+        for partition, next_off in sorted(self._covered.items()):
+            self.consumer.commit(partition, next_off)
+        if hasattr(self.consumer, "lag"):
+            self.m_lag.set(self.consumer.lag())
+
+    def _state(self) -> dict:
+        models_state: dict[str, Any] = {}
+        for name, model in self.models.items():
+            if isinstance(model, WindowAggregator):
+                models_state[name] = {
+                    "kind": "window_agg",
+                    "windows": model.windows,
+                    "watermark": model.watermark,
+                }
+            elif isinstance(model, WindowedHeavyHitter):
+                models_state[name] = {
+                    "kind": "windowed_hh",
+                    "hh": model.model.state,
+                    "current_slot": model.current_slot,
+                }
+            elif isinstance(model, DDoSDetector):
+                models_state[name] = {
+                    "kind": "ddos",
+                    "state": model.state,
+                    "current_sub": model.current_sub,
+                    "folds": model.folds,
+                }
+        return {
+            "covered": {str(k): v for k, v in self._covered.items()},
+            "models": models_state,
+            "batches_seen": self.batches_seen,
+            "flows_seen": self.flows_seen,
+        }
+
+    def restore(self, path: Optional[str] = None) -> bool:
+        """Rehydrate from the checkpoint; returns False if none exists."""
+        import jax.numpy as jnp
+
+        path = path or self.config.checkpoint_path
+        if not path or not os.path.isdir(path):
+            return False
+        snap = load_checkpoint(path)
+        self._covered = {int(k): v for k, v in snap["covered"].items()}
+        self.batches_seen = snap["batches_seen"]
+        self.flows_seen = snap["flows_seen"]
+        for name, ms in snap["models"].items():
+            model = self.models[name]
+            if ms["kind"] == "window_agg":
+                model.windows = {
+                    int(slot): {k: v for k, v in store.items()}
+                    for slot, store in ms["windows"].items()
+                }
+                model.watermark = ms["watermark"]
+            elif ms["kind"] == "windowed_hh":
+                hh = ms["hh"]  # NamedTuple decoded as field dict
+                model.model.state = HHState(
+                    cms=jnp.asarray(hh["cms"]),
+                    table_keys=jnp.asarray(hh["table_keys"]),
+                    table_vals=jnp.asarray(hh["table_vals"]),
+                )
+                model.current_slot = ms["current_slot"]
+            elif ms["kind"] == "ddos":
+                st = ms["state"]
+                from ..models.ddos import DDoSState
+
+                model.state = DDoSState(
+                    **{k: jnp.asarray(v) for k, v in st.items()}
+                )
+                model.current_sub = ms["current_sub"]
+                model.folds = ms["folds"]
+        # resume reading from the covered offsets, not the poll position
+        for p, off in self._covered.items():
+            if hasattr(self.consumer, "positions"):
+                self.consumer.positions[p] = off
+        return True

@@ -97,7 +97,12 @@ def decode_stream(data: bytes, capacity_hint: int = 0):
 
 
 def encode_stream(batch, out_capacity: int = 0) -> bytes:
-    """Encode a FlowBatch to length-prefixed frames using the native library."""
+    """Encode a FlowBatch to length-prefixed frames using the native library.
+
+    Byte-identical to the pure-Python encoder except for all-zero addresses:
+    the columnar form cannot distinguish an absent address from ``::``, and
+    the native encoder omits such fields (proto3 decoders treat both the
+    same; the stream is smaller)."""
     lib = _load()
     if lib is None:
         raise RuntimeError("libflowdecode.so not built; run `make native`")

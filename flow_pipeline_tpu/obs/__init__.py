@@ -1,0 +1,25 @@
+"""Observability: metrics, /metrics endpoint, leveled logging.
+
+Mirrors the reference's Prometheus + logrus surface (ref:
+inserter/inserter.go:28-29,44-49,69-73 and the GoFlow metric inventory in
+SURVEY.md §2-C12) — with the two reference bugs fixed by construction:
+counters here are incremented where the work happens (the reference's
+``insert_count`` is registered but never .Inc()'d), and the worker's
+metrics port is meant to be scraped (the reference never adds :8081 to
+prometheus.yml).
+"""
+
+from .metrics import Counter, Gauge, Summary, MetricsRegistry, REGISTRY
+from .server import MetricsServer
+from .logging import get_logger, set_level
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Summary",
+    "MetricsRegistry",
+    "REGISTRY",
+    "MetricsServer",
+    "get_logger",
+    "set_level",
+]

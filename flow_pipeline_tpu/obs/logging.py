@@ -1,0 +1,35 @@
+"""Leveled logging with a -loglevel flag surface (the reference uses logrus
+with the same flag in both binaries, ref: mocker/mocker.go:15,29-30,
+inserter/inserter.go:26,201-202)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s level=%(levelname)s component=%(name)s %(message)s"
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    root = logging.getLogger("flowtpu")
+    root.addHandler(handler)
+    root.setLevel(logging.INFO)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(component: str) -> logging.Logger:
+    _configure()
+    return logging.getLogger(f"flowtpu.{component}")
+
+
+def set_level(level: str) -> None:
+    """Accepts logrus-style names: debug/info/warning/error."""
+    _configure()
+    logging.getLogger("flowtpu").setLevel(level.upper())

@@ -1,0 +1,126 @@
+"""Minimal Prometheus-compatible metrics: counters, gauges, summaries.
+
+Dependency-free (no prometheus_client in the image); renders the text
+exposition format v0.0.4. Metric names follow the reference's observed
+surface where a counterpart exists — e.g. ``insert_count``
+(ref: inserter/inserter.go:44-49) and the ``flow_summary_*_time_us``
+latency summaries GoFlow exposes (SURVEY.md §2-C12).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = list(self._values.items()) or [((), 0.0)]
+        for key, v in items:
+            lines.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return "\n".join(lines)
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = value
+
+    def render(self) -> str:
+        return super().render().replace(" counter", " gauge", 1)
+
+
+class Summary:
+    """Sliding-window summary with quantiles + running sum/count (the shape
+    GoFlow's *_time_us summaries take)."""
+
+    def __init__(self, name: str, help_: str = "", window: int = 1024):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._obs: deque[float] = deque(maxlen=window)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._obs.append(value)
+            self._sum += value
+            self._count += 1
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            if not self._obs:
+                return 0.0
+            data = sorted(self._obs)
+        idx = min(len(data) - 1, int(q * len(data)))
+        return data[idx]
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} summary"]
+        for q in (0.5, 0.9, 0.99):
+            lines.append(f'{self.name}{{quantile="{q}"}} {self.quantile(q)}')
+        with self._lock:
+            lines.append(f"{self.name}_sum {self._sum}")
+            lines.append(f"{self.name}_count {self._count}")
+        return "\n".join(lines)
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_make(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_make(name, lambda: Gauge(name, help_), Gauge)
+
+    def summary(self, name: str, help_: str = "", window: int = 1024) -> Summary:
+        return self._get_or_make(name, lambda: Summary(name, help_, window), Summary)
+
+    def _get_or_make(self, name, factory, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name} already registered as {type(m).__name__}")
+            return m
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "\n".join(m.render() for m in metrics) + "\n"
+
+
+REGISTRY = MetricsRegistry()

@@ -1,0 +1,34 @@
+"""Sinks: flushed aggregate rows -> storage/serving edges.
+
+The reference lands rows in Postgres (table ``flows``,
+ref: compose/postgres/create.sh:5-24) or ClickHouse (``flows_raw`` +
+``flows_5m``, ref: compose/clickhouse/create.sh:36-110) and lets Grafana
+query them. Here:
+
+- ``MemorySink`` / ``StdoutSink``: tests and demos.
+- ``SQLiteSink``: a real queryable database from the stdlib, with
+  reference-shaped tables — the zero-dependency stand-in for Postgres.
+- ``PostgresSink`` / ``ClickHouseSink``: gated on their drivers; emit the
+  same schemas so the reference's Grafana dashboards keep working.
+- ``ddl``: the schema DDL for all targets, as code.
+
+All sinks implement write(table, rows) and must tolerate repeated partial
+rows per (window, key): the aggregator emits SummingMergeTree-style
+partials for late data (see models.window_agg docstring).
+"""
+
+from .base import MemorySink, StdoutSink, rows_to_records
+from .sqlite import SQLiteSink
+from .postgres import PostgresSink
+from .clickhouse import ClickHouseSink
+from . import ddl
+
+__all__ = [
+    "MemorySink",
+    "StdoutSink",
+    "SQLiteSink",
+    "PostgresSink",
+    "ClickHouseSink",
+    "rows_to_records",
+    "ddl",
+]

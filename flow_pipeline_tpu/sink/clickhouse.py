@@ -1,0 +1,72 @@
+"""ClickHouse sink (gated on an HTTP endpoint).
+
+Writes pre-aggregated flows_5m rows straight into the SummingMergeTree
+table (ref: compose/clickhouse/create.sh:70-90) over the HTTP interface
+using JSONEachRow — no driver dependency, just stdlib urllib. The
+TPU engine replaces the Kafka-engine + MV chain, so only the final tables
+are needed; partial rows for the same (Date, Timeslot, key) are summed by
+the engine at merge time, which is exactly the late-data contract our
+aggregator emits.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from .base import rows_to_records
+
+
+class ClickHouseSink:
+    def __init__(self, url: str = "http://localhost:8123",
+                 database: str = "default", timeout: float = 5.0):
+        self.url = url.rstrip("/")
+        self.database = database
+        self.timeout = timeout
+
+    def _post(self, query: str, body: bytes = b"") -> None:
+        req = urllib.request.Request(
+            f"{self.url}/?database={self.database}&query="
+            + urllib.parse.quote(query),
+            data=body,
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            resp.read()
+
+    def ping(self) -> bool:
+        try:
+            req = urllib.request.Request(f"{self.url}/ping")
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read().strip() == b"Ok."
+        except (urllib.error.URLError, OSError):
+            return False
+
+    # flush-row keys -> ClickHouse column names (the tables use the
+    # reference's CamelCase columns, ref: compose/clickhouse/create.sh:70-90)
+    _FLOWS_5M_COLS = {
+        "timeslot": "Timeslot",
+        "src_as": "SrcAS",
+        "dst_as": "DstAS",
+        "etype": "EType",
+        "bytes": "Bytes",
+        "packets": "Packets",
+        "count": "Count",
+    }
+
+    def write(self, table: str, rows) -> None:
+        records = rows_to_records(rows)
+        if not records:
+            return
+        if table == "flows_5m":
+            records = [
+                {self._FLOWS_5M_COLS.get(k, k): v for k, v in r.items()}
+                for r in records
+            ]
+            for r in records:
+                r.setdefault("Date", int(r.get("Timeslot", 0)) // 86400)
+        body = "\n".join(json.dumps(r, default=str) for r in records).encode()
+        self._post(f"INSERT INTO {table} FORMAT JSONEachRow", body)

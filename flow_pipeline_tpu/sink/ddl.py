@@ -1,0 +1,144 @@
+"""Schema DDL as code, for every storage target.
+
+Shapes mirror the reference so its Grafana dashboards keep working:
+- Postgres ``flows`` raw table: 14 columns + id (ref: compose/postgres/create.sh:5-24)
+- ClickHouse ``flows_raw`` / ``flows_5m`` + materialized views
+  (ref: compose/clickhouse/create.sh:36-110)
+plus this framework's own aggregate tables (flows_5m rows arrive
+pre-aggregated from the TPU, so the ClickHouse MV chain is optional).
+"""
+
+POSTGRES_FLOWS = """
+CREATE TABLE IF NOT EXISTS flows (
+    id             BIGSERIAL PRIMARY KEY,
+    date_inserted  TIMESTAMP,
+    time_flow      TIMESTAMP,
+    type           INT,
+    sampling_rate  BIGINT,
+    src_as         BIGINT,
+    dst_as         BIGINT,
+    src_ip         INET,
+    dst_ip         INET,
+    bytes          BIGINT,
+    packets        BIGINT,
+    etype          INT,
+    proto          INT,
+    src_port       INT,
+    dst_port       INT
+);
+"""
+
+POSTGRES_FLOWS_5M = """
+CREATE TABLE IF NOT EXISTS flows_5m (
+    timeslot  BIGINT,
+    src_as    BIGINT,
+    dst_as    BIGINT,
+    etype     INT,
+    bytes     BIGINT,
+    packets   BIGINT,
+    count     BIGINT
+);
+"""
+
+POSTGRES_TOP_TALKERS = """
+CREATE TABLE IF NOT EXISTS top_talkers (
+    timeslot  BIGINT,
+    rank      INT,
+    src_addr  TEXT,
+    dst_addr  TEXT,
+    src_port  INT,
+    dst_port  INT,
+    proto     INT,
+    bytes     BIGINT,
+    packets   BIGINT,
+    count     BIGINT
+);
+"""
+
+POSTGRES_DDOS_ALERTS = """
+CREATE TABLE IF NOT EXISTS ddos_alerts (
+    sub_window         BIGINT,
+    bucket             INT,
+    dst_addr           TEXT,
+    rate               DOUBLE PRECISION,
+    zscore             DOUBLE PRECISION,
+    baseline_quantile  DOUBLE PRECISION
+);
+"""
+
+CLICKHOUSE_FLOWS_RAW = """
+CREATE TABLE IF NOT EXISTS flows_raw (
+    Date Date,
+    TimeReceived UInt64,
+    TimeFlowStart UInt64,
+    SequenceNum UInt32,
+    SamplingRate UInt64,
+    SrcAddr FixedString(16),
+    DstAddr FixedString(16),
+    SrcAS UInt32,
+    DstAS UInt32,
+    EType UInt32,
+    Proto UInt32,
+    SrcPort UInt32,
+    DstPort UInt32,
+    Bytes UInt64,
+    Packets UInt64
+) ENGINE = MergeTree()
+PARTITION BY Date
+ORDER BY TimeReceived;
+"""
+
+CLICKHOUSE_FLOWS_5M = """
+CREATE TABLE IF NOT EXISTS flows_5m (
+    Date Date,
+    Timeslot DateTime,
+    SrcAS UInt32,
+    DstAS UInt32,
+    EType UInt32,
+    Bytes UInt64,
+    Packets UInt64,
+    Count UInt64
+) ENGINE = SummingMergeTree()
+ORDER BY (Date, Timeslot, SrcAS, DstAS, EType);
+"""
+
+SQLITE_TABLES = {
+    "flows": """
+CREATE TABLE IF NOT EXISTS flows (
+    id            INTEGER PRIMARY KEY AUTOINCREMENT,
+    date_inserted TEXT DEFAULT CURRENT_TIMESTAMP,
+    time_flow     INTEGER,
+    type          INTEGER,
+    sampling_rate INTEGER,
+    src_as        INTEGER,
+    dst_as        INTEGER,
+    src_ip        TEXT,
+    dst_ip        TEXT,
+    bytes         INTEGER,
+    packets       INTEGER,
+    etype         INTEGER,
+    proto         INTEGER,
+    src_port      INTEGER,
+    dst_port      INTEGER
+);
+""",
+    "flows_5m": """
+CREATE TABLE IF NOT EXISTS flows_5m (
+    timeslot INTEGER, src_as INTEGER, dst_as INTEGER, etype INTEGER,
+    bytes INTEGER, packets INTEGER, count INTEGER
+);
+""",
+    "top_talkers": """
+CREATE TABLE IF NOT EXISTS top_talkers (
+    timeslot INTEGER, rank INTEGER, src_addr TEXT, dst_addr TEXT,
+    src_port INTEGER, dst_port INTEGER, proto INTEGER,
+    bytes INTEGER, packets INTEGER, count INTEGER
+);
+""",
+    "ddos_alerts": """
+CREATE TABLE IF NOT EXISTS ddos_alerts (
+    sub_window INTEGER, bucket INTEGER, dst_addr TEXT,
+    rate REAL, zscore REAL, baseline_quantile REAL
+);
+""",
+}

@@ -1,0 +1,81 @@
+"""SQLite sink: a real queryable store from the stdlib.
+
+Plays the Postgres role in zero-dependency deployments and tests; the table
+shapes mirror the reference's Postgres schema (see sink.ddl.SQLITE_TABLES).
+Known table names map to typed tables; unknown tables land in a generic
+key-value journal so new models don't need schema changes to be observable.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+
+from . import ddl
+from .base import rows_to_records
+
+# flush-table name -> sqlite table + column order
+_TABLE_COLUMNS = {
+    "flows_5m": ("flows_5m",
+                 ["timeslot", "src_as", "dst_as", "etype", "bytes", "packets",
+                  "count"]),
+    "top_talkers": ("top_talkers",
+                    ["timeslot", "rank", "src_addr", "dst_addr", "src_port",
+                     "dst_port", "proto", "bytes", "packets", "count"]),
+    "ddos_alerts": ("ddos_alerts",
+                    ["sub_window", "bucket", "dst_addr", "rate", "zscore",
+                     "baseline_quantile"]),
+    "flows": ("flows",
+              ["time_flow", "type", "sampling_rate", "src_as", "dst_as",
+               "src_ip", "dst_ip", "bytes", "packets", "etype", "proto",
+               "src_port", "dst_port"]),
+}
+
+
+class SQLiteSink:
+    def __init__(self, path: str = ":memory:"):
+        # one connection guarded by a lock: sinks may be called from the
+        # worker thread while tests query from the main thread
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            for stmt in ddl.SQLITE_TABLES.values():
+                self._conn.executescript(stmt)
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS journal "
+                "(table_name TEXT, record TEXT)"
+            )
+            self._conn.commit()
+
+    def write(self, table: str, rows) -> None:
+        records = rows_to_records(rows)
+        if not records:
+            return
+        with self._lock:
+            mapped = _TABLE_COLUMNS.get(table)
+            if mapped is None:
+                self._conn.executemany(
+                    "INSERT INTO journal (table_name, record) VALUES (?, ?)",
+                    [(table, json.dumps(r, default=str)) for r in records],
+                )
+            else:
+                name, cols = mapped
+                placeholders = ",".join("?" for _ in cols)
+                collist = ",".join(f'"{c}"' for c in cols)
+                if table == "top_talkers":
+                    for rank, r in enumerate(records):
+                        r.setdefault("rank", rank)
+                self._conn.executemany(
+                    f'INSERT INTO "{name}" ({collist}) VALUES ({placeholders})',
+                    [tuple(r.get(c) for c in cols) for r in records],
+                )
+            self._conn.commit()
+
+    def query(self, sql: str, params=()) -> list[tuple]:
+        with self._lock:
+            return list(self._conn.execute(sql, params))
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

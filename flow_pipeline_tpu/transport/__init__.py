@@ -1,0 +1,22 @@
+"""Transport: the durable bus between pipeline stages.
+
+The reference's backbone is a Kafka topic ``flows`` with 2 partitions
+consumed by consumer groups (ref: compose/docker-compose-postgres-mock.yml:26-28,
+inserter/inserter.go:238-256). This package keeps that contract:
+
+- ``InProcessBus``: a partitioned, offset-addressed, append-only log with
+  consumer-group commit tracking — Kafka semantics without the broker, used
+  for single-process deployments, tests, and fault-injection harnesses.
+- ``Producer`` / ``Consumer``: the stage-facing API. The consumer commits
+  offsets explicitly and only after downstream flush — fixing the
+  reference's mark-before-flush loss window (ref: inserter/inserter.go:188
+  marks each message before the batch reaches Postgres).
+- ``kafka``: optional adapters onto a real Kafka cluster (gated import;
+  the wire payloads are identical FlowMessage frames either way).
+"""
+
+from .bus import InProcessBus, BusMessage
+from .producer import Producer
+from .consumer import Consumer
+
+__all__ = ["InProcessBus", "BusMessage", "Producer", "Consumer"]

@@ -1,0 +1,99 @@
+"""In-process partitioned bus with Kafka semantics.
+
+Topics hold P append-only partition logs of opaque byte messages; consumers
+address messages by (partition, offset) and commit offsets per consumer
+group. Thread-safe: producers and consumers may run on different threads
+(the generator thread feeding the device thread is the standard layout).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+
+@dataclass(frozen=True)
+class BusMessage:
+    topic: str
+    partition: int
+    offset: int
+    value: bytes
+
+
+class InProcessBus:
+    """A broker-less Kafka: partitioned logs + group offset commits."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._topics: dict[str, list[list[bytes]]] = {}
+        self._commits: dict[tuple[str, str, int], int] = {}  # (group, topic, p) -> next offset
+        self._rr = itertools.count()
+
+    def create_topic(self, topic: str, partitions: int = 2) -> None:
+        """Idempotent; the reference's default is 2 partitions
+        (ref: compose/docker-compose-postgres-mock.yml:28)."""
+        with self._lock:
+            self._topics.setdefault(topic, [[] for _ in range(partitions)])
+
+    def partitions(self, topic: str) -> int:
+        with self._lock:
+            return len(self._topics[topic])
+
+    def produce(self, topic: str, value: bytes, partition: Optional[int] = None) -> BusMessage:
+        """Append one message. Without an explicit partition, round-robin —
+        the reference's keyless async produce does the same
+        (ref: mocker/mocker.go:103-106)."""
+        with self._lock:
+            if topic not in self._topics:
+                self.create_topic(topic)
+            parts = self._topics[topic]
+            p = next(self._rr) % len(parts) if partition is None else partition
+            log = parts[p]
+            off = len(log)
+            log.append(value)
+            return BusMessage(topic, p, off, value)
+
+    def produce_many(self, topic: str, values: Iterable[bytes],
+                     partition: Optional[int] = None) -> int:
+        n = 0
+        for v in values:
+            self.produce(topic, v, partition)
+            n += 1
+        return n
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_messages: int = 1024) -> list[BusMessage]:
+        with self._lock:
+            log = self._topics[topic][partition]
+            end = min(len(log), offset + max_messages)
+            return [
+                BusMessage(topic, partition, o, log[o]) for o in range(offset, end)
+            ]
+
+    def end_offset(self, topic: str, partition: int) -> int:
+        with self._lock:
+            return len(self._topics[topic][partition])
+
+    # ---- consumer-group offsets ------------------------------------------
+
+    def committed(self, group: str, topic: str, partition: int) -> int:
+        """Next offset to read for the group (0 if never committed)."""
+        with self._lock:
+            return self._commits.get((group, topic, partition), 0)
+
+    def commit(self, group: str, topic: str, partition: int, next_offset: int) -> None:
+        """Record that the group has durably processed offsets < next_offset.
+        Commits never move backwards (replay-safe)."""
+        with self._lock:
+            key = (group, topic, partition)
+            if next_offset > self._commits.get(key, 0):
+                self._commits[key] = next_offset
+
+    def lag(self, group: str, topic: str) -> int:
+        with self._lock:
+            return sum(
+                len(log) - self._commits.get((group, topic, p), 0)
+                for p, log in enumerate(self._topics[topic])
+            )

@@ -1,0 +1,122 @@
+"""Real-Kafka adapters (optional).
+
+The in-process bus covers tests and single-process runs; against a real
+cluster these adapters speak the identical FlowMessage frame contract on
+topic ``flows``, so GoFlow / the reference mocker / ClickHouse Kafka-engine
+tables interoperate directly. Imports are gated: the environment may not
+ship a Kafka client, in which case ``available()`` is False and construction
+raises a clear error (the framework's own components then use InProcessBus).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+_IMPORT_ERROR: Optional[str] = None
+try:  # pragma: no cover - depends on environment
+    from kafka import KafkaConsumer as _KC, KafkaProducer as _KP  # type: ignore
+except Exception as e:  # noqa: BLE001
+    _KC = _KP = None
+    _IMPORT_ERROR = f"kafka-python not importable: {e}"
+
+
+def available() -> bool:
+    return _KP is not None
+
+
+class KafkaProducerAdapter:
+    """Same surface as transport.Producer, against a real broker."""
+
+    def __init__(self, brokers: str, topic: str = "flows", fixedlen: bool = False):
+        if not available():
+            raise RuntimeError(
+                f"real Kafka transport unavailable ({_IMPORT_ERROR}); "
+                "use transport.InProcessBus"
+            )
+        from ..schema import wire
+
+        self._wire = wire
+        self._producer = _KP(bootstrap_servers=brokers.split(","))
+        self.topic = topic
+        self.fixedlen = fixedlen
+        self.produced = 0
+
+    def send(self, msg) -> None:
+        data = (
+            self._wire.encode_frame(msg)
+            if self.fixedlen
+            else self._wire.encode_message(msg)
+        )
+        self._producer.send(self.topic, data)
+        self.produced += 1
+
+    def flush(self) -> None:
+        self._producer.flush()
+
+
+class KafkaConsumerAdapter:
+    """Same surface as transport.Consumer.poll/commit, against a broker.
+
+    Uses manual commits (enable_auto_commit=False): offsets go to the broker
+    only when the worker calls commit() after its flush — the at-least-once
+    contract this framework fixes relative to the reference.
+    """
+
+    def __init__(self, brokers: str, topic: str = "flows",
+                 group: str = "tpu-processor", fixedlen: bool = False):
+        if not available():
+            raise RuntimeError(
+                f"real Kafka transport unavailable ({_IMPORT_ERROR}); "
+                "use transport.InProcessBus"
+            )
+        from collections import deque
+
+        from ..schema import wire
+        from ..schema.batch import FlowBatch
+
+        self._wire = wire
+        self._FlowBatch = FlowBatch
+        self.topic = topic
+        self.fixedlen = fixedlen
+        self._pending = deque()  # batches already fetched, not yet returned
+        self._consumer = _KC(
+            topic,
+            bootstrap_servers=brokers.split(","),
+            group_id=group,
+            enable_auto_commit=False,
+            auto_offset_reset="earliest",
+        )
+
+    def poll(self, max_messages: int = 8192):
+        """One per-partition batch per call. The broker poll may return
+        records for several partitions at once; every partition's records
+        are batched and queued — none are dropped (the client has already
+        advanced its fetch positions past them)."""
+        if self._pending:
+            return self._pending.popleft()
+        records = self._consumer.poll(timeout_ms=200, max_records=max_messages)
+        for tp, msgs in records.items():
+            if not msgs:
+                continue
+            if self.fixedlen:
+                batch = self._FlowBatch.from_wire(b"".join(m.value for m in msgs))
+            else:
+                batch = self._FlowBatch.from_messages(
+                    [self._wire.decode_message(m.value) for m in msgs]
+                )
+            batch.partition = tp.partition
+            batch.first_offset = msgs[0].offset
+            batch.last_offset = msgs[-1].offset
+            self._pending.append(batch)
+        return self._pending.popleft() if self._pending else None
+
+    def commit(self, partition: int, next_offset: int) -> None:
+        from kafka import TopicPartition  # type: ignore
+        from kafka.structs import OffsetAndMetadata  # type: ignore
+
+        tp = TopicPartition(self.topic, partition)
+        try:  # kafka-python >= 2.1: (offset, metadata, leader_epoch)
+            om = OffsetAndMetadata(next_offset, "", -1)
+        except TypeError:  # older: (offset, metadata)
+            om = OffsetAndMetadata(next_offset, "")
+        self._consumer.commit({tp: om})

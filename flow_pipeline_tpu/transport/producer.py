@@ -1,0 +1,39 @@
+"""Producer: FlowMessages -> framed bytes -> bus."""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from ..schema import wire
+from ..schema.message import FlowMessage
+from .bus import InProcessBus
+
+
+class Producer:
+    """Publishes FlowMessages to a topic.
+
+    ``fixedlen`` controls length-prefixed framing, mirroring the reference's
+    ``-proto.fixedlen`` flag (needed by ClickHouse-style Protobuf consumers,
+    ref: mocker/mocker.go:95-102). Un-prefixed messages are the Go-inserter
+    contract.
+    """
+
+    def __init__(self, bus: InProcessBus, topic: str = "flows",
+                 fixedlen: bool = False):
+        self.bus = bus
+        self.topic = topic
+        self.fixedlen = fixedlen
+        self.produced = 0
+
+    def send(self, msg: FlowMessage, partition: Optional[int] = None) -> None:
+        data = wire.encode_frame(msg) if self.fixedlen else wire.encode_message(msg)
+        self.bus.produce(self.topic, data, partition)
+        self.produced += 1
+
+    def send_many(self, msgs: Iterable[FlowMessage],
+                  partition: Optional[int] = None) -> int:
+        n = 0
+        for m in msgs:
+            self.send(m, partition)
+            n += 1
+        return n

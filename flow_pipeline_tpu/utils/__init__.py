@@ -1,0 +1,5 @@
+"""Utilities: the dotted-flag config system and small shared helpers."""
+
+from .flags import FlagSet, Flag
+
+__all__ = ["FlagSet", "Flag"]

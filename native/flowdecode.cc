@@ -1,0 +1,319 @@
+// libflowdecode: bulk FlowMessage protobuf <-> struct-of-arrays codec.
+//
+// The host-side bottleneck at >=1M flows/sec is decoding length-prefixed
+// protobuf frames into the columnar batches the device consumes
+// (SURVEY.md §7 "hard parts": host path will dominate; the reference's
+// native analogue is ClickHouse's C++ Kafka/Protobuf engine,
+// ref: compose/clickhouse/create.sh:5-34). This is a dependency-free
+// proto3 wire parser specialized to the FlowMessage schema
+// (field numbers: flow_pipeline_tpu/schema/flow.proto — the wire contract).
+//
+// Exposed C ABI (ctypes, see flow_pipeline_tpu/native/__init__.py):
+//   flow_count_frames(data, len)                -> frames or -1-errpos
+//   flow_decode_stream(data, len, cols, cap)    -> rows or -1-badframe
+//   flow_encode_stream(cols, n, out, cap)       -> bytes written or -1
+//
+// Column pointer layout (must match schema.batch.COLUMNS order + widths):
+//   24 scalar columns, then 3 address columns of [N,4] uint32 (big-endian
+//   word order, addresses right-aligned to 16 bytes).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// scalar columns in schema.batch.COLUMNS order; width in bytes (4 or 8)
+enum ScalarCol {
+  COL_TYPE = 0,
+  COL_TIME_RECEIVED,
+  COL_SAMPLING_RATE,
+  COL_SEQUENCE_NUM,
+  COL_TIME_FLOW_START,
+  COL_TIME_FLOW_END,
+  COL_BYTES,
+  COL_PACKETS,
+  COL_SRC_AS,
+  COL_DST_AS,
+  COL_IN_IF,
+  COL_OUT_IF,
+  COL_PROTO,
+  COL_SRC_PORT,
+  COL_DST_PORT,
+  COL_IP_TOS,
+  COL_FORWARDING_STATUS,
+  COL_IP_TTL,
+  COL_TCP_FLAGS,
+  COL_ETYPE,
+  COL_ICMP_TYPE,
+  COL_ICMP_CODE,
+  COL_IPV6_FLOW_LABEL,
+  COL_FLOW_DIRECTION,
+  N_SCALAR_COLS
+};
+
+constexpr int kColWidth[N_SCALAR_COLS] = {
+    4, 8, 8, 4, 8, 8, 8, 8, 4, 4, 4, 4,
+    4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4,
+};
+
+enum AddrCol { ADDR_SRC = 0, ADDR_DST, ADDR_SAMPLER, N_ADDR_COLS };
+
+// proto field number -> scalar column (-1: not a scalar field)
+int scalar_col_for_field(uint32_t field) {
+  switch (field) {
+    case 1: return COL_TYPE;
+    case 2: return COL_TIME_RECEIVED;
+    case 3: return COL_SAMPLING_RATE;
+    case 4: return COL_SEQUENCE_NUM;
+    case 5: return COL_TIME_FLOW_END;
+    case 9: return COL_BYTES;
+    case 10: return COL_PACKETS;
+    case 14: return COL_SRC_AS;
+    case 15: return COL_DST_AS;
+    case 18: return COL_IN_IF;
+    case 19: return COL_OUT_IF;
+    case 20: return COL_PROTO;
+    case 21: return COL_SRC_PORT;
+    case 22: return COL_DST_PORT;
+    case 23: return COL_IP_TOS;
+    case 24: return COL_FORWARDING_STATUS;
+    case 25: return COL_IP_TTL;
+    case 26: return COL_TCP_FLAGS;
+    case 30: return COL_ETYPE;
+    case 31: return COL_ICMP_TYPE;
+    case 32: return COL_ICMP_CODE;
+    case 37: return COL_IPV6_FLOW_LABEL;
+    case 38: return COL_TIME_FLOW_START;
+    case 42: return COL_FLOW_DIRECTION;
+    default: return -1;
+  }
+}
+
+int addr_col_for_field(uint32_t field) {
+  switch (field) {
+    case 6: return ADDR_SRC;
+    case 7: return ADDR_DST;
+    case 11: return ADDR_SAMPLER;
+    default: return -1;
+  }
+}
+
+// Parse a varint; returns false on truncation/overlong. Matches the Python
+// codec: values truncate to 64 bits like canonical parsers.
+inline bool get_varint(const uint8_t* data, int64_t len, int64_t* pos,
+                       uint64_t* out) {
+  uint64_t result = 0;
+  int shift = 0;
+  while (*pos < len) {
+    uint8_t b = data[*pos];
+    ++*pos;
+    if (shift < 64) result |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = result;
+      return true;
+    }
+    shift += 7;
+    if (shift > 63) return false;
+  }
+  return false;
+}
+
+// Write a 16-byte (right-aligned) address into 4 big-endian uint32 words.
+inline void put_addr(uint32_t* dst, const uint8_t* src, int64_t n) {
+  uint8_t padded[16] = {0};
+  if (n > 16) {  // keep trailing 16 like the Python codec's addr[-16:]
+    src += n - 16;
+    n = 16;
+  }
+  std::memcpy(padded + (16 - n), src, static_cast<size_t>(n));
+  for (int w = 0; w < 4; ++w) {
+    dst[w] = (static_cast<uint32_t>(padded[4 * w]) << 24) |
+             (static_cast<uint32_t>(padded[4 * w + 1]) << 16) |
+             (static_cast<uint32_t>(padded[4 * w + 2]) << 8) |
+             static_cast<uint32_t>(padded[4 * w + 3]);
+  }
+}
+
+inline void store_scalar(void* col, int width, int64_t row, uint64_t value) {
+  if (width == 8) {
+    static_cast<uint64_t*>(col)[row] = value;
+  } else {
+    static_cast<uint32_t*>(col)[row] =
+        static_cast<uint32_t>(value & 0xFFFFFFFFu);
+  }
+}
+
+// Decode one message body into row `row` of the column buffers. Buffers are
+// pre-zeroed by the caller (numpy zeros), so absent fields stay 0.
+bool decode_body(const uint8_t* data, int64_t len, void** cols, int64_t row) {
+  int64_t pos = 0;
+  uint32_t* addr_base[N_ADDR_COLS];
+  for (int a = 0; a < N_ADDR_COLS; ++a) {
+    addr_base[a] = static_cast<uint32_t*>(cols[N_SCALAR_COLS + a]) + 4 * row;
+  }
+  while (pos < len) {
+    uint64_t tag;
+    if (!get_varint(data, len, &pos, &tag)) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3);
+    uint32_t wt = static_cast<uint32_t>(tag & 0x7);
+    if (wt == 0) {  // varint
+      uint64_t value;
+      if (!get_varint(data, len, &pos, &value)) return false;
+      int col = scalar_col_for_field(field);
+      if (col >= 0) store_scalar(cols[col], kColWidth[col], row, value);
+    } else if (wt == 2) {  // length-delimited
+      uint64_t blen;
+      if (!get_varint(data, len, &pos, &blen)) return false;
+      // compare as uint64: a huge blen must not wrap the int64 cast and
+      // defeat the bounds check (untrusted input)
+      if (blen > static_cast<uint64_t>(len - pos)) return false;
+      int acol = addr_col_for_field(field);
+      if (acol >= 0) {
+        put_addr(addr_base[acol], data + pos, static_cast<int64_t>(blen));
+      }
+      pos += static_cast<int64_t>(blen);
+    } else if (wt == 5) {  // fixed32: skip
+      if (pos + 4 > len) return false;
+      pos += 4;
+    } else if (wt == 1) {  // fixed64: skip
+      if (pos + 8 > len) return false;
+      pos += 8;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+inline void put_varint(uint8_t* out, int64_t cap, int64_t* pos, uint64_t v,
+                       bool* ok) {
+  while (true) {
+    if (*pos >= cap) {
+      *ok = false;
+      return;
+    }
+    uint8_t b = v & 0x7F;
+    v >>= 7;
+    out[(*pos)++] = v ? (b | 0x80) : b;
+    if (!v) return;
+  }
+}
+
+inline uint64_t load_scalar(void** cols, int col, int64_t row) {
+  return kColWidth[col] == 8
+             ? static_cast<uint64_t*>(cols[col])[row]
+             : static_cast<uint64_t>(static_cast<uint32_t*>(cols[col])[row]);
+}
+
+// field emission order mirrors the Python encoder (ascending field number)
+struct FieldSpec {
+  uint32_t field;
+  int col;  // scalar col, or -1
+  int addr;  // addr col, or -1
+};
+constexpr FieldSpec kEmitOrder[] = {
+    {1, COL_TYPE, -1},         {2, COL_TIME_RECEIVED, -1},
+    {3, COL_SAMPLING_RATE, -1}, {4, COL_SEQUENCE_NUM, -1},
+    {5, COL_TIME_FLOW_END, -1}, {6, -1, ADDR_SRC},
+    {7, -1, ADDR_DST},          {9, COL_BYTES, -1},
+    {10, COL_PACKETS, -1},      {11, -1, ADDR_SAMPLER},
+    {14, COL_SRC_AS, -1},       {15, COL_DST_AS, -1},
+    {18, COL_IN_IF, -1},        {19, COL_OUT_IF, -1},
+    {20, COL_PROTO, -1},        {21, COL_SRC_PORT, -1},
+    {22, COL_DST_PORT, -1},     {23, COL_IP_TOS, -1},
+    {24, COL_FORWARDING_STATUS, -1}, {25, COL_IP_TTL, -1},
+    {26, COL_TCP_FLAGS, -1},    {30, COL_ETYPE, -1},
+    {31, COL_ICMP_TYPE, -1},    {32, COL_ICMP_CODE, -1},
+    {37, COL_IPV6_FLOW_LABEL, -1}, {38, COL_TIME_FLOW_START, -1},
+    {42, COL_FLOW_DIRECTION, -1},
+};
+
+}  // namespace
+
+extern "C" {
+
+// Count length-prefixed frames. Returns -(errpos+1) on malformed input.
+long long flow_count_frames(const char* cdata, long long len) {
+  const uint8_t* data = reinterpret_cast<const uint8_t*>(cdata);
+  int64_t pos = 0;
+  long long frames = 0;
+  while (pos < len) {
+    uint64_t flen;
+    int64_t start = pos;
+    if (!get_varint(data, len, &pos, &flen) ||
+        flen > static_cast<uint64_t>(len - pos)) {
+      return -(start + 1);
+    }
+    pos += static_cast<int64_t>(flen);
+    ++frames;
+  }
+  return frames;
+}
+
+// Decode a stream into column buffers with capacity `cap` rows.
+// Returns rows decoded, or -(frame_index+1) on a malformed frame/overflow.
+long long flow_decode_stream(const char* cdata, long long len, void** cols,
+                             long long cap) {
+  const uint8_t* data = reinterpret_cast<const uint8_t*>(cdata);
+  int64_t pos = 0;
+  long long row = 0;
+  while (pos < len) {
+    uint64_t flen;
+    if (!get_varint(data, len, &pos, &flen) ||
+        flen > static_cast<uint64_t>(len - pos) || row >= cap) {
+      return -(row + 1);
+    }
+    if (!decode_body(data + pos, static_cast<int64_t>(flen), cols, row)) {
+      return -(row + 1);
+    }
+    pos += static_cast<int64_t>(flen);
+    ++row;
+  }
+  return row;
+}
+
+// Encode n rows to length-prefixed frames. Returns bytes written or -1 if
+// the output buffer is too small.
+long long flow_encode_stream(void** cols, long long n, char* cout,
+                             long long cap) {
+  uint8_t* out = reinterpret_cast<uint8_t*>(cout);
+  int64_t pos = 0;
+  uint8_t body[512];  // worst case: 27 fields * 12 + 3*18 < 512
+  for (long long row = 0; row < n; ++row) {
+    int64_t bpos = 0;
+    bool ok = true;
+    for (const FieldSpec& fs : kEmitOrder) {
+      if (fs.col >= 0) {
+        uint64_t v = load_scalar(cols, fs.col, row);
+        if (!v) continue;  // proto3: zero fields omitted
+        put_varint(body, sizeof(body), &bpos, (fs.field << 3) | 0, &ok);
+        put_varint(body, sizeof(body), &bpos, v, &ok);
+      } else {
+        const uint32_t* words =
+            static_cast<const uint32_t*>(cols[N_SCALAR_COLS + fs.addr]) +
+            4 * row;
+        if (!(words[0] | words[1] | words[2] | words[3])) continue;
+        put_varint(body, sizeof(body), &bpos, (fs.field << 3) | 2, &ok);
+        put_varint(body, sizeof(body), &bpos, 16, &ok);
+        if (bpos + 16 > static_cast<int64_t>(sizeof(body))) {
+          ok = false;
+        } else {
+          for (int w = 0; w < 4; ++w) {
+            body[bpos++] = static_cast<uint8_t>(words[w] >> 24);
+            body[bpos++] = static_cast<uint8_t>(words[w] >> 16);
+            body[bpos++] = static_cast<uint8_t>(words[w] >> 8);
+            body[bpos++] = static_cast<uint8_t>(words[w]);
+          }
+        }
+      }
+      if (!ok) return -1;
+    }
+    put_varint(out, cap, &pos, static_cast<uint64_t>(bpos), &ok);
+    if (!ok || pos + bpos > cap) return -1;
+    std::memcpy(out + pos, body, static_cast<size_t>(bpos));
+    pos += bpos;
+  }
+  return pos;
+}
+
+}  // extern "C"

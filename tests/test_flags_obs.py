@@ -1,0 +1,117 @@
+"""Dotted-flag parser + metrics/observability tests."""
+
+import urllib.request
+
+import pytest
+
+from flow_pipeline_tpu.obs import MetricsRegistry, MetricsServer
+from flow_pipeline_tpu.utils.flags import FlagSet
+
+
+class TestFlags:
+    def make(self):
+        fs = FlagSet("test")
+        fs.string("kafka.brokers", "127.0.0.1:9092", "brokers")
+        fs.integer("flush.count", 100, "count")
+        fs.number("flush.dur", 5.0, "dur")
+        fs.boolean("proto.fixedlen", False, "fixedlen")
+        fs.string("postgres.pass", "", "password", env="POSTGRES_PASSWORD")
+        return fs
+
+    def test_defaults(self):
+        vals = self.make().parse([])
+        assert vals["flush.count"] == 100
+        assert vals["proto.fixedlen"] is False
+
+    def test_space_and_equals_forms(self):
+        vals = self.make().parse(
+            ["-kafka.brokers", "k:9092", "-flush.count=7", "-proto.fixedlen"]
+        )
+        assert vals["kafka.brokers"] == "k:9092"
+        assert vals["flush.count"] == 7
+        assert vals["proto.fixedlen"] is True
+
+    def test_bool_explicit_false(self):
+        vals = self.make().parse(["-proto.fixedlen=false"])
+        assert vals["proto.fixedlen"] is False
+
+    def test_double_dash_accepted(self):
+        vals = self.make().parse(["--flush.count", "3"])
+        assert vals["flush.count"] == 3
+
+    def test_unknown_flag_names_itself(self):
+        with pytest.raises(ValueError, match="flag provided but not defined: -nope"):
+            self.make().parse(["-nope", "1"])
+
+    def test_missing_value(self):
+        with pytest.raises(ValueError, match="needs a value"):
+            self.make().parse(["-kafka.brokers"])
+
+    def test_bad_int(self):
+        with pytest.raises(ValueError, match="invalid value for -flush.count"):
+            self.make().parse(["-flush.count", "abc"])
+
+    def test_env_fallback(self, monkeypatch):
+        monkeypatch.setenv("POSTGRES_PASSWORD", "sekret")
+        vals = self.make().parse([])
+        assert vals["postgres.pass"] == "sekret"
+        # explicit flag beats env (reference precedence,
+        # ref: inserter/inserter.go:220-224)
+        vals = self.make().parse(["-postgres.pass", "flag"])
+        assert vals["postgres.pass"] == "flag"
+
+    def test_help_exits_zero(self, capsys):
+        with pytest.raises(SystemExit) as e:
+            self.make().parse(["-help"])
+        assert e.value.code == 0
+        assert "kafka.brokers" in capsys.readouterr().out
+
+
+class TestMetrics:
+    def test_counter_inc_and_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("requests_total", "reqs")
+        c.inc()
+        c.inc(2, path="/metrics")
+        assert c.value() == 1
+        assert c.value(path="/metrics") == 2
+        text = reg.render()
+        assert "# TYPE requests_total counter" in text
+        assert 'requests_total{path="/metrics"} 2.0' in text
+
+    def test_gauge_set(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("lag", "lag")
+        g.set(42)
+        assert "lag 42" in reg.render()
+        assert "# TYPE lag gauge" in reg.render()
+
+    def test_summary_quantiles(self):
+        reg = MetricsRegistry()
+        s = reg.summary("latency_us", "lat")
+        for v in range(100):
+            s.observe(float(v))
+        assert 45 <= s.quantile(0.5) <= 55
+        text = reg.render()
+        assert "latency_us_count 100" in text
+
+    def test_same_name_same_metric(self):
+        reg = MetricsRegistry()
+        assert reg.counter("x") is reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_http_endpoint(self):
+        reg = MetricsRegistry()
+        reg.counter("flows_processed_total", "n").inc(7)
+        server = MetricsServer(0, registry=reg).start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics"
+            ).read().decode()
+            assert "flows_processed_total 7.0" in body
+            # unknown path -> 404
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"http://127.0.0.1:{server.port}/nope")
+        finally:
+            server.stop()

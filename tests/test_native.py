@@ -1,0 +1,156 @@
+"""Native C++ codec tests: bit-parity with the pure-Python codec (the
+correctness reference), malformed-input handling, and the throughput
+sanity bound. Skipped when libflowdecode.so is not built (`make native`)."""
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu import native
+from flow_pipeline_tpu.schema import (
+    FlowBatch,
+    FlowMessage,
+    FlowType,
+    encode_frame,
+    encode_stream,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="libflowdecode.so not built (make native)"
+)
+
+
+def make_msgs(n=500):
+    return [
+        FlowMessage(
+            type=FlowType.SFLOW_5,
+            time_received=1_700_000_000 + i,
+            sampling_rate=1000,
+            sequence_num=i,
+            time_flow_start=1_700_000_000 + i,
+            time_flow_end=1_700_000_001 + i,
+            src_addr=bytes([i % 256]) * 16,
+            dst_addr=b"\x00" * 12 + bytes([10, 0, i % 256, (i * 3) % 256]),
+            sampler_address=b"\x00" * 12 + b"\x0a\x00\x00\x01",
+            bytes=(i * 37) % 1500,
+            packets=i % 100,
+            src_as=65000 + i % 3,
+            dst_as=65000 + (i * 2) % 3,
+            in_if=i % 8,
+            out_if=(i + 1) % 8,
+            proto=6 if i % 2 else 17,
+            src_port=1024 + i,
+            dst_port=443,
+            ip_tos=i % 4,
+            ip_ttl=64,
+            tcp_flags=0x18,
+            etype=0x86DD,
+            ipv6_flow_label=i,
+            flow_direction=i % 2,
+        )
+        for i in range(n)
+    ]
+
+
+class TestDecodeParity:
+    def test_columns_match_python_codec(self):
+        msgs = make_msgs()
+        wire_bytes = encode_stream(msgs)
+        got = native.decode_stream(wire_bytes)
+        want = FlowBatch.from_messages(msgs)
+        assert len(got) == len(want)
+        for name in want.columns:
+            np.testing.assert_array_equal(
+                got.columns[name], want.columns[name], err_msg=name
+            )
+
+    def test_uint64_fields_preserved(self):
+        msgs = [FlowMessage(bytes=2**40, time_received=2**33)]
+        got = native.decode_stream(encode_stream(msgs))
+        assert got.columns["bytes"][0] == 2**40
+        assert got.columns["time_received"][0] == 2**33
+
+    def test_empty_and_default_frames(self):
+        msgs = [FlowMessage(), FlowMessage(packets=1)]
+        got = native.decode_stream(encode_stream(msgs))
+        assert len(got) == 2
+        assert got.columns["packets"].tolist() == [0, 1]
+
+    def test_unknown_fields_skipped(self):
+        # unused field 12 varint + field 13 bytes inside a frame
+        body = bytes([12 << 3, 7, (13 << 3) | 2, 2, 0xAA, 0xBB])
+        body += encode_stream([FlowMessage(packets=9)])[1:]  # strip its prefix
+        frame = bytes([len(body)]) + body
+        got = native.decode_stream(frame)
+        assert got.columns["packets"][0] == 9
+
+    def test_malformed_truncated(self):
+        wire_bytes = encode_stream(make_msgs(3))
+        with pytest.raises(ValueError):
+            native.decode_stream(wire_bytes[:-2])
+
+    def test_garbage(self):
+        with pytest.raises(ValueError):
+            native.decode_stream(b"\xff\xff\xff\xff")
+
+    def test_huge_length_varint_rejected(self):
+        # length-delimited field claiming 2^63 bytes must not wrap the
+        # bounds check (signed-overflow hardening for untrusted streams)
+        huge = bytes([0x80] * 8 + [0x80, 0x01])  # varint 2^63
+        body = bytes([(6 << 3) | 2]) + huge  # field 6, wt 2
+        frame = bytes([len(body)]) + body
+        with pytest.raises(ValueError):
+            native.decode_stream(frame)
+        # same shape at the frame-length level
+        with pytest.raises(ValueError):
+            native.decode_stream(huge + b"\x00")
+
+    def test_single_byte_frames_counted(self):
+        # an all-default message frames to b"\x00": 1 byte per frame
+        stream = b"\x00" * 100
+        got = native.decode_stream(stream)
+        assert len(got) == 100
+
+
+class TestEncodeParity:
+    def test_encode_matches_python(self):
+        # start at i=1: row 0's src_addr would be all-zero, where the native
+        # encoder legally omits the field (see native.encode_stream docstring)
+        msgs = make_msgs(200)[1:]
+        batch = FlowBatch.from_messages(msgs)
+        assert native.encode_stream(batch) == encode_stream(msgs)
+
+    def test_all_zero_address_omitted_but_equivalent(self):
+        msgs = [FlowMessage(src_addr=b"\x00" * 16, packets=3)]
+        batch = FlowBatch.from_messages(msgs)
+        data = native.encode_stream(batch)
+        assert len(data) < len(encode_stream(msgs))  # field omitted
+        again = native.decode_stream(data)
+        np.testing.assert_array_equal(
+            again.columns["src_addr"], batch.columns["src_addr"]
+        )
+        assert again.columns["packets"][0] == 3
+
+    def test_roundtrip_through_native_both_ways(self):
+        batch = FlowBatch.from_messages(make_msgs(100))
+        again = native.decode_stream(native.encode_stream(batch))
+        for name in batch.columns:
+            np.testing.assert_array_equal(
+                again.columns[name], batch.columns[name], err_msg=name
+            )
+
+
+class TestThroughput:
+    def test_native_beats_python_by_10x(self):
+        import time
+
+        from flow_pipeline_tpu.schema import wire as pywire
+
+        msgs = make_msgs(2000)
+        wire_bytes = encode_stream(msgs)
+        t0 = time.perf_counter()
+        native.decode_stream(wire_bytes)
+        t_native = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        FlowBatch.from_messages(pywire.decode_frames(wire_bytes))
+        t_py = time.perf_counter() - t0
+        assert t_py / t_native > 10

@@ -1,0 +1,138 @@
+"""Sink + CLI tests: record normalization, SQLite storage, Postgres SQL
+generation, and the CLI surface (pipeline demo, mocker -out / processor -in
+file roundtrip, flag errors)."""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.cli import main
+from flow_pipeline_tpu.sink import MemorySink, SQLiteSink, rows_to_records
+from flow_pipeline_tpu.sink.postgres import insert_sql
+
+
+class TestRecords:
+    def test_columnar_rows(self):
+        rows = {
+            "timeslot": np.array([300, 300], np.uint64),
+            "src_as": np.array([65000, 65001], np.uint64),
+            "bytes": np.array([10, 20], np.uint64),
+        }
+        recs = rows_to_records(rows)
+        assert recs == [
+            {"timeslot": 300, "src_as": 65000, "bytes": 10},
+            {"timeslot": 300, "src_as": 65001, "bytes": 20},
+        ]
+
+    def test_valid_mask_filters(self):
+        rows = {
+            "bytes": np.array([1, 2], np.uint64),
+            "valid": np.array([True, False]),
+        }
+        assert len(rows_to_records(rows)) == 1
+
+    def test_ipv4_and_ipv6_render(self):
+        v4 = np.array([0, 0, 0, (10 << 24) | (0 << 16) | (0 << 8) | 7], np.uint32)
+        v6 = np.array([0x20010DB8, 0, 0, 0x1234], np.uint32)
+        rows = {"dst_addr": np.stack([v4, v6]), "bytes": np.array([1, 2], np.uint64)}
+        recs = rows_to_records(rows)
+        assert recs[0]["dst_addr"] == "10.0.0.7"
+        assert recs[1]["dst_addr"] == "2001:db8::1234"
+
+
+class TestSQLite:
+    def test_known_tables(self):
+        sink = SQLiteSink()
+        sink.write("flows_5m", {
+            "timeslot": np.array([300], np.uint64),
+            "src_as": np.array([65000], np.uint64),
+            "dst_as": np.array([65001], np.uint64),
+            "etype": np.array([0x86DD], np.uint64),
+            "bytes": np.array([99], np.uint64),
+            "packets": np.array([3], np.uint64),
+            "count": np.array([1], np.uint64),
+        })
+        assert sink.query("SELECT bytes FROM flows_5m") == [(99,)]
+
+    def test_unknown_table_journaled(self):
+        sink = SQLiteSink()
+        sink.write("mystery", [{"a": 1}])
+        rows = sink.query("SELECT table_name, record FROM journal")
+        assert rows[0][0] == "mystery"
+
+    def test_topk_rank_assigned(self):
+        sink = SQLiteSink()
+        sink.write("top_talkers", {
+            "timeslot": np.array([0, 0], np.uint64),
+            "bytes": np.array([100, 50], np.uint64),
+            "valid": np.array([True, True]),
+        })
+        assert sink.query("SELECT rank, bytes FROM top_talkers ORDER BY rank") == [
+            (0, 100), (1, 50),
+        ]
+
+
+class TestPostgresSQL:
+    def test_insert_sql_multirow_single_statement(self):
+        sql, args = insert_sql("flows_5m", [
+            {"timeslot": 300, "src_as": 1, "dst_as": 2, "etype": 3,
+             "bytes": 4, "packets": 5, "count": 6},
+            {"timeslot": 600, "src_as": 7, "dst_as": 8, "etype": 9,
+             "bytes": 10, "packets": 11, "count": 12},
+        ])
+        assert sql.startswith('INSERT INTO "flows_5m"')
+        assert sql.count("(%s") == 2  # one VALUES group per record
+        assert args == [300, 1, 2, 3, 4, 5, 6, 600, 7, 8, 9, 10, 11, 12]
+
+    def test_missing_fields_become_none(self):
+        _, args = insert_sql("ddos_alerts", [{"rate": 1.5}])
+        assert args.count(None) == 5
+
+
+class TestCLI:
+    def test_usage(self, capsys):
+        assert main([]) == 2
+        assert main(["-h"]) == 0
+        assert "mocker" in capsys.readouterr().out
+
+    def test_unknown_command(self, capsys):
+        assert main(["fnord"]) == 2
+
+    def test_unknown_flag(self, capsys):
+        assert main(["pipeline", "-not.a.flag", "x"]) == 2
+        assert "not.a.flag" in capsys.readouterr().err
+
+    def test_pipeline_to_sqlite(self, tmp_path):
+        db = str(tmp_path / "flows.db")
+        rc = main([
+            "pipeline", "-produce.count", "2000", "-produce.rate", "50",
+            "-processor.batch", "512", "-sink", f"sqlite:{db}",
+            "-metrics.addr", "", "-model.ddos=false",
+        ])
+        assert rc == 0
+        conn = sqlite3.connect(db)
+        total = conn.execute("SELECT SUM(count) FROM flows_5m").fetchone()[0]
+        assert total == 2000
+
+    def test_mocker_file_then_processor(self, tmp_path):
+        frames = str(tmp_path / "frames.bin")
+        db = str(tmp_path / "flows.db")
+        assert main(["mocker", "-out", frames, "-produce.count", "1500",
+                     "-produce.rate", "50"]) == 0
+        assert main(["processor", "-in", frames, "-processor.batch", "512",
+                     "-sink", f"sqlite:{db}", "-metrics.addr", "",
+                     "-model.ddos=false", "-model.talkers=false"]) == 0
+        conn = sqlite3.connect(db)
+        assert conn.execute("SELECT SUM(count) FROM flows_5m").fetchone()[0] == 1500
+
+    def test_mocker_then_inserter_raw_rows(self, tmp_path):
+        frames = str(tmp_path / "frames.bin")
+        db = str(tmp_path / "raw.db")
+        assert main(["mocker", "-out", frames, "-produce.count", "300"]) == 0
+        assert main(["inserter", "-in", frames, "-sqlite", db]) == 0
+        conn = sqlite3.connect(db)
+        n, su = conn.execute("SELECT COUNT(*), SUM(bytes) FROM flows").fetchone()
+        assert n == 300 and su > 0
+        ip = conn.execute("SELECT src_ip FROM flows LIMIT 1").fetchone()[0]
+        assert ip.startswith("2001:db8:0:1::")
