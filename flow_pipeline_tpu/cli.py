@@ -180,15 +180,18 @@ def _make_sinks(spec: str):
 
 
 def _load_frames_bus(path: str, topic: str, partitions: int = 2):
-    """Preload a frames file onto an in-process bus (the -in path)."""
+    """Preload a frames file onto an in-process bus (the -in path). Frames
+    are split by scanning length prefixes and produced as raw bytes — the
+    single protobuf decode happens downstream in the consumer."""
     from .schema import wire
     from .transport import InProcessBus
 
     bus = InProcessBus()
     bus.create_topic(topic, partitions)
-    data = open(path, "rb").read()
-    for msg in wire.decode_frames(data):
-        bus.produce(topic, wire.encode_frame(msg))
+    with open(path, "rb") as f:
+        data = f.read()
+    for frame in wire.iter_raw_frames(data):
+        bus.produce(topic, frame)
     return bus
 
 
@@ -295,10 +298,15 @@ def inserter_main(argv=None) -> int:
 def _raw_rows(batch) -> list[dict]:
     from .sink.base import _addr_str
 
+    import datetime
+
     c = batch.columns
     return [
         {
-            "time_flow": int(c["time_received"][i]),
+            # TIMESTAMP columns (Postgres) need a timestamp, not epoch int
+            "time_flow": datetime.datetime.fromtimestamp(
+                int(c["time_received"][i]), datetime.timezone.utc
+            ).strftime("%Y-%m-%d %H:%M:%S"),
             "type": int(c["type"][i]),
             "sampling_rate": int(c["sampling_rate"][i]),
             "src_as": int(c["src_as"][i]),

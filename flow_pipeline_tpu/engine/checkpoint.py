@@ -107,9 +107,19 @@ def save_checkpoint(path: str, state: Any) -> None:
         raise
 
 
+def checkpoint_exists(path: str) -> bool:
+    return os.path.isdir(path) or os.path.isdir(path + ".old")
+
+
 def load_checkpoint(path: str) -> Any:
     """Load a checkpoint. NamedTuples come back as field dicts — callers
-    rebuild their concrete state types (see StreamWorker.restore)."""
+    rebuild their concrete state types (see StreamWorker.restore).
+
+    Falls back to ``<path>.old`` when the primary is missing: a crash
+    between save_checkpoint's two renames leaves only the previous
+    checkpoint under .old, which is still a consistent snapshot."""
+    if not os.path.isdir(path) and os.path.isdir(path + ".old"):
+        path = path + ".old"
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     arrays = np.load(os.path.join(path, "arrays.npz"))

@@ -52,6 +52,7 @@ class StreamWorker:
         self.flows_seen = 0
         # offsets covered by state (committable after next snapshot/flush)
         self._covered: dict[int, int] = {}
+        self._emitted_since_snapshot = False
         self.m_flows = REGISTRY.counter("flows_processed_total",
                                         "flows decoded and aggregated")
         self.m_batches = REGISTRY.counter("batches_processed_total",
@@ -81,7 +82,13 @@ class StreamWorker:
             prev = self._covered.get(batch.partition, 0)
             self._covered[batch.partition] = max(prev, batch.last_offset + 1)
         self.flush_closed()
-        if (
+        # Snapshot immediately after any flush that emitted rows: a replay
+        # from an older snapshot would rebuild and re-emit those windows
+        # (duplicate partials inflate merging sinks). With this coupling the
+        # duplicate exposure shrinks to a crash inside the sink-write ->
+        # snapshot gap — the irreducible at-least-once window without
+        # transactional sinks.
+        if self._emitted_since_snapshot or (
             self.config.snapshot_every
             and self.batches_seen % self.config.snapshot_every == 0
         ):
@@ -124,6 +131,7 @@ class StreamWorker:
         for sink in self.sinks:
             sink.write(table, rows)
         self.m_rows.inc(n)
+        self._emitted_since_snapshot = True
         log.info("flushed table=%s rows=%d", table, n)
 
     def finalize(self) -> None:
@@ -140,6 +148,7 @@ class StreamWorker:
         state must be durable before the bus forgets the input."""
         if self.config.checkpoint_path:
             save_checkpoint(self.config.checkpoint_path, self._state())
+        self._emitted_since_snapshot = False
         for partition, next_off in sorted(self._covered.items()):
             self.consumer.commit(partition, next_off)
         if hasattr(self.consumer, "lag"):
@@ -178,8 +187,10 @@ class StreamWorker:
         """Rehydrate from the checkpoint; returns False if none exists."""
         import jax.numpy as jnp
 
+        from .checkpoint import checkpoint_exists
+
         path = path or self.config.checkpoint_path
-        if not path or not os.path.isdir(path):
+        if not path or not checkpoint_exists(path):
             return False
         snap = load_checkpoint(path)
         self._covered = {int(k): v for k, v in snap["covered"].items()}

@@ -38,8 +38,11 @@ class Counter:
         with self._lock:
             return self._values.get(key, 0.0)
 
+    _kind = "counter"
+
     def render(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self._kind}"]
         with self._lock:
             items = list(self._values.items()) or [((), 0.0)]
         for key, v in items:
@@ -48,13 +51,12 @@ class Counter:
 
 
 class Gauge(Counter):
+    _kind = "gauge"
+
     def set(self, value: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
         with self._lock:
             self._values[key] = value
-
-    def render(self) -> str:
-        return super().render().replace(" counter", " gauge", 1)
 
 
 class Summary:

@@ -119,6 +119,22 @@ def encode_stream(msgs) -> bytes:
     return bytes(out)
 
 
+def iter_raw_frames(data: bytes | memoryview):
+    """Yield each length-prefixed frame's raw bytes (prefix included) without
+    decoding — for splitting a frames stream onto a bus/partitions with one
+    decode total downstream."""
+    pos = 0
+    n = len(data)
+    view = memoryview(data)
+    while pos < n:
+        start = pos
+        length, pos = _get_varint(view, pos)
+        if pos + length > n:
+            raise ValueError("truncated frame")
+        pos += length
+        yield bytes(view[start:pos])
+
+
 def decode_frames(data: bytes | memoryview) -> list[FlowMessage]:
     """Parse a concatenation of length-prefixed FlowMessage frames."""
     msgs = []

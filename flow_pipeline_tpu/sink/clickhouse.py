@@ -17,15 +17,23 @@ import urllib.parse
 import urllib.request
 from typing import Optional
 
+from . import ddl
 from .base import rows_to_records
 
 
 class ClickHouseSink:
     def __init__(self, url: str = "http://localhost:8123",
-                 database: str = "default", timeout: float = 5.0):
+                 database: str = "default", timeout: float = 5.0,
+                 create_tables: bool = True):
         self.url = url.rstrip("/")
         self.database = database
         self.timeout = timeout
+        if create_tables:
+            # a bare clickhouse-server has no schema; without this the first
+            # flush 400s and the processor crash-loops
+            for stmt in (ddl.CLICKHOUSE_FLOWS_RAW, ddl.CLICKHOUSE_FLOWS_5M,
+                         ddl.CLICKHOUSE_TOP_TALKERS, ddl.CLICKHOUSE_DDOS_ALERTS):
+                self._post(stmt)
 
     def _post(self, query: str, body: bytes = b"") -> None:
         req = urllib.request.Request(

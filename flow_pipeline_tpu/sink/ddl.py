@@ -88,6 +88,34 @@ PARTITION BY Date
 ORDER BY TimeReceived;
 """
 
+CLICKHOUSE_TOP_TALKERS = """
+CREATE TABLE IF NOT EXISTS top_talkers (
+    timeslot UInt64,
+    rank UInt32,
+    src_addr String,
+    dst_addr String,
+    src_port UInt32,
+    dst_port UInt32,
+    proto UInt32,
+    bytes UInt64,
+    packets UInt64,
+    count UInt64
+) ENGINE = MergeTree()
+ORDER BY (timeslot, rank);
+"""
+
+CLICKHOUSE_DDOS_ALERTS = """
+CREATE TABLE IF NOT EXISTS ddos_alerts (
+    sub_window UInt64,
+    bucket UInt32,
+    dst_addr String,
+    rate Float64,
+    zscore Float64,
+    baseline_quantile Float64
+) ENGINE = MergeTree()
+ORDER BY sub_window;
+"""
+
 CLICKHOUSE_FLOWS_5M = """
 CREATE TABLE IF NOT EXISTS flows_5m (
     Date Date,
@@ -102,12 +130,35 @@ CREATE TABLE IF NOT EXISTS flows_5m (
 ORDER BY (Date, Timeslot, SrcAS, DstAS, EType);
 """
 
+# Flush-table name -> column order, shared by every SQL sink (single source
+# of truth; the sinks must not drift from each other or from the DDL above).
+TABLE_COLUMNS = {
+    "flows_5m": ["timeslot", "src_as", "dst_as", "etype", "bytes", "packets",
+                 "count"],
+    "top_talkers": ["timeslot", "rank", "src_addr", "dst_addr", "src_port",
+                    "dst_port", "proto", "bytes", "packets", "count"],
+    "ddos_alerts": ["sub_window", "bucket", "dst_addr", "rate", "zscore",
+                    "baseline_quantile"],
+    "flows": ["time_flow", "type", "sampling_rate", "src_as", "dst_as",
+              "src_ip", "dst_ip", "bytes", "packets", "etype", "proto",
+              "src_port", "dst_port"],
+}
+
+
+def assign_ranks(table: str, records: list[dict]) -> list[dict]:
+    """top_talkers rows are emitted in rank order; materialize the rank."""
+    if table == "top_talkers":
+        for rank, r in enumerate(records):
+            r.setdefault("rank", rank)
+    return records
+
+
 SQLITE_TABLES = {
     "flows": """
 CREATE TABLE IF NOT EXISTS flows (
     id            INTEGER PRIMARY KEY AUTOINCREMENT,
     date_inserted TEXT DEFAULT CURRENT_TIMESTAMP,
-    time_flow     INTEGER,
+    time_flow     TEXT,
     type          INTEGER,
     sampling_rate INTEGER,
     src_as        INTEGER,

@@ -25,17 +25,7 @@ except Exception as e:  # noqa: BLE001
     _IMPORT_ERROR = str(e)
 
 
-_COLUMNS = {
-    "flows_5m": ["timeslot", "src_as", "dst_as", "etype", "bytes", "packets",
-                 "count"],
-    "top_talkers": ["timeslot", "rank", "src_addr", "dst_addr", "src_port",
-                    "dst_port", "proto", "bytes", "packets", "count"],
-    "ddos_alerts": ["sub_window", "bucket", "dst_addr", "rate", "zscore",
-                    "baseline_quantile"],
-    "flows": ["time_flow", "type", "sampling_rate", "src_as", "dst_as",
-              "src_ip", "dst_ip", "bytes", "packets", "etype", "proto",
-              "src_port", "dst_port"],
-}
+_COLUMNS = ddl.TABLE_COLUMNS  # shared single source of truth (sink/ddl.py)
 
 DDL = {
     "flows": ddl.POSTGRES_FLOWS,
@@ -55,9 +45,7 @@ def insert_sql(table: str, records: list[dict]) -> tuple[str, list]:
     (the reference's row-at-a-time Exec is its throughput ceiling). Quoted
     identifiers come from the static column table, never from user data."""
     cols = _COLUMNS[table]
-    if table == "top_talkers":
-        for rank, r in enumerate(records):
-            r.setdefault("rank", rank)
+    ddl.assign_ranks(table, records)
     collist = ", ".join(f'"{c}"' for c in cols)
     row_ph = "(" + ", ".join(["%s"] * len(cols)) + ")"
     placeholders = ", ".join([row_ph] * len(records))

@@ -15,23 +15,6 @@ import threading
 from . import ddl
 from .base import rows_to_records
 
-# flush-table name -> sqlite table + column order
-_TABLE_COLUMNS = {
-    "flows_5m": ("flows_5m",
-                 ["timeslot", "src_as", "dst_as", "etype", "bytes", "packets",
-                  "count"]),
-    "top_talkers": ("top_talkers",
-                    ["timeslot", "rank", "src_addr", "dst_addr", "src_port",
-                     "dst_port", "proto", "bytes", "packets", "count"]),
-    "ddos_alerts": ("ddos_alerts",
-                    ["sub_window", "bucket", "dst_addr", "rate", "zscore",
-                     "baseline_quantile"]),
-    "flows": ("flows",
-              ["time_flow", "type", "sampling_rate", "src_as", "dst_as",
-               "src_ip", "dst_ip", "bytes", "packets", "etype", "proto",
-               "src_port", "dst_port"]),
-}
-
 
 class SQLiteSink:
     def __init__(self, path: str = ":memory:"):
@@ -53,21 +36,18 @@ class SQLiteSink:
         if not records:
             return
         with self._lock:
-            mapped = _TABLE_COLUMNS.get(table)
-            if mapped is None:
+            cols = ddl.TABLE_COLUMNS.get(table)
+            if cols is None:
                 self._conn.executemany(
                     "INSERT INTO journal (table_name, record) VALUES (?, ?)",
                     [(table, json.dumps(r, default=str)) for r in records],
                 )
             else:
-                name, cols = mapped
+                ddl.assign_ranks(table, records)
                 placeholders = ",".join("?" for _ in cols)
                 collist = ",".join(f'"{c}"' for c in cols)
-                if table == "top_talkers":
-                    for rank, r in enumerate(records):
-                        r.setdefault("rank", rank)
                 self._conn.executemany(
-                    f'INSERT INTO "{name}" ({collist}) VALUES ({placeholders})',
+                    f'INSERT INTO "{table}" ({collist}) VALUES ({placeholders})',
                     [tuple(r.get(c) for c in cols) for r in records],
                 )
             self._conn.commit()

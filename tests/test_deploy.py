@@ -1,0 +1,113 @@
+"""Deploy artifact sanity: YAML/JSON validity, topology shape parity with
+the reference (topic `flows`, 2 partitions, restart policies, Grafana
+provisioning paths), and dashboard queries referencing real tables."""
+
+import json
+import os
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+DEPLOY = os.path.join(os.path.dirname(__file__), "..", "deploy")
+
+COMPOSE_FILES = [
+    "compose/postgres-mock.yml",
+    "compose/postgres-collect.yml",
+    "compose/clickhouse-mock.yml",
+    "compose/clickhouse-collect.yml",
+]
+
+
+def load(path):
+    with open(os.path.join(DEPLOY, path)) as f:
+        return yaml.safe_load(f)
+
+
+class TestCompose:
+    @pytest.mark.parametrize("path", COMPOSE_FILES)
+    def test_valid_yaml_with_processor(self, path):
+        doc = load(path)
+        assert "processor" in doc["services"]
+        cmd = doc["services"]["processor"]["command"]
+        assert "flowtpu-processor" in cmd
+        assert "-metrics.addr" in cmd
+
+    @pytest.mark.parametrize("path", COMPOSE_FILES)
+    def test_topic_two_partitions(self, path):
+        # reference default: topic flows, 2 partitions, RF 1
+        doc = load(path)
+        init = doc["services"]["kafka-init"]["command"]
+        assert "--topic flows" in init
+        assert "--partitions 2" in init
+        assert "--replication-factor 1" in init
+
+    @pytest.mark.parametrize("path", COMPOSE_FILES)
+    def test_long_running_services_restart(self, path):
+        doc = load(path)
+        for name, svc in doc["services"].items():
+            if name == "kafka-init":
+                continue
+            assert svc.get("restart") == "always", name
+
+    def test_collect_topologies_expose_flow_ports(self):
+        for path in ("compose/postgres-collect.yml",
+                     "compose/clickhouse-collect.yml"):
+            doc = load(path)
+            ports = doc["services"]["goflow"]["ports"]
+            assert any("6343" in p for p in ports)  # sFlow
+            assert any("2055" in p for p in ports)  # NetFlow/IPFIX
+
+    def test_fixedlen_on_clickhouse_paths(self):
+        for path in ("compose/clickhouse-mock.yml",
+                     "compose/clickhouse-collect.yml"):
+            doc = load(path)
+            producers = [
+                s for n, s in doc["services"].items()
+                if n in ("mocker", "goflow")
+            ]
+            assert any("fixedlen" in p["command"] for p in producers)
+
+
+class TestPrometheus:
+    def test_scrapes_processor(self):
+        doc = load("prometheus/prometheus.yml")
+        targets = [
+            t
+            for job in doc["scrape_configs"]
+            for sc in job["static_configs"]
+            for t in sc["targets"]
+        ]
+        assert "processor:8081" in targets  # the reference never scraped :8081
+
+
+class TestGrafana:
+    def test_dashboards_parse_and_reference_real_tables(self):
+        for name in ("traffic.json", "pipeline.json"):
+            with open(os.path.join(DEPLOY, "grafana", "dashboards", name)) as f:
+                dash = json.load(f)
+            assert dash["panels"]
+        with open(os.path.join(DEPLOY, "grafana", "dashboards",
+                               "traffic.json")) as f:
+            text = f.read()
+        from flow_pipeline_tpu.sink.ddl import SQLITE_TABLES
+
+        for table in ("flows_5m", "top_talkers", "ddos_alerts"):
+            assert table in text
+            assert table in SQLITE_TABLES
+
+    def test_pipeline_dashboard_uses_exported_metrics(self):
+        with open(os.path.join(DEPLOY, "grafana", "dashboards",
+                               "pipeline.json")) as f:
+            text = f.read()
+        for metric in ("flows_processed_total", "insert_count",
+                       "consumer_lag", "flow_processing_time_us"):
+            assert metric in text
+
+    def test_datasource_provisioning(self):
+        pg = load("grafana/datasources.yml")
+        ch = load("grafana/datasources-ch.yml")
+        assert {d["name"] for d in pg["datasources"]} == {"Prometheus",
+                                                          "PostgreSQL"}
+        assert any(d["type"].endswith("clickhouse-datasource")
+                   for d in ch["datasources"])

@@ -151,6 +151,36 @@ class TestCheckpointResume:
                                 int(oracle["packets"][i]),
                                 int(oracle["count"][i]))
 
+    def test_flush_triggers_snapshot(self, tmp_path):
+        # any flush that emitted rows must immediately snapshot+commit, not
+        # wait for the snapshot_every cadence (re-emission exposure)
+        import os
+
+        bus, _ = fill_bus(n=4000, rate=10.0)  # 400s -> a window closes mid-run
+        ckpt = str(tmp_path / "ckpt")
+        worker, sink = make_worker(bus, checkpoint=ckpt, snapshot_every=10**9)
+        while worker.run_once():
+            if sink.tables.get("flows_5m"):
+                break
+        assert sink.tables.get("flows_5m"), "test premise: a window must close"
+        assert os.path.isdir(ckpt), "snapshot must follow the first emission"
+        assert worker._emitted_since_snapshot is False
+
+    def test_old_checkpoint_fallback(self, tmp_path):
+        # crash between save_checkpoint's two renames leaves only .old;
+        # load/restore must fall back to it
+        import os
+
+        from flow_pipeline_tpu.engine.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        path = str(tmp_path / "ckpt")
+        save_checkpoint(path, {"v": 1})
+        os.rename(path, path + ".old")  # simulate mid-rename crash
+        assert load_checkpoint(path)["v"] == 1
+
     def test_restore_missing_returns_false(self, tmp_path):
         bus, _ = fill_bus(n=500)
         worker, _ = make_worker(bus, checkpoint=str(tmp_path / "nope"))

@@ -86,6 +86,15 @@ class TestMetrics:
         assert "lag 42" in reg.render()
         assert "# TYPE lag gauge" in reg.render()
 
+    def test_gauge_help_mentioning_counter_unmangled(self):
+        # regression: naive str.replace corrupted HELP text containing the
+        # word "counter" instead of the TYPE line
+        reg = MetricsRegistry()
+        reg.gauge("queue_depth", "items behind the counter").set(1)
+        text = reg.render()
+        assert "# HELP queue_depth items behind the counter" in text
+        assert "# TYPE queue_depth gauge" in text
+
     def test_summary_quantiles(self):
         reg = MetricsRegistry()
         s = reg.summary("latency_us", "lat")
