@@ -160,6 +160,17 @@ def _apply_backend(backend: str) -> None:
         jax.config.update("jax_platforms", "cpu")
 
 
+def _pg_dsn(dsn: str) -> str:
+    """Apply the $POSTGRES_PASSWORD fallback when the DSN has no password
+    (the reference's env fallback, ref: inserter/inserter.go:220-224)."""
+    import os
+
+    password = os.environ.get("POSTGRES_PASSWORD")
+    if password and "password" not in dsn:
+        dsn = f"{dsn} password={password}"
+    return dsn
+
+
 def _make_sinks(spec: str):
     from .sink import ClickHouseSink, PostgresSink, SQLiteSink, StdoutSink
 
@@ -171,12 +182,25 @@ def _make_sinks(spec: str):
         elif kind == "sqlite":
             sinks.append(SQLiteSink(arg or ":memory:"))
         elif kind == "postgres":
-            sinks.append(PostgresSink(arg))
+            sinks.append(PostgresSink(_pg_dsn(arg)))
         elif kind == "clickhouse":
             sinks.append(ClickHouseSink(arg or "http://localhost:8123"))
         else:
             raise ValueError(f"unknown sink {part!r}")
     return sinks
+
+
+def _start_metrics(addr: str, default_port: int):
+    """host:port -> started MetricsServer, or None when addr is empty.
+    The single parser for every subcommand's -metrics.addr flag."""
+    if not addr:
+        return None
+    host, _, port = addr.rpartition(":")
+    server = MetricsServer(int(port or default_port),
+                           host=host or "127.0.0.1").start()
+    log.info("metrics on http://%s:%d/metrics", host or "127.0.0.1",
+             server.port)
+    return server
 
 
 def _load_frames_bus(path: str, topic: str, partitions: int = 2):
@@ -218,11 +242,7 @@ def processor_main(argv=None) -> int:
             fixedlen=vals["proto.fixedlen"],
         )
         stop_when_idle = False
-    server = None
-    if vals["metrics.addr"]:
-        host, _, port = vals["metrics.addr"].partition(":")
-        server = MetricsServer(int(port or 8081), host=host or "127.0.0.1").start()
-        log.info("metrics on http://%s:%d/metrics", host, server.port)
+    server = _start_metrics(vals["metrics.addr"], 8081)
     worker = StreamWorker(
         consumer,
         _build_models(vals),
@@ -260,37 +280,47 @@ def inserter_main(argv=None) -> int:
     fs.integer("flush.count", 100, "Rows per flush")
     vals = fs.parse(argv if argv is not None else sys.argv[2:])
     set_level(vals["loglevel"])
-    import os
-
-    from .schema.batch import FlowBatch
     from .sink import PostgresSink, SQLiteSink
-    from .sink.base import rows_to_records  # noqa: F401 (re-export for sinks)
 
     if vals["postgres.dsn"]:
         dsn = vals["postgres.dsn"]
-        password = vals["postgres.pass"] or os.environ.get("POSTGRES_PASSWORD")
-        if password and "password" not in dsn:
-            dsn += f" password={password}"
-        sink = PostgresSink(dsn)
+        if vals["postgres.pass"] and "password" not in dsn:
+            dsn += f" password={vals['postgres.pass']}"
+        sink = PostgresSink(_pg_dsn(dsn))
     else:
         sink = SQLiteSink(vals["sqlite"] or ":memory:")
-    if not vals["in"]:
-        log.error("this environment has no Kafka client; use -in FILE")
-        return 2
-    bus = _load_frames_bus(vals["in"], vals["kafka.topic"])
-    from .transport import Consumer
+    if vals["in"]:
+        bus = _load_frames_bus(vals["in"], vals["kafka.topic"])
+        from .transport import Consumer
 
-    consumer = Consumer(bus, vals["kafka.topic"], group="postgres-inserter",
-                        fixedlen=True)
+        consumer = Consumer(bus, vals["kafka.topic"],
+                            group="postgres-inserter", fixedlen=True)
+        stop_when_idle = True
+    else:
+        from .transport import kafka as tkafka
+
+        if not tkafka.available():
+            log.error("no Kafka client in this environment; use -in FILE")
+            return 2
+        consumer = tkafka.KafkaConsumerAdapter(
+            vals["kafka.brokers"], vals["kafka.topic"],
+            group="postgres-inserter", fixedlen=vals["proto.fixedlen"],
+        )
+        stop_when_idle = False
     total = 0
-    while True:
-        batch = consumer.poll(vals["flush.count"])
-        if batch is None:
-            break
-        rows = _raw_rows(batch)
-        sink.write("flows", rows)
-        consumer.commit(batch.partition, batch.last_offset + 1)
-        total += len(batch)
+    try:
+        while True:
+            batch = consumer.poll(vals["flush.count"])
+            if batch is None:
+                if stop_when_idle:
+                    break
+                time.sleep(0.05)
+                continue
+            sink.write("flows", _raw_rows(batch))
+            consumer.commit(batch.partition, batch.last_offset + 1)
+            total += len(batch)
+    except KeyboardInterrupt:
+        pass
     log.info("inserted %d raw rows", total)
     return 0
 
@@ -348,12 +378,7 @@ def pipeline_main(argv=None) -> int:
     log.info("produced %d flows in %.2fs", produced, time.perf_counter() - t0)
 
     consumer = Consumer(bus, vals["kafka.topic"], fixedlen=True)
-    server = None
-    if vals["metrics.addr"]:
-        host, _, port = vals["metrics.addr"].partition(":")
-        server = MetricsServer(int(port or 8081), host=host or "127.0.0.1").start()
-        log.info("metrics on http://%s:%s/metrics", host or "127.0.0.1",
-                 server.port)
+    server = _start_metrics(vals["metrics.addr"], 8081)
     worker = StreamWorker(
         consumer,
         _build_models(vals),
@@ -372,11 +397,78 @@ def pipeline_main(argv=None) -> int:
     return 0
 
 
+def collector_main(argv=None) -> int:
+    """UDP flow collector (in-framework GoFlow replacement): listens for
+    sFlow on 6343 and NetFlow/IPFIX on 2055, produces FlowMessages."""
+    fs = _common_flags(FlagSet("collector"))
+    fs.string("listen.netflow", "0.0.0.0:2055", "NetFlow/IPFIX UDP addr "
+                                                "(empty disables)")
+    fs.string("listen.sflow", "0.0.0.0:6343", "sFlow UDP addr (empty disables)")
+    fs.string("metrics.addr", "127.0.0.1:8080", "host:port for /metrics")
+    fs.string("out", "", "Append frames to this file instead of Kafka")
+    fs.number("run.seconds", 0.0, "Exit after this long (0 = run forever)")
+    vals = fs.parse(argv if argv is not None else sys.argv[2:])
+    set_level(vals["loglevel"])
+    from .collector import CollectorConfig, CollectorServer
+
+    def parse_addr(s):
+        if not s:
+            return None
+        host, _, port = s.rpartition(":")
+        return (host or "0.0.0.0", int(port))  # UDP listen addr, not metrics
+
+    if vals["out"]:
+        from .schema import wire
+
+        out_f = open(vals["out"], "ab")
+
+        class FileProducer:
+            def send(self, msg):
+                out_f.write(wire.encode_frame(msg))
+
+        producer = FileProducer()
+    else:
+        from .transport import kafka as tkafka
+
+        if not tkafka.available():
+            log.error("no Kafka client; use -out FILE")
+            return 2
+        producer = tkafka.KafkaProducerAdapter(
+            vals["kafka.brokers"], vals["kafka.topic"], vals["proto.fixedlen"]
+        )
+    server = _start_metrics(vals["metrics.addr"], 8080)
+    collector = CollectorServer(
+        producer,
+        CollectorConfig(
+            netflow_addr=parse_addr(vals["listen.netflow"]),
+            sflow_addr=parse_addr(vals["listen.sflow"]),
+        ),
+    ).start()
+    try:
+        if vals["run.seconds"]:
+            time.sleep(vals["run.seconds"])
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        collector.stop()
+        if hasattr(producer, "flush"):
+            producer.flush()  # drain the async Kafka batch queue
+        if server:
+            server.stop()
+        if vals["out"]:
+            out_f.close()
+    return 0
+
+
 _COMMANDS = {
     "mocker": mocker_main,
     "processor": processor_main,
     "inserter": inserter_main,
     "pipeline": pipeline_main,
+    "collector": collector_main,
 }
 
 
@@ -384,7 +476,7 @@ def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if not argv or argv[0] in ("-h", "-help", "--help"):
         print("usage: flow_pipeline_tpu.cli <mocker|processor|inserter|"
-              "pipeline> [-flags]\nRun '<cmd> -help' for flags.")
+              "pipeline|collector> [-flags]\nRun '<cmd> -help' for flags.")
         return 0 if argv else 2
     cmd = _COMMANDS.get(argv[0])
     if cmd is None:
@@ -411,6 +503,10 @@ def inserter_entry() -> None:
 
 def pipeline_entry() -> None:
     sys.exit(main(["pipeline"] + sys.argv[1:]))
+
+
+def collector_entry() -> None:
+    sys.exit(main(["collector"] + sys.argv[1:]))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,155 @@
+"""Collector service: UDP listeners -> decoders -> Producer.
+
+Replaces the external GoFlow container (ref:
+compose/docker-compose-clickhouse-collect.yml:47-62) with in-framework
+listeners on the same ports (sFlow 6343, NetFlow/IPFIX 2055) and the same
+observed metric surface (SURVEY.md §2-C12), so the reference's perfs
+dashboard panels resolve against our /metrics:
+
+    udp_traffic_bytes / udp_traffic_packets
+    flow_traffic_bytes{type=...} / flow_traffic_packets{type=...}
+    flow_process_nf_flowset_records_sum / flow_process_nf_errors_count
+    flow_process_nf_templates_count
+    flow_process_sf_samples_sum{type=FlowSample}
+    flow_summary_decoding_time_us{name=...}
+    flow_decoder_count{worker=...}
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..obs import REGISTRY, get_logger
+from ..schema.message import FlowType
+from .netflow import TemplateCache, decode_netflow
+from .sflow import decode_sflow
+
+log = get_logger("collector")
+
+_TYPE_NAMES = {
+    FlowType.SFLOW_5: "sFlow",
+    FlowType.NETFLOW_V5: "NetFlow",
+    FlowType.NETFLOW_V9: "NetFlow",
+    FlowType.IPFIX: "NetFlow",
+}
+
+
+@dataclass
+class CollectorConfig:
+    netflow_addr: Optional[tuple[str, int]] = ("0.0.0.0", 2055)
+    sflow_addr: Optional[tuple[str, int]] = ("0.0.0.0", 6343)
+    recv_buf: int = 1 << 20
+
+
+class CollectorServer:
+    """Threaded UDP listeners feeding a Producer (bus or Kafka adapter)."""
+
+    def __init__(self, producer, config: CollectorConfig = CollectorConfig()):
+        self.producer = producer
+        self.config = config
+        self.templates = TemplateCache()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._sockets: list[socket.socket] = []
+        self.ports: dict[str, int] = {}
+
+        self.m_udp_bytes = REGISTRY.counter("udp_traffic_bytes")
+        self.m_udp_pkts = REGISTRY.counter("udp_traffic_packets")
+        self.m_flow_bytes = REGISTRY.counter("flow_traffic_bytes")
+        self.m_flow_pkts = REGISTRY.counter("flow_traffic_packets")
+        self.m_nf_records = REGISTRY.counter("flow_process_nf_flowset_records_sum")
+        self.m_nf_errors = REGISTRY.counter("flow_process_nf_errors_count")
+        self.m_nf_templates = REGISTRY.gauge("flow_process_nf_templates_count")
+        self.m_sf_samples = REGISTRY.counter("flow_process_sf_samples_sum")
+        self.m_decode_us = REGISTRY.summary("flow_summary_decoding_time_us")
+        self.m_workers = REGISTRY.gauge("flow_decoder_count")
+
+    # ---- datagram handling (also the direct test surface) -----------------
+
+    def handle_netflow(self, data: bytes, source: str = "") -> int:
+        self.m_udp_bytes.inc(len(data))
+        self.m_udp_pkts.inc()
+        t0 = time.perf_counter()
+        try:
+            msgs = decode_netflow(data, self.templates, source)
+        except ValueError as e:
+            self.m_nf_errors.inc()
+            log.debug("netflow decode error from %s: %s", source, e)
+            return 0
+        finally:
+            self.m_decode_us.observe((time.perf_counter() - t0) * 1e6)
+        self.m_nf_templates.set(len(self.templates))
+        self.m_nf_records.inc(len(msgs))
+        return self._publish(msgs)
+
+    def handle_sflow(self, data: bytes, source: str = "") -> int:
+        self.m_udp_bytes.inc(len(data))
+        self.m_udp_pkts.inc()
+        t0 = time.perf_counter()
+        try:
+            msgs = decode_sflow(data)
+        except ValueError as e:
+            self.m_nf_errors.inc()
+            log.debug("sflow decode error from %s: %s", source, e)
+            return 0
+        finally:
+            self.m_decode_us.observe((time.perf_counter() - t0) * 1e6)
+        self.m_sf_samples.inc(len(msgs), type="FlowSample")
+        return self._publish(msgs)
+
+    def _publish(self, msgs) -> int:
+        for m in msgs:
+            self.producer.send(m)
+            name = _TYPE_NAMES.get(m.type, "unknown")
+            self.m_flow_bytes.inc(m.bytes, type=name)
+            self.m_flow_pkts.inc(m.packets, type=name)
+        return len(msgs)
+
+    # ---- service lifecycle ------------------------------------------------
+
+    def start(self) -> "CollectorServer":
+        listeners = []
+        if self.config.netflow_addr:
+            listeners.append(("netflow", self.config.netflow_addr,
+                              self.handle_netflow))
+        if self.config.sflow_addr:
+            listeners.append(("sflow", self.config.sflow_addr,
+                              self.handle_sflow))
+        for name, addr, handler in listeners:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                            self.config.recv_buf)
+            sock.bind(addr)
+            sock.settimeout(0.2)
+            self._sockets.append(sock)
+            self.ports[name] = sock.getsockname()[1]
+            t = threading.Thread(
+                target=self._serve, args=(sock, handler, name),
+                name=f"collector-{name}", daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+            log.info("listening %s on %s:%d", name, addr[0], self.ports[name])
+        self.m_workers.set(len(self._threads), worker="udp")
+        return self
+
+    def _serve(self, sock, handler, name) -> None:
+        while not self._stop.is_set():
+            try:
+                data, addr = sock.recvfrom(65535)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            handler(data, f"{addr[0]}:{addr[1]}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        for s in self._sockets:
+            s.close()

@@ -69,6 +69,7 @@ class ClickHouseSink:
         records = rows_to_records(rows)
         if not records:
             return
+        ddl.assign_ranks(table, records)
         if table == "flows_5m":
             records = [
                 {self._FLOWS_5M_COLS.get(k, k): v for k, v in r.items()}

@@ -68,6 +68,33 @@ class TestCompose:
             ]
             assert any("fixedlen" in p["command"] for p in producers)
 
+    def test_clickhouse_grafana_has_plugin_and_ch_dashboards(self):
+        for path in ("compose/clickhouse-mock.yml",
+                     "compose/clickhouse-collect.yml"):
+            doc = load(path)
+            graf = doc["services"]["grafana"]
+            assert graf["environment"]["GF_INSTALL_PLUGINS"] == (
+                "grafana-clickhouse-datasource"
+            )
+            vols = "\n".join(graf["volumes"])
+            assert "dashboards-ch/traffic.json" in vols
+            assert "dashboards/pipeline.json" in vols
+            # every topology has prometheus for the pipeline dashboard
+            assert "prometheus" in doc["services"]
+
+    def test_postgres_processor_gets_password_env(self):
+        for path in ("compose/postgres-mock.yml",
+                     "compose/postgres-collect.yml"):
+            doc = load(path)
+            proc = doc["services"]["processor"]
+            assert "POSTGRES_PASSWORD" in proc["environment"]
+
+    def test_ch_dashboard_parses_and_uses_ch_datasource(self):
+        with open(os.path.join(DEPLOY, "grafana", "dashboards-ch",
+                               "traffic.json")) as f:
+            dash = json.load(f)
+        assert all(p["datasource"] == "ClickHouse" for p in dash["panels"])
+
 
 class TestPrometheus:
     def test_scrapes_processor(self):
