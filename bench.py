@@ -14,11 +14,19 @@ transfer excluded — the metric is the aggregation tier, the part that
 replaces ClickHouse's rollup), warm up the jit, then time a steady-state
 update loop round-robining over the staged batches, including one window
 close + top-K merge at the end, and block on the result.
+
+Modes (default ``hh`` is what the driver records):
+
+    python bench.py              # flagship heavy-hitter step, one JSON line
+    python bench.py decode       # native host decode throughput
+    python bench.py cms          # XLA scatter vs Pallas one-hot CMS update
+    python bench.py e2e          # full in-process pipeline flows/sec
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 
@@ -73,5 +81,97 @@ def main() -> None:
     )
 
 
+def bench_decode() -> None:
+    """Native host decode throughput (the feed path)."""
+    from flow_pipeline_tpu import native
+    from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+
+    if not native.available():
+        print(json.dumps({"error": "libflowdecode.so not built (make native)"}))
+        return
+    batch = FlowGenerator(ZipfProfile(), seed=1).batch(65536)
+    data = native.encode_stream(batch)
+    native.decode_stream(data)  # warm
+    t0 = time.perf_counter()
+    reps = 20
+    for _ in range(reps):
+        native.decode_stream(data)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "native protobuf->columnar decode",
+        "value": round(65536 * reps / dt, 1),
+        "unit": "flows/sec",
+        "vs_baseline": round(65536 * reps / dt / 100_000.0, 3),
+    }))
+
+
+def bench_cms() -> None:
+    """XLA scatter-add vs Pallas one-hot MXU kernel for the CMS update."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from flow_pipeline_tpu.ops.cms import cms_add, cms_init
+    from flow_pipeline_tpu.ops.cms_pallas import cms_add_pallas
+
+    rng = np.random.default_rng(0)
+    n, planes, depth, width = 4096, 3, 4, 1 << 16
+    keys = jnp.asarray(rng.integers(0, 2**31, size=(n, 8), dtype=np.int64)
+                       .astype(np.int32))
+    vals = jnp.asarray(rng.integers(1, 1500, size=(n, planes))
+                       .astype(np.float32))
+    valid = jnp.ones(n, bool)
+    on_tpu = jax.devices()[0].platform != "cpu"
+
+    results = {}
+    scatter = jax.jit(cms_add)
+    s = scatter(cms_init(planes, depth, width), keys, vals, valid)
+    jax.block_until_ready(s)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        s = scatter(s, keys, vals, valid)
+    jax.block_until_ready(s)
+    results["xla_scatter_us"] = round((time.perf_counter() - t0) / 20 * 1e6, 1)
+
+    p = cms_add_pallas(cms_init(planes, depth, width), keys, vals, valid,
+                       interpret=not on_tpu)
+    jax.block_until_ready(p)
+    t0 = time.perf_counter()
+    for _ in range(20 if on_tpu else 2):
+        p = cms_add_pallas(p, keys, vals, valid, interpret=not on_tpu)
+    jax.block_until_ready(p)
+    reps = 20 if on_tpu else 2
+    results["pallas_onehot_us"] = round((time.perf_counter() - t0) / reps * 1e6, 1)
+    results["pallas_compiled"] = on_tpu
+    print(json.dumps({"metric": "cms update step", "unit": "us/batch",
+                      **results}))
+
+
+def bench_e2e() -> None:
+    """Full in-process pipeline (host decode + device models + sinks)."""
+    from flow_pipeline_tpu.cli import main as cli_main
+
+    t0 = time.perf_counter()
+    cli_main(["pipeline", "-produce.count", "200000", "-produce.profile",
+              "zipf", "-processor.batch", "16384", "-sink", "stdout",
+              "-metrics.addr", "", "-loglevel", "warning"])
+    # the pipeline command logs its own rate; emit a coarse one here too
+    print(json.dumps({"metric": "e2e wall time (200k flows, all models)",
+                      "value": round(time.perf_counter() - t0, 2),
+                      "unit": "seconds"}))
+
+
 if __name__ == "__main__":
-    main()
+    mode = sys.argv[1] if len(sys.argv) > 1 else "hh"
+    if mode == "hh":
+        main()
+    elif mode == "decode":
+        bench_decode()
+    elif mode == "cms":
+        bench_cms()
+    elif mode == "e2e":
+        bench_e2e()
+    else:
+        print(json.dumps({"error": f"unknown mode {mode}"}))
+        sys.exit(2)

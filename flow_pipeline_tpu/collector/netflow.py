@@ -38,7 +38,7 @@ def decode_v5(data: bytes, now: Optional[int] = None) -> list[FlowMessage]:
     (_, count, sysuptime, unix_secs, _nsecs, seq, _etype, _eid,
      sampling) = _V5_HEADER.unpack_from(data, 0)
     sampling_rate = sampling & 0x3FFF  # top 2 bits are the sampling mode
-    now = unix_secs
+    now = now or unix_secs  # caller's receive time wins over exporter clock
     msgs = []
     off = _V5_HEADER.size
     for i in range(count):
@@ -187,15 +187,22 @@ def _record_from_fields(fields, data, off, flow_type, now, header_secs,
     return msg, off
 
 
-def _decode_templates(data, off, end, source, domain, cache, id_size=2):
+def _decode_templates(data, off, end, source, domain, cache):
     while off + 4 <= end:
         tid, fcount = struct.unpack_from(">HH", data, off)
         off += 4
         fields = []
         for _ in range(fcount):
+            # field specs must stay inside this flowset: an overstated count
+            # would otherwise swallow the next set's bytes and cache a
+            # corrupt template that mis-decodes every later record
+            if off + 4 > end:
+                raise ValueError("template field specs overrun flowset")
             ftype, flen = struct.unpack_from(">HH", data, off)
             off += 4
             if ftype & 0x8000:  # IPFIX enterprise field: skip the PEN
+                if off + 4 > end:
+                    raise ValueError("enterprise field PEN overruns flowset")
                 off += 4
                 ftype = 0  # unknown -> skipped at decode
             fields.append((ftype, flen))

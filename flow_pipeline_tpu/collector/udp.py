@@ -18,12 +18,13 @@ dashboard panels resolve against our /metrics:
 from __future__ import annotations
 
 import socket
+import struct
 import threading
 import time
 from dataclasses import dataclass
 from typing import Optional
 
-from ..obs import REGISTRY, get_logger
+from ..obs import REGISTRY, MetricsRegistry, get_logger
 from ..schema.message import FlowType
 from .netflow import TemplateCache, decode_netflow
 from .sflow import decode_sflow
@@ -48,7 +49,8 @@ class CollectorConfig:
 class CollectorServer:
     """Threaded UDP listeners feeding a Producer (bus or Kafka adapter)."""
 
-    def __init__(self, producer, config: CollectorConfig = CollectorConfig()):
+    def __init__(self, producer, config: CollectorConfig = CollectorConfig(),
+                 registry: MetricsRegistry = REGISTRY):
         self.producer = producer
         self.config = config
         self.templates = TemplateCache()
@@ -57,16 +59,17 @@ class CollectorServer:
         self._sockets: list[socket.socket] = []
         self.ports: dict[str, int] = {}
 
-        self.m_udp_bytes = REGISTRY.counter("udp_traffic_bytes")
-        self.m_udp_pkts = REGISTRY.counter("udp_traffic_packets")
-        self.m_flow_bytes = REGISTRY.counter("flow_traffic_bytes")
-        self.m_flow_pkts = REGISTRY.counter("flow_traffic_packets")
-        self.m_nf_records = REGISTRY.counter("flow_process_nf_flowset_records_sum")
-        self.m_nf_errors = REGISTRY.counter("flow_process_nf_errors_count")
-        self.m_nf_templates = REGISTRY.gauge("flow_process_nf_templates_count")
-        self.m_sf_samples = REGISTRY.counter("flow_process_sf_samples_sum")
-        self.m_decode_us = REGISTRY.summary("flow_summary_decoding_time_us")
-        self.m_workers = REGISTRY.gauge("flow_decoder_count")
+        self.m_udp_bytes = registry.counter("udp_traffic_bytes")
+        self.m_udp_pkts = registry.counter("udp_traffic_packets")
+        self.m_flow_bytes = registry.counter("flow_traffic_bytes")
+        self.m_flow_pkts = registry.counter("flow_traffic_packets")
+        self.m_nf_records = registry.counter("flow_process_nf_flowset_records_sum")
+        self.m_nf_errors = registry.counter("flow_process_nf_errors_count")
+        self.m_sf_errors = registry.counter("flow_process_sf_errors_count")
+        self.m_nf_templates = registry.gauge("flow_process_nf_templates_count")
+        self.m_sf_samples = registry.counter("flow_process_sf_samples_sum")
+        self.m_decode_us = registry.summary("flow_summary_decoding_time_us")
+        self.m_workers = registry.gauge("flow_decoder_count")
 
     # ---- datagram handling (also the direct test surface) -----------------
 
@@ -76,7 +79,10 @@ class CollectorServer:
         t0 = time.perf_counter()
         try:
             msgs = decode_netflow(data, self.templates, source)
-        except ValueError as e:
+        except (ValueError, struct.error) as e:
+            # struct.error covers malformed datagrams that trip fixed-layout
+            # unpacks before a bounds check — one spoofed packet must never
+            # kill the listener
             self.m_nf_errors.inc()
             log.debug("netflow decode error from %s: %s", source, e)
             return 0
@@ -92,8 +98,8 @@ class CollectorServer:
         t0 = time.perf_counter()
         try:
             msgs = decode_sflow(data)
-        except ValueError as e:
-            self.m_nf_errors.inc()
+        except (ValueError, struct.error) as e:
+            self.m_sf_errors.inc()
             log.debug("sflow decode error from %s: %s", source, e)
             return 0
         finally:
@@ -145,7 +151,10 @@ class CollectorServer:
                 continue
             except OSError:
                 break
-            handler(data, f"{addr[0]}:{addr[1]}")
+            try:
+                handler(data, f"{addr[0]}:{addr[1]}")
+            except Exception:  # noqa: BLE001 — the listener must survive
+                log.exception("unexpected %s handler failure", name)
 
     def stop(self) -> None:
         self._stop.set()

@@ -1,0 +1,52 @@
+"""Profiling/tracing hooks (SURVEY.md §5: the reference's closest analogue
+is GoFlow's per-stage latency summaries; here we add real device traces).
+
+- ``device_trace``: context manager around jax.profiler.trace — captures a
+  TensorBoard-loadable trace of everything the device executed.
+- ``StageTimer``: host-side per-stage wall-clock accumulation exposed as
+  the flow_summary_*_time_us metric family the reference dashboards chart.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+from .metrics import REGISTRY
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """Capture a jax.profiler trace into ``logdir`` (view with TensorBoard
+    or xprof). Usage:
+
+        with device_trace("/tmp/trace"):
+            run_some_batches()
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StageTimer:
+    """Named per-stage timers -> flow_summary_<stage>_time_us summaries."""
+
+    def __init__(self):
+        self._summaries = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        s = self._summaries.get(name)
+        if s is None:
+            s = REGISTRY.summary(f"flow_summary_{name}_time_us",
+                                 f"{name} stage wall time")
+            self._summaries[name] = s
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            s.observe((time.perf_counter() - t0) * 1e6)

@@ -188,11 +188,17 @@ class TestSFlow:
 
 class TestCollectorServer:
     def make(self):
+        from flow_pipeline_tpu.obs import MetricsRegistry
+
         bus = InProcessBus()
         bus.create_topic("flows", 1)
         producer = Producer(bus, fixedlen=True)
-        server = CollectorServer(producer, CollectorConfig(
-            netflow_addr=("127.0.0.1", 0), sflow_addr=("127.0.0.1", 0)))
+        server = CollectorServer(
+            producer,
+            CollectorConfig(netflow_addr=("127.0.0.1", 0),
+                            sflow_addr=("127.0.0.1", 0)),
+            registry=MetricsRegistry(),  # isolated from the global registry
+        )
         return bus, producer, server
 
     def test_handlers_and_metrics(self):
@@ -206,6 +212,39 @@ class TestCollectorServer:
         assert server.m_nf_errors.value() == 1
         assert server.m_flow_bytes.value(type="NetFlow") == 2001
         assert server.m_udp_pkts.value() == 3
+
+    def test_struct_error_datagrams_survive(self):
+        # crafted packets that trip fixed-layout unpacks (struct.error) must
+        # be counted as errors, never propagate out of the handlers
+        bus, producer, server = self.make()
+        trunc_tmpl = (struct.pack(">HHIIII", 9, 1, 0, NOW, 0, 1)
+                      + struct.pack(">HH", 0, 8) + struct.pack(">HH", 256, 10))
+        assert server.handle_netflow(trunc_tmpl) == 0
+        short_sflow = struct.pack(">II", 5, 2) + bytes(24)  # ipv6 agent cut
+        assert server.handle_sflow(short_sflow) == 0
+        lying_sample = (struct.pack(">II", 5, 1) + bytes([1, 2, 3, 4])
+                        + struct.pack(">IIII", 0, 1, 1, 1)
+                        + struct.pack(">II", 1, 400))  # sample len > datagram
+        assert server.handle_sflow(lying_sample) == 0
+        assert server.m_nf_errors.value() == 1
+        assert server.m_sf_errors.value() == 2  # sFlow errors separate metric
+        assert producer.produced == 0
+
+    def test_template_overrun_not_cached(self):
+        # fcount larger than the flowset body must not swallow the next set
+        cache = TemplateCache()
+        bad_tmpl = struct.pack(">HH", 256, 6) + struct.pack(">HHHH", 8, 4, 12, 4)
+        datagram = (struct.pack(">HHIIII", 9, 1, 0, NOW, 0, 1)
+                    + struct.pack(">HH", 0, 4 + len(bad_tmpl)) + bad_tmpl)
+        with pytest.raises(ValueError):
+            decode_netflow(datagram, cache)
+        assert len(cache) == 0
+
+    def test_v5_receive_time_parameter_wins(self):
+        msgs = decode_netflow(v5_datagram(), TemplateCache(), now=NOW + 500)
+        assert msgs[0].time_received == NOW + 500
+        # flow times still anchor to the exporter clock
+        assert msgs[0].time_flow_start == NOW - 10
 
     def test_udp_end_to_end(self):
         bus, producer, server = self.make()

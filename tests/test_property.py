@@ -1,0 +1,122 @@
+"""Property-based fuzzing (hypothesis): the wire codec and the exact
+aggregation path must hold for arbitrary well-typed inputs, not just
+generator-shaped ones."""
+
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from flow_pipeline_tpu.models.oracle import flows_5m
+from flow_pipeline_tpu.models.window_agg import WindowAggConfig, WindowAggregator
+from flow_pipeline_tpu.schema import (
+    FlowBatch,
+    FlowMessage,
+    decode_message,
+    encode_message,
+)
+
+u32 = st.integers(0, 2**32 - 1)
+u64 = st.integers(0, 2**64 - 1)
+u16 = st.integers(0, 2**16 - 1)
+u8 = st.integers(0, 255)
+addr = st.binary(min_size=0, max_size=16)
+
+messages = st.builds(
+    FlowMessage,
+    type=st.integers(0, 4),
+    time_received=u64,
+    sampling_rate=u64,
+    sequence_num=u32,
+    time_flow_start=u64,
+    time_flow_end=u64,
+    src_addr=addr,
+    dst_addr=addr,
+    sampler_address=addr,
+    bytes=u64,
+    packets=u64,
+    src_as=u32,
+    dst_as=u32,
+    in_if=u32,
+    out_if=u32,
+    proto=u8,
+    src_port=u16,
+    dst_port=u16,
+    ip_tos=u8,
+    forwarding_status=u8,
+    ip_ttl=u8,
+    tcp_flags=u8,
+    etype=u16,
+    icmp_type=u8,
+    icmp_code=u8,
+    ipv6_flow_label=st.integers(0, 2**20 - 1),
+    flow_direction=st.integers(0, 1),
+)
+
+
+class TestWireProperty:
+    @given(messages)
+    @settings(max_examples=200, deadline=None)
+    def test_roundtrip(self, msg):
+        assert decode_message(encode_message(msg)) == msg
+
+    @given(st.binary(max_size=64))
+    @settings(max_examples=200, deadline=None)
+    def test_decoder_never_crashes_unhandled(self, blob):
+        # arbitrary bytes either decode or raise ValueError — nothing else
+        try:
+            decode_message(blob)
+        except ValueError:
+            pass
+
+
+class TestWindowAggProperty:
+    @given(
+        st.lists(
+            st.tuples(
+                st.integers(1_000_000, 1_000_000 + 1800),  # time_received
+                st.integers(64000, 64004),  # src_as
+                st.integers(64000, 64004),  # dst_as
+                st.sampled_from([0x0800, 0x86DD]),  # etype
+                st.integers(0, 65535),  # bytes
+                st.integers(0, 100),  # packets
+            ),
+            min_size=1,
+            max_size=300,
+        ),
+        st.integers(1, 7),  # batch split factor
+    )
+    @settings(max_examples=30, deadline=None)
+    def test_matches_oracle_for_any_stream(self, rows, splits):
+        n = len(rows)
+        batch = FlowBatch.empty(n)
+        c = batch.columns
+        for i, (ts, sas, das, et, by, pk) in enumerate(rows):
+            c["time_received"][i] = ts
+            c["src_as"][i] = sas
+            c["dst_as"][i] = das
+            c["etype"][i] = et
+            c["bytes"][i] = by
+            c["packets"][i] = pk
+        agg = WindowAggregator(WindowAggConfig(batch_size=64))
+        # feed in arbitrary chunk sizes (exercises padding + chunking)
+        step = max(1, n // splits)
+        for start in range(0, n, step):
+            agg.update(batch.slice(start, start + step))
+        out = agg.flush(force=True)
+        oracle = flows_5m(batch)
+        assert len(out["timeslot"]) == len(oracle["timeslot"])
+        got = {
+            (int(t), int(s), int(d), int(e)): (int(b), int(p), int(cn))
+            for t, s, d, e, b, p, cn in zip(
+                out["timeslot"], out["src_as"], out["dst_as"], out["etype"],
+                out["bytes"], out["packets"], out["count"],
+            )
+        }
+        for i in range(len(oracle["timeslot"])):
+            key = (int(oracle["timeslot"][i]), int(oracle["src_as"][i]),
+                   int(oracle["dst_as"][i]), int(oracle["etype"][i]))
+            assert got[key] == (int(oracle["bytes"][i]),
+                                int(oracle["packets"][i]),
+                                int(oracle["count"][i]))
