@@ -150,6 +150,9 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.string("sink", "stdout", "stdout | sqlite:PATH | postgres:DSN | "
                                 "clickhouse:URL (comma separated)")
     fs.string("in", "", "Read frames from file instead of Kafka")
+    fs.string("listen.feed", "", "gRPC feed address (host:port) — accept "
+                                 "batches from colocated producers instead "
+                                 "of Kafka")
     return fs
 
 
@@ -227,41 +230,59 @@ def processor_main(argv=None) -> int:
     from .engine import StreamWorker, WorkerConfig
     from .transport import Consumer
 
-    if vals["in"]:
-        bus = _load_frames_bus(vals["in"], vals["kafka.topic"])
-        consumer = Consumer(bus, vals["kafka.topic"], fixedlen=True)
-        stop_when_idle = True
-    else:
-        from .transport import kafka as tkafka
-
-        if not tkafka.available():
-            log.error("no Kafka client; use -in FILE or `pipeline`")
-            return 2
-        consumer = tkafka.KafkaConsumerAdapter(
-            vals["kafka.brokers"], vals["kafka.topic"],
-            fixedlen=vals["proto.fixedlen"],
-        )
-        stop_when_idle = False
-    server = _start_metrics(vals["metrics.addr"], 8081)
-    worker = StreamWorker(
-        consumer,
-        _build_models(vals),
-        _make_sinks(vals["sink"]),
-        WorkerConfig(
-            poll_max=vals["processor.batch"],
-            snapshot_every=vals["flush.count"],
-            checkpoint_path=vals["checkpoint.path"] or None,
-        ),
-    )
-    if vals["checkpoint.path"]:
-        if worker.restore():
-            log.info("restored checkpoint from %s", vals["checkpoint.path"])
+    feed = None
+    server = None
     try:
-        worker.run(stop_when_idle=stop_when_idle)
-    except KeyboardInterrupt:
-        log.info("interrupt: draining")
-        worker.finalize()
+        if vals["in"]:
+            bus = _load_frames_bus(vals["in"], vals["kafka.topic"])
+            consumer = Consumer(bus, vals["kafka.topic"], fixedlen=True)
+            stop_when_idle = True
+        elif vals["listen.feed"]:
+            from .transport import InProcessBus
+            from .transport.feed import FeedServer
+
+            bus = InProcessBus()
+            feed = FeedServer(bus, vals["kafka.topic"],
+                              vals["listen.feed"]).start()
+            consumer = Consumer(bus, vals["kafka.topic"], fixedlen=True)
+            stop_when_idle = False
+        else:
+            from .transport import kafka as tkafka
+
+            if not tkafka.available():
+                log.error("no Kafka client; use -in FILE, -listen.feed, or "
+                          "`pipeline`")
+                return 2
+            consumer = tkafka.KafkaConsumerAdapter(
+                vals["kafka.brokers"], vals["kafka.topic"],
+                fixedlen=vals["proto.fixedlen"],
+            )
+            stop_when_idle = False
+        server = _start_metrics(vals["metrics.addr"], 8081)
+        worker = StreamWorker(
+            consumer,
+            _build_models(vals),
+            _make_sinks(vals["sink"]),
+            WorkerConfig(
+                poll_max=vals["processor.batch"],
+                snapshot_every=vals["flush.count"],
+                checkpoint_path=vals["checkpoint.path"] or None,
+            ),
+        )
+        if vals["checkpoint.path"]:
+            if worker.restore():
+                log.info("restored checkpoint from %s",
+                         vals["checkpoint.path"])
+        try:
+            worker.run(stop_when_idle=stop_when_idle)
+        except KeyboardInterrupt:
+            log.info("interrupt: draining")
+            worker.finalize()
     finally:
+        # covers setup failures after feed/metrics start (bad sink, restore
+        # error), not just the run loop
+        if feed:
+            feed.stop()
         if server:
             server.stop()
     log.info("processed %d flows in %d batches",

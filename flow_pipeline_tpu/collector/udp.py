@@ -39,7 +39,7 @@ _TYPE_NAMES = {
 }
 
 
-@dataclass
+@dataclass(frozen=True)
 class CollectorConfig:
     netflow_addr: Optional[tuple[str, int]] = ("0.0.0.0", 2055)
     sflow_addr: Optional[tuple[str, int]] = ("0.0.0.0", 6343)

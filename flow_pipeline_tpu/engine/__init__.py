@@ -18,6 +18,7 @@ counting (SURVEY.md §5 checkpoint/resume).
 from .worker import StreamWorker, WorkerConfig
 from .windowed import WindowedHeavyHitter
 from .checkpoint import save_checkpoint, load_checkpoint
+from .supervisor import Supervisor, SupervisorConfig
 
 __all__ = [
     "StreamWorker",
@@ -25,4 +26,6 @@ __all__ = [
     "WindowedHeavyHitter",
     "save_checkpoint",
     "load_checkpoint",
+    "Supervisor",
+    "SupervisorConfig",
 ]

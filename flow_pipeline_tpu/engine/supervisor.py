@@ -1,0 +1,78 @@
+"""Failure detection + elastic recovery for bare-metal deployments.
+
+The reference's recovery story is container-level: ``restart: always`` on
+every long-running compose service plus consumer-group rebalance
+(SURVEY.md §5 failure detection). Inside containers that still applies; for
+bare-metal/systemd-less runs this supervisor provides the same semantics in
+process: run a worker factory, restart on crash with exponential backoff,
+give up after ``max_restarts`` within ``window_seconds`` (a crash loop is a
+bug, not a transient).
+
+The worker's checkpoint/offset machinery makes restarts safe: a fresh
+worker restores the snapshot and resumes from committed offsets, so crashes
+cost at most the unsnapshotted tail, never double counting.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+from ..obs import REGISTRY, get_logger
+
+log = get_logger("supervisor")
+
+
+@dataclass(frozen=True)
+class SupervisorConfig:
+    max_restarts: int = 5
+    window_seconds: float = 300.0
+    backoff_initial: float = 0.5
+    backoff_max: float = 30.0
+
+
+class Supervisor:
+    """run() calls ``factory()`` to build a worker and invokes
+    ``worker.run(**run_kwargs)``; on exception it rebuilds (factory should
+    wire restore()) and retries with backoff."""
+
+    def __init__(self, factory: Callable, config: SupervisorConfig = SupervisorConfig(),
+                 **run_kwargs):
+        self.factory = factory
+        self.config = config
+        self.run_kwargs = run_kwargs
+        self.restarts = 0
+        self.m_restarts = REGISTRY.counter("worker_restarts_total",
+                                           "supervisor worker restarts")
+
+    def run(self) -> None:
+        crash_times: list[float] = []
+        backoff = self.config.backoff_initial
+        while True:
+            worker = self.factory()
+            try:
+                worker.run(**self.run_kwargs)
+                return  # clean exit
+            except KeyboardInterrupt:
+                worker.finalize()
+                raise
+            except Exception as e:  # noqa: BLE001 — the supervisor's job
+                now = time.monotonic()
+                recent = [
+                    t for t in crash_times
+                    if now - t < self.config.window_seconds
+                ]
+                if not recent:  # healthy era since the last crash burst
+                    backoff = self.config.backoff_initial
+                crash_times = recent + [now]
+                self.restarts += 1
+                self.m_restarts.inc()
+                if len(crash_times) > self.config.max_restarts:
+                    log.error("crash loop (%d crashes in %.0fs); giving up",
+                              len(crash_times), self.config.window_seconds)
+                    raise
+                log.exception("worker crashed (%s); restarting in %.1fs",
+                              e, backoff)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, self.config.backoff_max)

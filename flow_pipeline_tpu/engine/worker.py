@@ -24,7 +24,7 @@ from .windowed import WindowedHeavyHitter
 log = get_logger("worker")
 
 
-@dataclass
+@dataclass(frozen=True)
 class WorkerConfig:
     poll_max: int = 8192
     snapshot_every: int = 50  # batches between snapshots (0 = never)

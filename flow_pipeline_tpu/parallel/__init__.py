@@ -24,6 +24,7 @@ from .sharded import (
     sharded_hh_update,
     sharded_hh_merge,
 )
+from .multihost import init_distributed, LocalShardFeeder
 
 __all__ = [
     "make_mesh",
@@ -32,4 +33,6 @@ __all__ = [
     "ShardedWindowAggregator",
     "sharded_hh_update",
     "sharded_hh_merge",
+    "init_distributed",
+    "LocalShardFeeder",
 ]

@@ -1,0 +1,82 @@
+"""Multi-host scale-out over DCN.
+
+Single-host meshes span a chip pod slice over ICI; beyond one host, JAX's
+distributed runtime extends the same mesh over DCN — the framework's
+equivalent of the reference scaling Kafka consumers across machines
+(SURVEY.md §2: "jax collectives over ICI ..., DCN for multi-host").
+
+Nothing in the kernels or models changes: the sharded pipelines in
+parallel.sharded already address devices through a Mesh, and psum /
+all_gather lower to cross-host collectives automatically. What multi-host
+adds is process bootstrap + per-process data feeding, wrapped here:
+
+    init_distributed(coordinator, num_processes, process_id)
+    mesh = make_mesh()                       # now spans all hosts' devices
+    feeder = LocalShardFeeder(mesh)          # per-host batch placement
+    model = ShardedHeavyHitter(config, mesh)
+    model.state = ...                        # as usual
+
+Each host consumes its own bus partitions (the Kafka consumer-group
+assignment IS the data-parallel split) and places its rows on its local
+devices with make_array_from_process_local_data.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+
+def init_distributed(coordinator_address: str, num_processes: int,
+                     process_id: int, local_device_ids=None) -> None:
+    """jax.distributed bootstrap (idempotent). coordinator_address is
+    host:port of process 0; every process calls this before building meshes
+    AND before any other jax call (backend init must not have happened yet —
+    which is also why the guard below must not touch devices/process_count)."""
+    if num_processes <= 1:
+        return  # single-process: nothing to do
+    from jax._src import distributed as _dist
+
+    if getattr(_dist.global_state, "client", None) is not None:
+        return  # already initialized
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+
+
+class LocalShardFeeder:
+    """Builds global device arrays from per-process local rows.
+
+    On host h with L local devices out of G global, feed() takes the rows
+    this host consumed (local_rows == global_rows / (G/L) after padding)
+    and returns a global jax.Array row-sharded over the mesh without any
+    cross-host data movement — each host supplies exactly its devices'
+    shards.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str = DATA_AXIS):
+        self.mesh = mesh
+        self.axis = axis
+        self.sharding = NamedSharding(mesh, P(axis))
+
+    def feed_columns(self, cols: dict, valid: np.ndarray):
+        if jax.process_count() == 1:
+            out = {
+                k: jax.device_put(v, self.sharding) for k, v in cols.items()
+            }
+            return out, jax.device_put(valid, self.sharding)
+        out = {
+            k: jax.make_array_from_process_local_data(self.sharding, v)
+            for k, v in cols.items()
+        }
+        return out, jax.make_array_from_process_local_data(
+            self.sharding, valid
+        )

@@ -1,0 +1,132 @@
+"""gRPC batch feed: external producers -> colocated TPU worker.
+
+The north star wires the Go collector side to "shell protobuf batches to a
+colocated JAX worker via gRPC" (BASELINE.json north_star). This module is
+that seam, defined without codegen so any language can call it with a raw
+bytes codec:
+
+    service: /flowtpu.Feed/Publish   (unary)
+      request:  a concatenation of length-prefixed FlowMessage frames
+                (the -proto.fixedlen wire format producers already speak)
+      response: 8-byte big-endian count of frames accepted
+
+The server lands frames on an InProcessBus topic, where the normal
+Consumer/StreamWorker loop picks them up — the gRPC hop replaces Kafka for
+colocated deployments, with the same at-least-once offset machinery
+downstream. A Go client needs ~10 lines: grpc.Invoke with codec=rawCodec.
+"""
+
+from __future__ import annotations
+
+import struct
+from concurrent import futures
+from typing import Optional
+
+from ..obs import REGISTRY, get_logger
+from ..schema import wire
+from .bus import InProcessBus
+
+log = get_logger("feed")
+
+METHOD = "/flowtpu.Feed/Publish"
+
+_IMPORT_ERROR: Optional[str] = None
+try:  # pragma: no cover - environment dependent
+    import grpc
+except Exception as e:  # noqa: BLE001
+    grpc = None
+    _IMPORT_ERROR = str(e)
+
+
+def available() -> bool:
+    return grpc is not None
+
+
+class FeedServer:
+    """Receives frame blobs over gRPC and produces them onto a bus topic."""
+
+    def __init__(self, bus: InProcessBus, topic: str = "flows",
+                 address: str = "127.0.0.1:0", max_workers: int = 4):
+        if not available():
+            raise RuntimeError(f"grpcio not importable ({_IMPORT_ERROR})")
+        self.bus = bus
+        self.topic = topic
+        bus.create_topic(topic)
+        self.m_frames = REGISTRY.counter("feed_frames_total",
+                                         "frames accepted over the feed")
+        self.m_bytes = REGISTRY.counter("feed_bytes_total",
+                                        "payload bytes over the feed")
+
+        outer = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                if handler_call_details.method != METHOD:
+                    return None
+                return grpc.unary_unary_rpc_method_handler(
+                    outer._publish,
+                    request_deserializer=None,  # raw bytes in
+                    response_serializer=None,  # raw bytes out
+                )
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self.port = self._server.add_insecure_port(address)
+        if self.port == 0:
+            raise RuntimeError(f"feed could not bind {address!r}")
+
+    def _publish(self, request: bytes, context) -> bytes:
+        # validate the WHOLE stream before producing anything: a malformed
+        # tail must not leave a partial batch on the bus (the client will
+        # retry the whole blob and double-count the prefix)
+        try:
+            frames = list(wire.iter_raw_frames(request))
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"malformed frame stream: {e}")
+        for frame in frames:
+            self.bus.produce(self.topic, frame)
+        self.m_frames.inc(len(frames))
+        self.m_bytes.inc(len(request))
+        return struct.pack(">Q", len(frames))
+
+    def start(self) -> "FeedServer":
+        self._server.start()
+        log.info("feed listening on port %d", self.port)
+        return self
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace).wait()
+
+
+class FeedClient:
+    """Publishes FlowMessage batches to a FeedServer."""
+
+    def __init__(self, target: str):
+        if not available():
+            raise RuntimeError(f"grpcio not importable ({_IMPORT_ERROR})")
+        self._channel = grpc.insecure_channel(target)
+        self._publish = self._channel.unary_unary(
+            METHOD, request_serializer=None, response_deserializer=None
+        )
+
+    def publish_frames(self, data: bytes) -> int:
+        """Send pre-framed bytes; returns frames accepted."""
+        resp = self._publish(data)
+        return struct.unpack(">Q", resp)[0]
+
+    def publish_messages(self, msgs) -> int:
+        return self.publish_frames(wire.encode_stream(msgs))
+
+    def publish_batch(self, batch) -> int:
+        """Columnar batch -> native encode (fast path) -> publish."""
+        from .. import native
+
+        if native.available():
+            return self.publish_frames(native.encode_stream(batch))
+        return self.publish_messages(batch.to_messages())
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,128 @@
+"""gRPC feed seam + supervisor recovery tests."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.engine import Supervisor, SupervisorConfig
+from flow_pipeline_tpu.schema.message import FlowMessage
+from flow_pipeline_tpu.transport import Consumer, InProcessBus
+
+feed = pytest.importorskip("flow_pipeline_tpu.transport.feed")
+if not feed.available():  # pragma: no cover
+    pytest.skip("grpcio unavailable", allow_module_level=True)
+
+
+class TestFeed:
+    def make(self):
+        bus = InProcessBus()
+        server = feed.FeedServer(bus, address="127.0.0.1:0").start()
+        client = feed.FeedClient(f"127.0.0.1:{server.port}")
+        return bus, server, client
+
+    def test_publish_messages_lands_on_bus(self):
+        bus, server, client = self.make()
+        try:
+            msgs = [FlowMessage(bytes=i + 1, packets=1, src_as=65000)
+                    for i in range(10)]
+            assert client.publish_messages(msgs) == 10
+            cons = Consumer(bus, fixedlen=True)
+            got = []
+            while (batch := cons.poll()) is not None:  # one batch/partition
+                got.extend(batch.columns["bytes"].tolist())
+            assert sorted(got) == list(range(1, 11))
+        finally:
+            client.close()
+            server.stop()
+
+    def test_publish_batch_native_path(self):
+        from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+
+        bus, server, client = self.make()
+        try:
+            batch = FlowGenerator(ZipfProfile(n_keys=20), seed=3).batch(500)
+            assert client.publish_batch(batch) == 500
+            cons = Consumer(bus, fixedlen=True)
+            total_rows = 0
+            total_bytes = 0
+            while (got := cons.poll(1000)) is not None:
+                total_rows += len(got)
+                total_bytes += int(got.columns["bytes"].sum())
+            assert total_rows == 500
+            assert total_bytes == int(batch.columns["bytes"].sum())
+        finally:
+            client.close()
+            server.stop()
+
+    def test_malformed_stream_rejected(self):
+        import grpc
+
+        bus, server, client = self.make()
+        try:
+            with pytest.raises(grpc.RpcError) as e:
+                client.publish_frames(b"\xff\xff\xff garbage")
+            assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        finally:
+            client.close()
+            server.stop()
+
+    def test_concurrent_publishers(self):
+        bus, server, client2 = self.make()
+        clients = [feed.FeedClient(f"127.0.0.1:{server.port}")
+                   for _ in range(4)]
+        try:
+            def blast(c):
+                c.publish_messages([FlowMessage(bytes=1)] * 100)
+
+            threads = [threading.Thread(target=blast, args=(c,))
+                       for c in clients]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            total = sum(bus.end_offset("flows", p)
+                        for p in range(bus.partitions("flows")))
+            assert total == 400
+        finally:
+            for c in clients:
+                c.close()
+            client2.close()
+            server.stop()
+
+
+class TestSupervisor:
+    def test_restarts_until_success(self):
+        attempts = []
+
+        class Flaky:
+            def run(self):
+                attempts.append(1)
+                if len(attempts) < 3:
+                    raise RuntimeError("transient")
+
+            def finalize(self):
+                pass
+
+        sup = Supervisor(Flaky, SupervisorConfig(backoff_initial=0.01))
+        sup.run()
+        assert len(attempts) == 3
+        assert sup.restarts == 2
+
+    def test_crash_loop_gives_up(self):
+        class AlwaysCrashes:
+            def run(self):
+                raise RuntimeError("permanent")
+
+            def finalize(self):
+                pass
+
+        sup = Supervisor(
+            AlwaysCrashes,
+            SupervisorConfig(max_restarts=2, backoff_initial=0.01,
+                             backoff_max=0.02),
+        )
+        with pytest.raises(RuntimeError, match="permanent"):
+            sup.run()
+        assert sup.restarts == 3  # 2 allowed restarts + the final crash
