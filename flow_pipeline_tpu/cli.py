@@ -111,31 +111,58 @@ def _build_models(vals):
     )
 
     batch = vals["processor.batch"]
+    n_mesh = vals.get("processor.mesh", 0)
+    mesh = None
+    if n_mesh:
+        from .parallel import make_mesh
+
+        mesh = make_mesh(n_mesh)
     models = {}
     if vals["model.flows5m"]:
-        models["flows_5m"] = WindowAggregator(
-            WindowAggConfig(batch_size=batch,
-                            allowed_lateness=vals["window.lateness"])
-        )
+        cfg = WindowAggConfig(batch_size=batch,
+                              allowed_lateness=vals["window.lateness"])
+        if mesh:
+            from .parallel import ShardedWindowAggregator
+
+            models["flows_5m"] = ShardedWindowAggregator(cfg, mesh)
+        else:
+            models["flows_5m"] = WindowAggregator(cfg)
     if vals["model.talkers"]:
-        models["top_talkers"] = WindowedHeavyHitter(
-            HeavyHitterConfig(
-                key_cols=("src_addr", "dst_addr", "src_port", "dst_port",
-                          "proto"),
-                batch_size=batch,
-                width=vals["sketch.width"],
-                capacity=vals["sketch.capacity"],
-            ),
-            k=vals["sketch.topk"],
+        hh_cfg = HeavyHitterConfig(
+            key_cols=("src_addr", "dst_addr", "src_port", "dst_port",
+                      "proto"),
+            batch_size=batch,
+            width=vals["sketch.width"],
+            capacity=vals["sketch.capacity"],
         )
+        if mesh:
+            from .parallel import ShardedHeavyHitter
+
+            models["top_talkers"] = WindowedHeavyHitter(
+                hh_cfg, k=vals["sketch.topk"],
+                model_cls=ShardedHeavyHitter, mesh=mesh,
+            )
+        else:
+            models["top_talkers"] = WindowedHeavyHitter(
+                hh_cfg, k=vals["sketch.topk"]
+            )
     if vals["model.ddos"]:
-        models["ddos_alerts"] = DDoSDetector(DDoSConfig(batch_size=batch))
+        if mesh:
+            from .parallel import ShardedDDoSDetector
+
+            models["ddos_alerts"] = ShardedDDoSDetector(
+                DDoSConfig(batch_size=batch), mesh
+            )
+        else:
+            models["ddos_alerts"] = DDoSDetector(DDoSConfig(batch_size=batch))
     return models
 
 
 def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.string("processor.backend", "tpu", "tpu | cpu (jax platform hint)")
-    fs.integer("processor.batch", 8192, "Device batch rows")
+    fs.integer("processor.batch", 8192, "Device batch rows (per chip)")
+    fs.integer("processor.mesh", 0, "Shard models over this many devices "
+                                    "(0 = single chip)")
     fs.boolean("model.flows5m", True, "Exact 5m rollup model")
     fs.boolean("model.talkers", True, "5-tuple top-K talkers model")
     fs.boolean("model.ddos", True, "DDoS spike detector")

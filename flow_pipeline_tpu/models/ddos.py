@@ -10,10 +10,11 @@ quantile-sketch on Packets". Design:
   population quantile sketch (ops.quantile). A bucket alarms when both
   z >= z_threshold and rate >= quantile(q) — the quantile gate suppresses
   "3 sigma above a tiny baseline" noise.
-- Bucket -> address inversion: a last-writer-wins [M, 4] address store
-  updated by scatter, good enough to name the attacked destination in the
-  alert (hash collisions can mislabel within a bucket; the alert carries
-  the bucket id for exact drill-down via the heavy-hitter model).
+- Bucket -> address inversion: an [M, 4] witness store holding the dst of
+  the largest single flow seen in the bucket this sub-window — deterministic
+  under a flood even when several dsts hash-collide into one bucket (the
+  alert also carries the bucket id for exact drill-down via the
+  heavy-hitter model).
 
 All state is mergeable across chips: rates and the histogram sum (psum);
 the EW fold happens once per sub-window on the merged rates.
@@ -55,7 +56,8 @@ class DDoSState(NamedTuple):
     seen: jnp.ndarray  # [M] bool
     rates: jnp.ndarray  # [M] current sub-window accumulator
     hist: jnp.ndarray  # [B] quantile sketch of historical rates
-    addrs: jnp.ndarray  # [M, 4] last-writer dst address per bucket
+    addrs: jnp.ndarray  # [M, 4] witness dst address per bucket
+    wmax: jnp.ndarray  # [M] largest single-flow value seen this sub-window
 
 
 def ddos_init(config: DDoSConfig, spec: QuantileSketchSpec) -> DDoSState:
@@ -67,23 +69,39 @@ def ddos_init(config: DDoSConfig, spec: QuantileSketchSpec) -> DDoSState:
         rates=jnp.zeros(config.n_buckets, jnp.float32),
         hist=spec.init(),
         addrs=jnp.zeros((config.n_buckets, 4), jnp.uint32),
+        wmax=jnp.zeros(config.n_buckets, jnp.float32),
     )
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("state",))
 def ddos_accumulate(state: DDoSState, cols: dict, valid, *, config: DDoSConfig):
-    """Scatter one batch into the current sub-window."""
+    """Scatter one batch into the current sub-window.
+
+    The batch is first collapsed to per-dst sums (sort_groupby), so the
+    scatter sees each dst once — fewer conflicts AND a meaningful witness:
+    the bucket's witness address is the dst with the largest per-batch SUM
+    (a thousand 1-packet flood flows beat one benign 2-packet flow), not
+    the largest single flow or an arbitrary last writer.
+    """
+    from ..ops.segment import sort_groupby_float
+
     dst = cols["dst_addr"].astype(jnp.uint32)
-    buckets = ewma_ops.bucket_of(dst, config.n_buckets)
     # uint32 reinterpretation keeps saturated counters (>2^31) positive
     vals = cols[config.value_col].astype(jnp.uint32).astype(jnp.float32)
-    rates = ewma_ops.rate_accumulate(state.rates, buckets, vals, valid)
-    # Last-writer-wins address inversion. Invalid rows go to index
-    # n_buckets: out of range HIGH, which mode="drop" discards (a negative
-    # index would wrap to the last bucket before the drop check).
-    safe_buckets = jnp.where(valid, buckets, config.n_buckets)
-    addrs = state.addrs.at[safe_buckets].set(dst, mode="drop")
-    return state._replace(rates=rates, addrs=addrs)
+    uniq, sums, counts = sort_groupby_float(dst, vals[:, None], valid)
+    row_valid = counts > 0
+    dsums = sums[:, 0]
+    buckets = ewma_ops.bucket_of(uniq, config.n_buckets)
+    rates = ewma_ops.rate_accumulate(state.rates, buckets, dsums, row_valid)
+    # Invalid rows go to index n_buckets: out of range HIGH, which
+    # mode="drop" discards (a negative index would wrap before the check).
+    safe_buckets = jnp.where(row_valid, buckets, config.n_buckets)
+    masked = jnp.where(row_valid, dsums, -1.0)
+    wmax = state.wmax.at[safe_buckets].max(masked, mode="drop")
+    is_witness = row_valid & (masked >= wmax[buckets])
+    witness_buckets = jnp.where(is_witness, buckets, config.n_buckets)
+    addrs = state.addrs.at[witness_buckets].set(uniq, mode="drop")
+    return state._replace(rates=rates, addrs=addrs, wmax=wmax)
 
 
 @partial(jax.jit, static_argnames=("config", "spec"), donate_argnames=("state",))
@@ -102,6 +120,7 @@ def ddos_close_window(state: DDoSState, *, config: DDoSConfig, spec: QuantileSke
     new_state = state._replace(
         mean=mean, var=var, seen=seen,
         rates=jnp.zeros_like(state.rates), hist=hist,
+        wmax=jnp.zeros_like(state.wmax),
     )
     return new_state, z, state.rates
 
@@ -157,25 +176,30 @@ class DDoSDetector:
         self.state, z, rates = ddos_close_window(
             self.state, config=self.config, spec=self.spec
         )
+        return self._emit_alerts(z, rates, self.state.hist, self.state.addrs)
+
+    def _emit_alerts(self, z, rates, hist, addrs) -> list[dict]:
+        """Shared gating + alert construction (single-chip and sharded)."""
         self.folds += 1
         if self.folds <= self.config.warmup_windows:
             return []
         z = np.asarray(z)
         rates = np.asarray(rates)
-        gate = self.spec.quantile(np.asarray(self.state.hist), self.config.quantile)
-        hot = np.nonzero((z >= self.config.z_threshold) & (rates >= max(gate, 1.0)))[0]
-        new = []
-        addrs = np.asarray(self.state.addrs)
-        for b in hot:
-            new.append(
-                {
-                    "sub_window": self.current_sub,
-                    "bucket": int(b),
-                    "dst_addr": addrs[b].astype(np.uint32),
-                    "rate": float(rates[b]),
-                    "zscore": float(z[b]),
-                    "baseline_quantile": float(gate),
-                }
-            )
+        gate = self.spec.quantile(np.asarray(hist), self.config.quantile)
+        hot = np.nonzero(
+            (z >= self.config.z_threshold) & (rates >= max(gate, 1.0))
+        )[0]
+        addrs = np.asarray(addrs)
+        new = [
+            {
+                "sub_window": self.current_sub,
+                "bucket": int(b),
+                "dst_addr": addrs[b].astype(np.uint32),
+                "rate": float(rates[b]),
+                "zscore": float(z[b]),
+                "baseline_quantile": float(gate),
+            }
+            for b in hot
+        ]
         self.alerts.extend(new)
         return new

@@ -19,6 +19,7 @@ Multi-host runs extend the same mesh over DCN: jax.distributed.initialize()
 
 from .mesh import make_mesh, shard_batch_columns
 from .sharded import (
+    ShardedDDoSDetector,
     ShardedHeavyHitter,
     ShardedWindowAggregator,
     sharded_hh_update,
@@ -29,6 +30,7 @@ from .multihost import init_distributed, LocalShardFeeder
 __all__ = [
     "make_mesh",
     "shard_batch_columns",
+    "ShardedDDoSDetector",
     "ShardedHeavyHitter",
     "ShardedWindowAggregator",
     "sharded_hh_update",

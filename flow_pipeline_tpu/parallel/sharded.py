@@ -25,6 +25,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from ..models import ddos as ddos_mod
 from ..models import heavy_hitter as hh
 from ..models.window_agg import WindowAggConfig, WindowAggregator
 from ..ops import topk as topk_ops
@@ -210,3 +211,110 @@ class ShardedWindowAggregator(WindowAggregator):
             self._merge_partials(
                 keys[d], plane_sums[d], counts_np[d], int(ns[d])
             )
+
+
+# ---------------------------------------------------------------------------
+# DDoS detection, sharded
+# ---------------------------------------------------------------------------
+
+
+class ShardedDDoSDetector(ddos_mod.DDoSDetector):
+    """Multi-chip DDoS detector.
+
+    Per-chip scatter into rate/witness shards on the hot path; sub-window
+    close merges over ICI: psum for the rates (a monoid), and an
+    all_gather + argmax-by-wmax pick of the witness addresses (the chip
+    that saw the heaviest per-dst contribution supplies the address —
+    elementwise maxing would splice words of different addresses). The EW
+    baseline and the quantile histogram then fold once on the merged rates,
+    identically on every chip, so mean/var/seen/hist stay replicated with
+    no further collectives.
+    """
+
+    def __init__(self, config: ddos_mod.DDoSConfig = ddos_mod.DDoSConfig(),
+                 mesh: Mesh | None = None):
+        super().__init__(config)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_dev = self.mesh.devices.size
+        spec_obj = self.spec
+        cfg = config
+
+        def acc_per_chip(state, cols, valid):
+            state = jax.tree.map(lambda x: x[0], state)
+            new = ddos_mod.ddos_accumulate.__wrapped__(
+                state, cols, valid, config=cfg
+            )
+            return jax.tree.map(lambda x: x[None], new)
+
+        state_spec = ddos_mod.DDoSState(
+            *([P(DATA_AXIS)] * len(ddos_mod.DDoSState._fields))
+        )
+        self._acc = jax.jit(
+            shard_map(
+                acc_per_chip, mesh=self.mesh,
+                in_specs=(state_spec, P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=state_spec, check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+        def close_per_chip(state):
+            s = jax.tree.map(lambda x: x[0], state)
+            rates = lax.psum(s.rates, DATA_AXIS)
+            # hist is NOT psum'd: after each close every chip adds the same
+            # merged rates into its replica, so the replicas stay identical —
+            # summing them would multiply historical mass by n_dev per window
+            # (geometric blow-up of the quantile gate).
+            # witness merge: per bucket, take the address from the chip that
+            # saw the heaviest per-dst sum (elementwise pmax would splice
+            # words of different addresses together)
+            wmax_all = lax.all_gather(s.wmax, DATA_AXIS)  # [n_dev, M]
+            addrs_all = lax.all_gather(s.addrs, DATA_AXIS)  # [n_dev, M, 4]
+            winner = jnp.argmax(wmax_all, axis=0)  # [M]
+            addrs = jnp.take_along_axis(
+                addrs_all, winner[None, :, None], axis=0
+            )[0]
+            wmax = jnp.max(wmax_all, axis=0)
+            merged = s._replace(rates=rates, addrs=addrs, wmax=wmax)
+            new, z, r = ddos_mod.ddos_close_window.__wrapped__(
+                merged, config=cfg, spec=spec_obj
+            )
+            return jax.tree.map(lambda x: x[None], new), z[None], r[None]
+
+        self._close = jax.jit(
+            shard_map(
+                close_per_chip, mesh=self.mesh, in_specs=(state_spec,),
+                out_specs=(state_spec, P(DATA_AXIS), P(DATA_AXIS)),
+                check_vma=False,
+            )
+        )
+        # re-stack the single-chip init state onto the device axis
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        self.state = jax.tree.map(
+            lambda x: jax.device_put(
+                jnp.broadcast_to(x[None], (self.n_dev,) + x.shape), sharding
+            ),
+            self.state,
+        )
+
+    @property
+    def global_batch(self) -> int:
+        return self.config.batch_size * self.n_dev
+
+    def _accumulate(self, batch: FlowBatch) -> None:
+        gb = self.global_batch
+        for start in range(0, len(batch), gb):
+            padded, mask = batch.slice(start, start + gb).pad_to(gb)
+            cols = padded.device_columns(["dst_addr", self.config.value_col])
+            cols, valid = shard_batch_columns(self.mesh, cols, mask)
+            self.state = self._acc(self.state, cols, valid)
+
+    def close_sub_window(self) -> list[dict]:
+        self.state, z_stack, rates_stack = self._close(self.state)
+        # every chip computed the same merged scores; read chip 0's replicas
+        return self._emit_alerts(
+            np.asarray(z_stack)[0],
+            np.asarray(rates_stack)[0],
+            self.state.hist[0],
+            self.state.addrs[0],
+        )

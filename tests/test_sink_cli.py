@@ -126,6 +126,21 @@ class TestCLI:
         conn = sqlite3.connect(db)
         assert conn.execute("SELECT SUM(count) FROM flows_5m").fetchone()[0] == 1500
 
+    def test_pipeline_with_mesh(self, tmp_path):
+        # -processor.mesh 8 runs the sharded models over the CPU mesh
+        db = str(tmp_path / "mesh.db")
+        rc = main([
+            "pipeline", "-produce.count", "4000", "-produce.rate", "40",
+            "-processor.batch", "128", "-processor.mesh", "8",
+            "-sink", f"sqlite:{db}", "-metrics.addr", "",
+            "-model.ddos=false", "-sketch.width", str(1 << 12),
+            "-sketch.capacity", "64",
+        ])
+        assert rc == 0
+        conn = sqlite3.connect(db)
+        assert conn.execute("SELECT SUM(count) FROM flows_5m").fetchone()[0] == 4000
+        assert conn.execute("SELECT COUNT(*) FROM top_talkers").fetchone()[0] > 0
+
     def test_mocker_then_inserter_raw_rows(self, tmp_path):
         frames = str(tmp_path / "frames.bin")
         db = str(tmp_path / "raw.db")
