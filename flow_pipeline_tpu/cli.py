@@ -55,6 +55,14 @@ def _make_generator(vals):
                          rate=vals["produce.rate"])
 
 
+def _batch_frames(batch):
+    """Per-frame bytes for a batch (bus produce needs one message per
+    frame; file writers should use batch.to_wire() directly)."""
+    from .schema import wire
+
+    return wire.iter_raw_frames(batch.to_wire())
+
+
 def mocker_main(argv=None) -> int:
     fs = _common_flags(FlagSet("mocker"))
     _gen_flags(fs)
@@ -72,9 +80,7 @@ def mocker_main(argv=None) -> int:
         with open(vals["out"], "wb") as f:
             while total == 0 or written < total:
                 n = min(vals["produce.batch"], total - written) if total else vals["produce.batch"]
-                batch = gen.batch(n)
-                for m in batch.to_messages():
-                    f.write(wire.encode_frame(m))
+                f.write(gen.batch(n).to_wire())
                 written += n
                 if total == 0 and written % (vals["produce.batch"] * 64) == 0:
                     log.info("produced %d frames", written)
@@ -420,8 +426,8 @@ def pipeline_main(argv=None) -> int:
     produced = 0
     while produced < vals["produce.count"]:
         n = min(8192, vals["produce.count"] - produced)
-        for m in gen.batch(n).to_messages():
-            bus.produce(vals["kafka.topic"], wire.encode_frame(m))
+        for frame in _batch_frames(gen.batch(n)):
+            bus.produce(vals["kafka.topic"], frame)
         produced += n
     log.info("produced %d flows in %.2fs", produced, time.perf_counter() - t0)
 

@@ -158,6 +158,8 @@ class StreamWorker:
         models_state: dict[str, Any] = {}
         for name, model in self.models.items():
             if isinstance(model, WindowAggregator):
+                model._drain()  # fold pending device partials first: the
+                # snapshot must cover everything the committed offsets cover
                 models_state[name] = {
                     "kind": "window_agg",
                     "windows": model.windows,

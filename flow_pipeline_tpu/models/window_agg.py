@@ -90,6 +90,10 @@ class WindowAggregator:
         # windows: timeslot -> {key tuple -> uint64 [**values, count]}
         self.windows: dict[int, dict[tuple, np.ndarray]] = {}
         self.watermark = 0  # max time_received seen
+        # device partials not yet folded into `windows`: jax dispatch is
+        # async, so keeping results as device arrays until a flush needs
+        # them lets the next chunk's sort overlap the previous transfer
+        self._pending_partials: list = []
 
     def update(self, batch: FlowBatch) -> None:
         if len(batch) == 0:
@@ -110,10 +114,29 @@ class WindowAggregator:
             ).items()
         }
         keys, sums, counts, n = self._update(cols, jnp.asarray(mask))
-        n = int(n)
-        # slice on device: transfer only the n real group rows
-        self._merge_partials(np.asarray(keys[:n]), np.asarray(sums[:n]),
-                             np.asarray(counts[:n]), n)
+        self._pending_partials.append((keys, sums, counts, n))
+        # bound the deferral: a flush-free caller (huge update() loops) must
+        # not pin unbounded padded buffers on device
+        if len(self._pending_partials) >= 32:
+            self._drain()
+
+    def _drain(self) -> None:
+        pending, self._pending_partials = self._pending_partials, []
+        for keys, sums, counts, n in pending:
+            if keys.ndim == 3:  # stacked per-chip partials (sharded variant)
+                ns = np.asarray(n)
+                keys_np = np.asarray(keys)
+                sums_np = np.asarray(sums)
+                counts_np = np.asarray(counts)
+                for d in range(keys_np.shape[0]):
+                    self._merge_partials(keys_np[d], sums_np[d],
+                                         counts_np[d], int(ns[d]))
+            else:
+                n = int(n)  # first host sync for this chunk
+                # slice on device: transfer only the n real group rows
+                self._merge_partials(np.asarray(keys[:n]),
+                                     np.asarray(sums[:n]),
+                                     np.asarray(counts[:n]), n)
 
     def _merge_partials(self, keys, plane_sums, counts, n) -> None:
         """Fold device partial aggregates (keys + 16-bit value planes +
@@ -138,6 +161,7 @@ class WindowAggregator:
             acc[nvals] += counts[i]
 
     def closed_slots(self) -> list[int]:
+        self._drain()
         limit = self.watermark - self.config.allowed_lateness
         return sorted(
             s for s in self.windows if s + self.config.window_seconds <= limit
@@ -145,6 +169,7 @@ class WindowAggregator:
 
     def flush(self, force: bool = False) -> dict[str, np.ndarray]:
         """Pop finalized windows (all, if force) as columnar rows."""
+        self._drain()
         slots = sorted(self.windows) if force else self.closed_slots()
         rows_ts, rows_key, rows_val = [], [], []
         for slot in slots:

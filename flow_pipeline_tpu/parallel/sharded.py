@@ -202,15 +202,10 @@ class ShardedWindowAggregator(WindowAggregator):
             ["time_received", *self.config.key_cols, *self.config.value_cols]
         )
         cols, valid = shard_batch_columns(self.mesh, cols, mask)
-        keys, sums, counts, ns = self._sharded(cols, valid)
-        keys = np.asarray(keys)
-        plane_sums = np.asarray(sums)
-        counts_np = np.asarray(counts)
-        ns = np.asarray(ns)
-        for d in range(self.n_dev):
-            self._merge_partials(
-                keys[d], plane_sums[d], counts_np[d], int(ns[d])
-            )
+        # stacked partials stay on device until a flush drains them
+        self._pending_partials.append(self._sharded(cols, valid))
+        if len(self._pending_partials) >= 32:  # bound device-memory pinning
+            self._drain()
 
 
 # ---------------------------------------------------------------------------

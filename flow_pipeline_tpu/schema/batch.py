@@ -134,6 +134,16 @@ class FlowBatch:
         msgs = wire.decode_frames(data) if framed else [wire.decode_message(data)]
         return FlowBatch.from_messages(msgs)
 
+    def to_wire(self) -> bytes:
+        """Length-prefixed frame stream for the whole batch — the single
+        place that picks the native bulk encoder over the pure-Python path
+        (mirrors from_wire)."""
+        from .. import native  # local import: native is optional
+
+        if native.available():
+            return native.encode_stream(self)
+        return wire.encode_stream(self.to_messages())
+
     # ---- views ------------------------------------------------------------
 
     def __len__(self) -> int:

@@ -121,12 +121,8 @@ class FeedClient:
         return self.publish_frames(wire.encode_stream(msgs))
 
     def publish_batch(self, batch) -> int:
-        """Columnar batch -> native encode (fast path) -> publish."""
-        from .. import native
-
-        if native.available():
-            return self.publish_frames(native.encode_stream(batch))
-        return self.publish_messages(batch.to_messages())
+        """Columnar batch -> frame stream (native when built) -> publish."""
+        return self.publish_frames(batch.to_wire())
 
     def close(self) -> None:
         self._channel.close()
