@@ -26,11 +26,48 @@ Modes (default ``hh`` is what the driver records):
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
+_PLATFORM = None
+
+
+def _resolve_platform(probe_timeout: float = 90.0) -> str:
+    """Probe the backend in a subprocess; fall back to CPU when the backend
+    wedges (a dead session can hold the single chip's grant and the client
+    then blocks forever in backend init — a benchmark must degrade, not
+    deadlock). The child reports the platform it actually got, so a
+    CPU-only machine is labeled honestly rather than assumed to be a TPU."""
+    global _PLATFORM
+    if _PLATFORM:
+        return _PLATFORM
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        _PLATFORM = "cpu"
+        return _PLATFORM
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            timeout=probe_timeout, check=True, capture_output=True, text=True,
+        )
+        _PLATFORM = out.stdout.strip().splitlines()[-1] or "unknown"
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        _PLATFORM = "cpu"
+    if _PLATFORM == "cpu":
+        # Env alone is not enough here: the environment's sitecustomize
+        # registers the TPU backend and overrides jax_platforms via config
+        # at interpreter start, so re-force it after import too.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    return _PLATFORM
+
 
 def main() -> None:
+    platform = _PLATFORM or _resolve_platform()
     import jax
     import jax.numpy as jnp
 
@@ -76,6 +113,7 @@ def main() -> None:
                 "value": round(flows_per_sec, 1),
                 "unit": "flows/sec",
                 "vs_baseline": round(flows_per_sec / baseline, 3),
+                "platform": platform,
             }
         )
     )
@@ -164,6 +202,7 @@ def bench_e2e() -> None:
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "hh"
+    _resolve_platform()  # every mode uses jax; none may deadlock on a wedged chip
     if mode == "hh":
         main()
     elif mode == "decode":
