@@ -35,34 +35,12 @@ _PLATFORM = None
 
 
 def _resolve_platform(probe_timeout: float = 90.0) -> str:
-    """Probe the backend in a subprocess; fall back to CPU when the backend
-    wedges (a dead session can hold the single chip's grant and the client
-    then blocks forever in backend init — a benchmark must degrade, not
-    deadlock). The child reports the platform it actually got, so a
-    CPU-only machine is labeled honestly rather than assumed to be a TPU."""
+    """Shared probe-or-degrade logic (utils.platform), memoized per run."""
     global _PLATFORM
-    if _PLATFORM:
-        return _PLATFORM
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        _PLATFORM = "cpu"
-        return _PLATFORM
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            timeout=probe_timeout, check=True, capture_output=True, text=True,
-        )
-        _PLATFORM = out.stdout.strip().splitlines()[-1] or "unknown"
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        _PLATFORM = "cpu"
-    if _PLATFORM == "cpu":
-        # Env alone is not enough here: the environment's sitecustomize
-        # registers the TPU backend and overrides jax_platforms via config
-        # at interpreter start, so re-force it after import too.
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
+    if not _PLATFORM:
+        from flow_pipeline_tpu.utils.platform import resolve_platform
 
-        jax.config.update("jax_platforms", "cpu")
+        _PLATFORM = resolve_platform(probe_timeout)
     return _PLATFORM
 
 

@@ -1,0 +1,65 @@
+"""Platform selection helpers.
+
+This environment's sitecustomize registers the TPU backend at interpreter
+start and overrides ``jax_platforms`` through jax.config, so the
+``JAX_PLATFORMS`` env var alone cannot force CPU — and an accidental TPU
+claim can block forever when a dead session holds the single chip's grant.
+Every entry point that must honor or decide the platform goes through
+here.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def force_cpu() -> None:
+    """Pin this process to the CPU backend (env var for child processes,
+    config update because sitecustomize overrides the env var)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def cpu_requested() -> bool:
+    """True iff JAX_PLATFORMS names cpu as the only platform ("tpu,cpu"
+    priority lists are NOT a CPU request)."""
+    return [
+        p.strip() for p in os.environ.get("JAX_PLATFORMS", "").split(",")
+        if p.strip()
+    ] == ["cpu"]
+
+
+def honor_platform_env() -> None:
+    """Enforce an explicit CPU-only request through jax.config."""
+    if cpu_requested():
+        force_cpu()
+
+
+def resolve_platform(probe_timeout: float = 90.0) -> str:
+    """Decide the platform for a benchmark/driver run.
+
+    CPU-only request -> 'cpu' (enforced). Otherwise probe backend init in a
+    subprocess: the child reports the platform it actually got (so a
+    CPU-only machine is never mislabeled), and a timeout/failure — the
+    wedged-chip case — degrades to CPU instead of deadlocking.
+    """
+    if cpu_requested():
+        force_cpu()
+        return "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            timeout=probe_timeout, check=True, capture_output=True, text=True,
+        )
+        lines = out.stdout.strip().splitlines()
+        platform = lines[-1] if lines else "unknown"
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        platform = "cpu"
+    if platform == "cpu":
+        force_cpu()
+    return platform

@@ -7,20 +7,18 @@ before jax is imported anywhere.
 
 import os
 
-# Force CPU: the environment may pre-register a TPU backend and override
-# JAX_PLATFORMS via jax.config at interpreter start (sitecustomize), so the
-# env var alone is not enough — update the config again after import. Two
-# concurrent test runs must never race for the single real TPU chip.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Force CPU (shared helper: utils.platform documents why the env var alone
+# is not enough in this environment). Two concurrent test runs must never
+# race for the single real TPU chip. XLA_FLAGS must be set before import.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax
+from flow_pipeline_tpu.utils.platform import force_cpu
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu()
 
 import numpy as np
 import pytest
