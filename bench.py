@@ -26,8 +26,6 @@ Modes (default ``hh`` is what the driver records):
 from __future__ import annotations
 
 import json
-import os
-import subprocess
 import sys
 import time
 

@@ -186,6 +186,8 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.string("listen.feed", "", "gRPC feed address (host:port) — accept "
                                  "batches from colocated producers instead "
                                  "of Kafka")
+    fs.string("query.addr", "", "Live query API host:port (O(K) top-K / "
+                                "open windows / alerts; empty disables)")
     return fs
 
 
@@ -226,16 +228,28 @@ def _make_sinks(spec: str):
     return sinks
 
 
+def _host_port(addr: str, default_port: int,
+               default_host: str = "127.0.0.1") -> tuple[str, int]:
+    """Parse "host:port" / ":port" / "host" / "port" with clear errors —
+    the single address parser for every listen-style flag."""
+    host, sep, port = addr.rpartition(":")
+    if not sep:  # no colon: bare port number or bare hostname
+        if addr.isdigit():
+            host, port = "", addr
+        else:
+            host, port = addr, ""
+    if port and not port.isdigit():
+        raise ValueError(f"invalid port in address {addr!r}")
+    return host or default_host, int(port) if port else default_port
+
+
 def _start_metrics(addr: str, default_port: int):
-    """host:port -> started MetricsServer, or None when addr is empty.
-    The single parser for every subcommand's -metrics.addr flag."""
+    """host:port -> started MetricsServer, or None when addr is empty."""
     if not addr:
         return None
-    host, _, port = addr.rpartition(":")
-    server = MetricsServer(int(port or default_port),
-                           host=host or "127.0.0.1").start()
-    log.info("metrics on http://%s:%d/metrics", host or "127.0.0.1",
-             server.port)
+    host, port = _host_port(addr, default_port)
+    server = MetricsServer(port, host=host).start()
+    log.info("metrics on http://%s:%d/metrics", host, server.port)
     return server
 
 
@@ -265,6 +279,7 @@ def processor_main(argv=None) -> int:
 
     feed = None
     server = None
+    query = None
     try:
         if vals["in"]:
             bus = _load_frames_bus(vals["in"], vals["kafka.topic"])
@@ -302,6 +317,11 @@ def processor_main(argv=None) -> int:
                 checkpoint_path=vals["checkpoint.path"] or None,
             ),
         )
+        if vals["query.addr"]:
+            from .engine.query_api import QueryServer
+
+            qhost, qport = _host_port(vals["query.addr"], 8082)
+            query = QueryServer(worker, qport, qhost).start()
         if vals["checkpoint.path"]:
             if worker.restore():
                 log.info("restored checkpoint from %s",
@@ -314,6 +334,8 @@ def processor_main(argv=None) -> int:
     finally:
         # covers setup failures after feed/metrics start (bad sink, restore
         # error), not just the run loop
+        if query:
+            query.stop()
         if feed:
             feed.stop()
         if server:
@@ -468,8 +490,7 @@ def collector_main(argv=None) -> int:
     def parse_addr(s):
         if not s:
             return None
-        host, _, port = s.rpartition(":")
-        return (host or "0.0.0.0", int(port))  # UDP listen addr, not metrics
+        return _host_port(s, 0, default_host="0.0.0.0")  # UDP listen addr
 
     if vals["out"]:
         from .schema import wire

@@ -10,6 +10,7 @@ partitions — the sarama consumer-group model (ref: inserter/inserter.go:
 from __future__ import annotations
 
 import os
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
@@ -53,6 +54,9 @@ class StreamWorker:
         # offsets covered by state (committable after next snapshot/flush)
         self._covered: dict[int, int] = {}
         self._emitted_since_snapshot = False
+        # Guards model/window state against concurrent readers (the live
+        # query API); the worker holds it across each run_once step.
+        self.lock = threading.Lock()
         self.m_flows = REGISTRY.counter("flows_processed_total",
                                         "flows decoded and aggregated")
         self.m_batches = REGISTRY.counter("batches_processed_total",
@@ -70,6 +74,10 @@ class StreamWorker:
         batch = self.consumer.poll(self.config.poll_max)
         if batch is None or len(batch) == 0:
             return False
+        with self.lock:
+            return self._process(batch)
+
+    def _process(self, batch) -> bool:
         t0 = time.perf_counter()
         for model in self.models.values():
             model.update(batch)
@@ -136,8 +144,9 @@ class StreamWorker:
 
     def finalize(self) -> None:
         """Drain everything (end of stream / shutdown)."""
-        self.flush_closed(force=True)
-        self.snapshot_and_commit()
+        with self.lock:
+            self.flush_closed(force=True)
+            self.snapshot_and_commit()
         if hasattr(self.consumer, "lag"):
             self.m_lag.set(self.consumer.lag())
 

@@ -22,6 +22,7 @@ the EW fold happens once per sub-window on the merged rates.
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass
 from functools import partial
 from typing import NamedTuple
@@ -134,7 +135,8 @@ class DDoSDetector:
         self.state = ddos_init(config, self.spec)
         self.current_sub = None  # sub-window start
         self.folds = 0  # closed sub-windows; alerts suppressed during warmup
-        self.alerts: list[dict] = []
+        self.alerts: list[dict] = []  # drained by the worker per flush
+        self.recent = deque(maxlen=1000)  # retained for live queries
 
     def update(self, batch: FlowBatch) -> None:
         if len(batch) == 0:
@@ -202,4 +204,5 @@ class DDoSDetector:
             for b in hot
         ]
         self.alerts.extend(new)
+        self.recent.extend(new)
         return new

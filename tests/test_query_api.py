@@ -1,0 +1,100 @@
+"""Live query API tests: O(K) top-K / open windows / alerts served straight
+off a running worker's models."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from flow_pipeline_tpu.engine import StreamWorker, WindowedHeavyHitter, WorkerConfig
+from flow_pipeline_tpu.engine.query_api import QueryServer
+from flow_pipeline_tpu.gen import FlowGenerator, MockerProfile, ZipfProfile
+from flow_pipeline_tpu.models import (
+    DDoSConfig,
+    DDoSDetector,
+    HeavyHitterConfig,
+    WindowAggConfig,
+    WindowAggregator,
+)
+from flow_pipeline_tpu.sink import MemorySink
+from flow_pipeline_tpu.transport import Consumer, InProcessBus, Producer
+
+
+@pytest.fixture
+def served_worker():
+    bus = InProcessBus()
+    bus.create_topic("flows", 1)
+    gen = FlowGenerator(ZipfProfile(n_keys=100, alpha=1.3), seed=91,
+                        t0=1_699_999_800, rate=50.0)
+    prod = Producer(bus, fixedlen=True)
+    for _ in range(4):
+        prod.send_many(gen.batch(500).to_messages())
+    worker = StreamWorker(
+        Consumer(bus, fixedlen=True),
+        {
+            "flows_5m": WindowAggregator(WindowAggConfig(batch_size=512)),
+            "top_talkers": WindowedHeavyHitter(
+                HeavyHitterConfig(batch_size=512, width=1 << 12, capacity=64),
+                k=10,
+            ),
+            "ddos_alerts": DDoSDetector(DDoSConfig(batch_size=512,
+                                                   n_buckets=256)),
+        },
+        [MemorySink()],
+        WorkerConfig(snapshot_every=0),
+    )
+    while worker.run_once():  # drain the bus but do NOT finalize: the open
+        pass  # window must stay live, which is what the API exists to serve
+    server = QueryServer(worker, port=0).start()
+    yield worker, server
+    server.stop()
+
+
+def get(server, path):
+    return json.loads(
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{path}"
+        ).read()
+    )
+
+
+class TestQueryAPI:
+    def test_healthz(self, served_worker):
+        worker, server = served_worker
+        h = get(server, "/healthz")
+        assert h["ok"] and h["flows_seen"] == 2000
+        assert set(h["models"]) == {"flows_5m", "top_talkers", "ddos_alerts"}
+
+    def test_topk_open_window(self, served_worker):
+        worker, server = served_worker
+        t = get(server, "/topk?k=5")
+        assert t["model"] == "top_talkers"
+        assert t["window_start"] is not None
+        assert 0 < len(t["rows"]) <= 5
+        row = t["rows"][0]
+        assert row["src_addr"].startswith("2001:db8:0:1::")
+        assert row["bytes"] > 0
+
+    def test_windows(self, served_worker):
+        worker, server = served_worker
+        w = get(server, "/windows")
+        assert w["model"] == "flows_5m"
+        assert w["watermark"] > 0
+        assert w["open_windows"]  # something still open after the stream
+
+    def test_alerts_empty_on_steady(self, served_worker):
+        worker, server = served_worker
+        assert get(server, "/alerts")["alerts"] == []
+
+    def test_errors(self, served_worker):
+        worker, server = served_worker
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(server, "/nope")
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(server, "/topk?model=flows_5m")  # wrong model kind
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(server, "/topk?model=ghost")
+        assert e.value.code == 400
