@@ -463,11 +463,19 @@ def pipeline_main(argv=None) -> int:
                      snapshot_every=vals["flush.count"],
                      checkpoint_path=vals["checkpoint.path"] or None),
     )
+    query = None
+    if vals["query.addr"]:
+        from .engine.query_api import QueryServer
+
+        qhost, qport = _host_port(vals["query.addr"], 8082)
+        query = QueryServer(worker, qport, qhost).start()
     t0 = time.perf_counter()
     worker.run(stop_when_idle=True)
     dt = time.perf_counter() - t0
     log.info("aggregated %d flows in %.2fs (%.0f flows/sec)",
              worker.flows_seen, dt, worker.flows_seen / max(dt, 1e-9))
+    if query:
+        query.stop()
     if server:
         server.stop()
     return 0
