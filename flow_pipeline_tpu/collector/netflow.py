@@ -116,20 +116,36 @@ class TemplateCache:
 
     Templates arrive in-band; data sets that reference an unseen template
     are counted and skipped (the GoFlow behavior behind its
-    flow_process_nf_errors_count metric)."""
+    flow_process_nf_errors_count metric). Options templates are tracked
+    separately: their data records carry exporter-wide state — notably the
+    sampling interval — which is cached per (source, domain) and applied to
+    flow records that do not carry an inline sampling field."""
 
     templates: dict[tuple, list[tuple[int, int]]] = field(default_factory=dict)
+    options: set = field(default_factory=set)  # keys that are options templates
+    sampling: dict[tuple, int] = field(default_factory=dict)  # (src, dom) -> rate
     missing: int = 0
 
     def put(self, source: str, domain: int, tid: int,
-            fields: list[tuple[int, int]]) -> None:
-        self.templates[(source, domain, tid)] = fields
+            fields: list[tuple[int, int]], is_options: bool = False) -> None:
+        key = (source, domain, tid)
+        self.templates[key] = fields
+        if is_options:
+            self.options.add(key)
+        else:
+            self.options.discard(key)
 
     def get(self, source: str, domain: int, tid: int):
         t = self.templates.get((source, domain, tid))
         if t is None:
             self.missing += 1
         return t
+
+    def is_options(self, source: str, domain: int, tid: int) -> bool:
+        return (source, domain, tid) in self.options
+
+    def exporter_sampling(self, source: str, domain: int) -> int:
+        return self.sampling.get((source, domain), 0)
 
     def __len__(self) -> int:
         return len(self.templates)
@@ -140,15 +156,22 @@ def _uint(b: bytes) -> int:
 
 
 def _record_from_fields(fields, data, off, flow_type, now, header_secs,
-                        sysuptime, seq) -> tuple[FlowMessage, int]:
+                        sysuptime, seq) -> tuple[FlowMessage, int, bool]:
+    """Returns (msg, new offset, has_inline_sampling). The flag matters:
+    sampling_rate defaults to 1, so 'field absent' and 'explicit inline 1'
+    (unsampled flows from an otherwise-sampling exporter) are otherwise
+    indistinguishable to the exporter-rate inheritance."""
     msg = FlowMessage(type=flow_type, time_received=now, sequence_num=seq,
                       sampling_rate=1)
     times = {}
     etype = 0x0800
+    has_sampling = False
     for ftype, flen in fields:
         raw = data[off : off + flen]
         off += flen
         if ftype in _INT_FIELDS:
+            if ftype in _SAMPLING_FIELDS:
+                has_sampling = True
             setattr(msg, _INT_FIELDS[ftype], _uint(raw))
         elif ftype in _ADDR4_FIELDS:
             attr = _ADDR4_FIELDS[ftype]
@@ -184,30 +207,89 @@ def _record_from_fields(fields, data, off, flow_type, now, header_secs,
         msg.time_flow_start = now
     if not msg.time_flow_end:
         msg.time_flow_end = msg.time_flow_start
-    return msg, off
+    return msg, off, has_sampling
 
 
-def _decode_templates(data, off, end, source, domain, cache):
+def _read_field_specs(data, off, end, count, enterprise: bool):
+    """``enterprise`` is the IPFIX PEN rule (bit 15 => 4 extra bytes);
+    NetFlow v9 has no such encoding, so its callers pass False — a v9
+    vendor field type >= 0x8000 is just a type, not a length change."""
+    fields = []
+    for _ in range(count):
+        # field specs must stay inside this flowset: an overstated count
+        # would otherwise swallow the next set's bytes and cache a
+        # corrupt template that mis-decodes every later record
+        if off + 4 > end:
+            raise ValueError("template field specs overrun flowset")
+        ftype, flen = struct.unpack_from(">HH", data, off)
+        off += 4
+        if enterprise and ftype & 0x8000:  # IPFIX enterprise: skip the PEN
+            if off + 4 > end:
+                raise ValueError("enterprise field PEN overruns flowset")
+            off += 4
+            ftype = 0  # unknown -> skipped at decode
+        fields.append((ftype, flen))
+    return fields, off
+
+
+def _decode_templates(data, off, end, source, domain, cache,
+                      enterprise=False):
     while off + 4 <= end:
         tid, fcount = struct.unpack_from(">HH", data, off)
         off += 4
-        fields = []
-        for _ in range(fcount):
-            # field specs must stay inside this flowset: an overstated count
-            # would otherwise swallow the next set's bytes and cache a
-            # corrupt template that mis-decodes every later record
-            if off + 4 > end:
-                raise ValueError("template field specs overrun flowset")
-            ftype, flen = struct.unpack_from(">HH", data, off)
-            off += 4
-            if ftype & 0x8000:  # IPFIX enterprise field: skip the PEN
-                if off + 4 > end:
-                    raise ValueError("enterprise field PEN overruns flowset")
-                off += 4
-                ftype = 0  # unknown -> skipped at decode
-            fields.append((ftype, flen))
+        fields, off = _read_field_specs(data, off, end, fcount, enterprise)
         cache.put(source, domain, tid, fields)
     return off
+
+
+def _decode_options_templates_v9(data, off, end, source, domain, cache):
+    """v9 options template: tid, scope length (bytes), options length
+    (bytes), then scope + option field specs (RFC 3954 §6.1)."""
+    while off + 6 <= end:
+        tid, scope_len, opt_len = struct.unpack_from(">HHH", data, off)
+        off += 6
+        if tid == 0:  # padding
+            break
+        n_fields = (scope_len + opt_len) // 4
+        fields, off = _read_field_specs(data, off, end, n_fields,
+                                        enterprise=False)
+        cache.put(source, domain, tid, fields, is_options=True)
+    return off
+
+
+def _decode_options_templates_ipfix(data, off, end, source, domain, cache):
+    """IPFIX options template: tid, total field count, scope field count,
+    then the field specs (RFC 7011 §3.4.2.2)."""
+    while off + 6 <= end:
+        tid, fcount, _scope_count = struct.unpack_from(">HHH", data, off)
+        off += 6
+        if tid == 0:  # padding
+            break
+        fields, off = _read_field_specs(data, off, end, fcount,
+                                        enterprise=True)
+        cache.put(source, domain, tid, fields, is_options=True)
+    return off
+
+
+# option-data field types carrying the exporter's sampling interval
+_SAMPLING_FIELDS = {34, 305}  # SAMPLING_INTERVAL, samplingPacketInterval
+
+
+def _decode_options_data(fields, data, off, end, source, domain, cache):
+    """Scan option data records for a sampling interval; cache it
+    exporter-wide."""
+    rec_len = sum(flen for _, flen in fields)
+    if rec_len <= 0:
+        return
+    while off + rec_len <= end:
+        p = off
+        for ftype, flen in fields:
+            if ftype in _SAMPLING_FIELDS:
+                rate = _uint(data[p : p + flen])
+                if rate:
+                    cache.sampling[(source, domain)] = rate
+            p += flen
+        off += rec_len
 
 
 def decode_v9(data: bytes, cache: TemplateCache, source: str = "",
@@ -219,6 +301,7 @@ def decode_v9(data: bytes, cache: TemplateCache, source: str = "",
     )
     now = now or unix_secs
     msgs = []
+    inherit = []  # records lacking an inline sampling field
     off = 20
     while off + 4 <= len(data):
         set_id, set_len = struct.unpack_from(">HH", data, off)
@@ -228,19 +311,30 @@ def decode_v9(data: bytes, cache: TemplateCache, source: str = "",
         body = off + 4
         if set_id == 0:  # template set
             _decode_templates(data, body, body_end, source, source_id, cache)
-        elif set_id == 1:  # options template: not carried
-            pass
+        elif set_id == 1:  # options template (sampling-rate carrier)
+            try:
+                _decode_options_templates_v9(data, body, body_end, source,
+                                             source_id, cache)
+            except ValueError:
+                pass  # a malformed options set must not drop the datagram's flows
         elif set_id > 255:  # data set
             fields = cache.get(source, source_id, set_id)
             if fields is not None:
-                rec_len = sum(flen for _, flen in fields)
-                while body + rec_len <= body_end and rec_len > 0:
-                    msg, body = _record_from_fields(
-                        fields, data, body, FlowType.NETFLOW_V9, now,
-                        unix_secs, sysuptime, seq,
-                    )
-                    msgs.append(msg)
+                if cache.is_options(source, source_id, set_id):
+                    _decode_options_data(fields, data, body, body_end,
+                                         source, source_id, cache)
+                else:
+                    rec_len = sum(flen for _, flen in fields)
+                    while body + rec_len <= body_end and rec_len > 0:
+                        msg, body, has_sampling = _record_from_fields(
+                            fields, data, body, FlowType.NETFLOW_V9, now,
+                            unix_secs, sysuptime, seq,
+                        )
+                        msgs.append(msg)
+                        if not has_sampling:
+                            inherit.append(msg)
         off = body_end
+    _apply_exporter_sampling(inherit, cache, source, source_id)
     return msgs
 
 
@@ -251,6 +345,7 @@ def decode_ipfix(data: bytes, cache: TemplateCache, source: str = "",
     _, length, export_secs, seq, domain = struct.unpack_from(">HHIII", data, 0)
     now = now or export_secs
     msgs = []
+    inherit = []  # records lacking an inline sampling field
     off = 16
     end = min(len(data), length)
     while off + 4 <= end:
@@ -260,21 +355,45 @@ def decode_ipfix(data: bytes, cache: TemplateCache, source: str = "",
         body_end = off + set_len
         body = off + 4
         if set_id == 2:  # template set
-            _decode_templates(data, body, body_end, source, domain, cache)
-        elif set_id == 3:  # options template
-            pass
+            _decode_templates(data, body, body_end, source, domain, cache,
+                              enterprise=True)
+        elif set_id == 3:  # options template (sampling-rate carrier)
+            try:
+                _decode_options_templates_ipfix(data, body, body_end, source,
+                                                domain, cache)
+            except ValueError:
+                pass  # a malformed options set must not drop the datagram's flows
         elif set_id > 255:
             fields = cache.get(source, domain, set_id)
             if fields is not None:
-                rec_len = sum(flen for _, flen in fields)
-                while body + rec_len <= body_end and rec_len > 0:
-                    msg, body = _record_from_fields(
-                        fields, data, body, FlowType.IPFIX, now,
-                        export_secs, 0, seq,
-                    )
-                    msgs.append(msg)
+                if cache.is_options(source, domain, set_id):
+                    _decode_options_data(fields, data, body, body_end,
+                                         source, domain, cache)
+                else:
+                    rec_len = sum(flen for _, flen in fields)
+                    while body + rec_len <= body_end and rec_len > 0:
+                        msg, body, has_sampling = _record_from_fields(
+                            fields, data, body, FlowType.IPFIX, now,
+                            export_secs, 0, seq,
+                        )
+                        msgs.append(msg)
+                        if not has_sampling:
+                            inherit.append(msg)
         off = body_end
+    _apply_exporter_sampling(inherit, cache, source, domain)
     return msgs
+
+
+def _apply_exporter_sampling(msgs, cache: TemplateCache, source: str,
+                             domain: int) -> None:
+    """Flows WITHOUT an inline sampling field (callers pass only those)
+    inherit the exporter-wide rate announced via options data; records stay
+    at the default 1 when neither exists."""
+    rate = cache.exporter_sampling(source, domain)
+    if not rate:
+        return
+    for m in msgs:
+        m.sampling_rate = rate
 
 
 def decode_netflow(data: bytes, cache: TemplateCache, source: str = "",

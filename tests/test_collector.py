@@ -151,6 +151,108 @@ class TestNetFlowV9:
         assert decode_netflow(data_only, cache, source="r2") == []
 
 
+def v9_options_sampling(rate=2048):
+    """Options template (set 1) announcing SAMPLING_INTERVAL, then its
+    option-data record, then a flow data record WITHOUT inline sampling."""
+    # options template 512: scope (1 field, 4B) + option SAMPLING_INTERVAL(34, 4B)
+    otmpl = struct.pack(">HHH", 512, 4, 4)  # tid, scope_len, opt_len
+    otmpl += struct.pack(">HH", 1, 4)  # scope field: System(1), 4 bytes
+    otmpl += struct.pack(">HH", 34, 4)  # SAMPLING_INTERVAL, 4 bytes
+    oset = struct.pack(">HH", 1, 4 + len(otmpl)) + otmpl
+    odata_rec = struct.pack(">I", 0) + struct.pack(">I", rate)
+    odata = struct.pack(">HH", 512, 4 + len(odata_rec)) + odata_rec
+    # regular template 300 without sampling field + one data record
+    fields = [(8, 4), (12, 4), (1, 4), (2, 4)]
+    tmpl = struct.pack(">HH", 300, len(fields))
+    for t, l in fields:
+        tmpl += struct.pack(">HH", t, l)
+    tset = struct.pack(">HH", 0, 4 + len(tmpl)) + tmpl
+    rec = bytes([10, 0, 0, 1]) + bytes([10, 0, 0, 2]) + struct.pack(">II", 500, 2)
+    dset = struct.pack(">HH", 300, 4 + len(rec)) + rec
+    body = oset + odata + tset + dset
+    return struct.pack(">HHIIII", 9, 4, 0, NOW, 1, 9) + body
+
+
+class TestOptionsSampling:
+    def test_exporter_sampling_applied(self):
+        cache = TemplateCache()
+        msgs = decode_netflow(v9_options_sampling(rate=2048), cache, "r9")
+        assert len(msgs) == 1
+        assert msgs[0].sampling_rate == 2048
+        assert cache.exporter_sampling("r9", 9) == 2048
+
+    def test_sampling_persists_across_datagrams(self):
+        cache = TemplateCache()
+        decode_netflow(v9_options_sampling(rate=512), cache, "r9")
+        # later datagram: only template + data (no options sets)
+        datagram = v9_template_and_data()
+        msgs = decode_netflow(datagram, cache, "r9")
+        # source_id differs (1 vs 9) -> different exporter, rate NOT applied
+        assert msgs[0].sampling_rate == 1
+        # same exporter id as the options announcement -> applied
+        header = struct.pack(">HHIIII", 9, 2, 1_000_000, NOW, 7, 9)
+        msgs = decode_netflow(header + datagram[20:], cache, "r9")
+        assert msgs[0].sampling_rate == 512
+
+    def test_inline_sampling_of_one_not_overridden(self):
+        # explicit inline SAMPLING_INTERVAL=1 (unsampled flows from an
+        # otherwise-sampling exporter) must NOT inherit the exporter rate
+        cache = TemplateCache()
+        decode_netflow(v9_options_sampling(rate=4096), cache, "r9")
+        fields = [(1, 4), (34, 4)]
+        tmpl = struct.pack(">HH", 302, len(fields))
+        for t, l in fields:
+            tmpl += struct.pack(">HH", t, l)
+        tset = struct.pack(">HH", 0, 4 + len(tmpl)) + tmpl
+        rec = struct.pack(">II", 100, 1)  # inline sampling exactly 1
+        dset = struct.pack(">HH", 302, 4 + len(rec)) + rec
+        datagram = struct.pack(">HHIIII", 9, 2, 0, NOW, 2, 9) + tset + dset
+        msgs = decode_netflow(datagram, cache, "r9")
+        assert msgs[0].sampling_rate == 1
+
+    def test_malformed_options_set_does_not_drop_flows(self):
+        # options template whose byte lengths overrun its set must be
+        # skipped; the datagram's flow records still decode
+        cache = TemplateCache()
+        bad_otmpl = struct.pack(">HHH", 513, 400, 400)  # lengths overrun
+        oset = struct.pack(">HH", 1, 4 + len(bad_otmpl)) + bad_otmpl
+        good = v9_template_and_data()
+        datagram = good[:20] + oset + good[20:]
+        msgs = decode_netflow(datagram, cache, "r1")
+        assert len(msgs) == 1  # the flow survived the bad options set
+
+    def test_v9_vendor_field_type_no_enterprise_skip(self):
+        # v9 has no IPFIX enterprise encoding: type >= 0x8000 is 4 bytes of
+        # spec like any other, not 8
+        cache = TemplateCache()
+        fields = [(0x8001, 4), (1, 4)]
+        tmpl = struct.pack(">HH", 320, len(fields))
+        for t, l in fields:
+            tmpl += struct.pack(">HH", t, l)
+        tset = struct.pack(">HH", 0, 4 + len(tmpl)) + tmpl
+        rec = bytes(4) + struct.pack(">I", 777)  # vendor field, then bytes
+        dset = struct.pack(">HH", 320, 4 + len(rec)) + rec
+        datagram = struct.pack(">HHIIII", 9, 2, 0, NOW, 3, 1) + tset + dset
+        msgs = decode_netflow(datagram, cache, "r1")
+        assert len(msgs) == 1
+        assert msgs[0].bytes == 777
+
+    def test_inline_sampling_wins(self):
+        cache = TemplateCache()
+        decode_netflow(v9_options_sampling(rate=4096), cache, "r9")
+        # template carrying inline SAMPLING_INTERVAL(34) beats exporter rate
+        fields = [(1, 4), (34, 4)]
+        tmpl = struct.pack(">HH", 301, len(fields))
+        for t, l in fields:
+            tmpl += struct.pack(">HH", t, l)
+        tset = struct.pack(">HH", 0, 4 + len(tmpl)) + tmpl
+        rec = struct.pack(">II", 100, 64)  # bytes, inline sampling 64
+        dset = struct.pack(">HH", 301, 4 + len(rec)) + rec
+        datagram = struct.pack(">HHIIII", 9, 2, 0, NOW, 2, 9) + tset + dset
+        msgs = decode_netflow(datagram, cache, "r9")
+        assert msgs[0].sampling_rate == 64
+
+
 class TestIPFIX:
     def test_template_then_data(self):
         cache = TemplateCache()
