@@ -1,0 +1,111 @@
+"""Concurrency stress: producer threads blasting the bus while the worker
+consumes and the query API reads — totals must stay exact (SURVEY.md §5
+race detection; the reference has a single RWMutex and no -race CI)."""
+
+import threading
+import time
+import urllib.request
+
+from flow_pipeline_tpu.engine import StreamWorker, WorkerConfig
+from flow_pipeline_tpu.engine.query_api import QueryServer
+from flow_pipeline_tpu.gen import FlowGenerator, MockerProfile
+from flow_pipeline_tpu.models import WindowAggConfig, WindowAggregator
+from flow_pipeline_tpu.sink import MemorySink
+from flow_pipeline_tpu.transport import Consumer, InProcessBus, Producer
+
+
+class TestConcurrentPipeline:
+    def test_producers_race_consumer_exact_totals(self):
+        bus = InProcessBus()
+        bus.create_topic("flows", 4)
+        n_producers, per_producer = 4, 2000
+
+        thread_errors = []
+
+        def produce(seed):
+            try:
+                gen = FlowGenerator(MockerProfile(), seed=seed,
+                                    t0=1_699_999_800, rate=100.0)
+                prod = Producer(bus, fixedlen=True)
+                for _ in range(per_producer // 500):
+                    prod.send_many(gen.batch(500).to_messages())
+            except Exception as e:  # noqa: BLE001 — surface in the assert
+                thread_errors.append(e)
+
+        worker = StreamWorker(
+            Consumer(bus, fixedlen=True),
+            {"flows_5m": WindowAggregator(WindowAggConfig(batch_size=512))},
+            [sink := MemorySink()],
+            WorkerConfig(snapshot_every=0, idle_sleep=0.005),
+        )
+        threads = [threading.Thread(target=produce, args=(100 + i,))
+                   for i in range(n_producers)]
+        stop = threading.Event()
+
+        def consume():
+            try:
+                while not stop.is_set():  # churn while producers race us
+                    if not worker.run_once():
+                        time.sleep(0.001)  # caught up: don't starve producers
+                while worker.run_once():  # then drain whatever remains
+                    pass
+                worker.finalize()
+            except Exception as e:  # noqa: BLE001 — surface in the assert
+                thread_errors.append(e)
+
+        consumer_thread = threading.Thread(target=consume)
+        consumer_thread.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        consumer_thread.join(timeout=60)
+        assert not consumer_thread.is_alive()
+        assert thread_errors == []
+
+        total = sum(r["count"] for r in sink.tables.get("flows_5m", []))
+        assert total == n_producers * per_producer
+        assert worker.consumer.lag() == 0
+
+    def test_queries_race_worker(self):
+        bus = InProcessBus()
+        bus.create_topic("flows", 2)
+        gen = FlowGenerator(MockerProfile(), seed=7, t0=1_699_999_800,
+                            rate=20.0)
+        prod = Producer(bus, fixedlen=True)
+        for _ in range(16):
+            prod.send_many(gen.batch(500).to_messages())
+        worker = StreamWorker(
+            Consumer(bus, fixedlen=True),
+            {"flows_5m": WindowAggregator(WindowAggConfig(batch_size=512))},
+            [sink := MemorySink()],
+            WorkerConfig(snapshot_every=0),
+        )
+        worker.run_once()  # warm the jit before hammers: the first batch
+        # holds the worker lock across compile, which could outlast a
+        # conservative HTTP timeout on a cold runner
+        server = QueryServer(worker, port=0).start()
+        errors = []
+
+        def hammer():
+            for _ in range(50):
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{server.port}/windows", timeout=30
+                    ).read()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        hammers = [threading.Thread(target=hammer) for _ in range(3)]
+        for h in hammers:
+            h.start()
+        while worker.run_once():  # worker churns while queries hammer
+            pass
+        worker.finalize()
+        for h in hammers:
+            h.join()
+        server.stop()
+        assert errors == []
+        total = sum(r["count"] for r in sink.tables.get("flows_5m", []))
+        assert total == 8000
