@@ -109,8 +109,21 @@ class TestPrometheus:
 
 
 class TestGrafana:
+    def test_collector_dashboard_uses_collector_metrics(self):
+        with open(os.path.join(DEPLOY, "grafana", "dashboards",
+                               "collector.json")) as f:
+            text = f.read()
+        # the GoFlow-shaped surface (SURVEY §2-C12) our collector exports
+        for metric in ("udp_traffic_bytes", "flow_traffic_bytes",
+                       "flow_process_nf_flowset_records_sum",
+                       "flow_process_sf_samples_sum",
+                       "flow_process_nf_errors_count",
+                       "flow_process_nf_templates_count",
+                       "flow_summary_decoding_time_us", "flow_decoder_count"):
+            assert metric in text
+
     def test_dashboards_parse_and_reference_real_tables(self):
-        for name in ("traffic.json", "pipeline.json"):
+        for name in ("traffic.json", "pipeline.json", "collector.json"):
             with open(os.path.join(DEPLOY, "grafana", "dashboards", name)) as f:
                 dash = json.load(f)
             assert dash["panels"]
