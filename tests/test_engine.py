@@ -65,20 +65,24 @@ def flows5m_totals(sink):
     return agg
 
 
+def assert_matches_oracle(got, all_flows):
+    """Merged (window, key) sink totals must equal the exact oracle."""
+    oracle = flows_5m(all_flows)
+    assert len(got) == len(oracle["timeslot"])
+    for i in range(len(oracle["timeslot"])):
+        key = (int(oracle["timeslot"][i]), int(oracle["src_as"][i]),
+               int(oracle["dst_as"][i]), int(oracle["etype"][i]))
+        assert got[key] == (int(oracle["bytes"][i]),
+                            int(oracle["packets"][i]),
+                            int(oracle["count"][i]))
+
+
 class TestWorkerE2E:
     def test_bus_to_sink_parity(self):
         bus, all_flows = fill_bus()
         worker, sink = make_worker(bus)
         worker.run(stop_when_idle=True)
-        got = flows5m_totals(sink)
-        oracle = flows_5m(all_flows)
-        assert len(got) == len(oracle["timeslot"])
-        for i in range(len(oracle["timeslot"])):
-            key = (int(oracle["timeslot"][i]), int(oracle["src_as"][i]),
-                   int(oracle["dst_as"][i]), int(oracle["etype"][i]))
-            assert got[key] == (int(oracle["bytes"][i]),
-                                int(oracle["packets"][i]),
-                                int(oracle["count"][i]))
+        assert_matches_oracle(flows5m_totals(sink), all_flows)
         # top talkers emitted per closed window
         assert "top_talkers" in sink.tables
 
@@ -142,14 +146,7 @@ class TestCheckpointResume:
         }
         for k, v in sink2.tables.items():
             combined.tables.setdefault(k, []).extend(v)
-        got = flows5m_totals(combined)
-        oracle = flows_5m(all_flows)
-        for i in range(len(oracle["timeslot"])):
-            key = (int(oracle["timeslot"][i]), int(oracle["src_as"][i]),
-                   int(oracle["dst_as"][i]), int(oracle["etype"][i]))
-            assert got[key] == (int(oracle["bytes"][i]),
-                                int(oracle["packets"][i]),
-                                int(oracle["count"][i]))
+        assert_matches_oracle(flows5m_totals(combined), all_flows)
 
     def test_flush_triggers_snapshot(self, tmp_path):
         # any flush that emitted rows must immediately snapshot+commit, not
@@ -185,6 +182,40 @@ class TestCheckpointResume:
         bus, _ = fill_bus(n=500)
         worker, _ = make_worker(bus, checkpoint=str(tmp_path / "nope"))
         assert worker.restore() is False
+
+
+class TestSupervisedRecovery:
+    def test_flaky_sink_supervised_exact_totals(self, tmp_path):
+        """Full recovery chain: a sink that dies on its first flush kills
+        the worker; the supervisor rebuilds one that restores the
+        checkpoint and resumes from committed offsets. The failed flush
+        never reached good_sink, so this proves replay-after-crash produces
+        the exact oracle totals (cross-restart partial-row merging is
+        covered by test_kill_mid_window_resume_no_loss_no_double)."""
+        from flow_pipeline_tpu.engine import Supervisor, SupervisorConfig
+
+        bus, all_flows = fill_bus(n=4000, rate=10.0)  # windows close mid-run
+        ckpt = str(tmp_path / "ckpt")
+        good_sink = MemorySink()
+        failures = {"left": 1}
+
+        class FlakySink:
+            def write(self, table, rows):
+                if failures["left"] > 0:
+                    failures["left"] -= 1
+                    raise ConnectionError("sink hiccup")
+                good_sink.write(table, rows)
+
+        def factory():
+            worker, _ = make_worker(bus, checkpoint=ckpt, snapshot_every=2)
+            worker.sinks = [FlakySink()]
+            worker.restore()
+            return worker
+
+        Supervisor(factory, SupervisorConfig(backoff_initial=0.01),
+                   stop_when_idle=True).run()
+        assert failures["left"] == 0  # the crash actually happened
+        assert_matches_oracle(flows5m_totals(good_sink), all_flows)
 
 
 class TestDDoSInWorker:
