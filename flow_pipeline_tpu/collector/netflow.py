@@ -155,18 +155,61 @@ def _uint(b: bytes) -> int:
     return int.from_bytes(b, "big")
 
 
+# RFC 7011 §7: a template field length of 0xFFFF marks a variable-length
+# field whose actual size is a per-record 1-byte prefix (or 255 followed by
+# a 2-byte length). NetFlow v9 has no such encoding, but treating 0xFFFF
+# identically there is safe: no fixed v9 field is 65535 bytes wide.
+VARLEN = 0xFFFF
+
+
+def _varlen_slice(data: bytes, p: int, end: int) -> tuple[bytes, int]:
+    """Read one variable-length field's content; returns (raw, new offset)."""
+    if p >= end:
+        raise ValueError("varlen field prefix overruns set")
+    ln = data[p]
+    p += 1
+    if ln == 255:  # 3-byte form
+        if p + 2 > end:
+            raise ValueError("varlen field extended prefix overruns set")
+        ln = struct.unpack_from(">H", data, p)[0]
+        p += 2
+    if p + ln > end:
+        raise ValueError("varlen field content overruns set")
+    return data[p : p + ln], p + ln
+
+
+def _min_record_len(fields) -> int:
+    """Lower bound on one data record's wire size: fixed widths plus at
+    least one length-prefix byte per variable-length field."""
+    return sum(1 if flen == VARLEN else flen for _, flen in fields)
+
+
 def _record_from_fields(fields, data, off, flow_type, now, header_secs,
-                        sysuptime, seq) -> tuple[FlowMessage, int, bool]:
+                        sysuptime, seq, end=None) -> tuple[FlowMessage, int, bool]:
     """Returns (msg, new offset, has_inline_sampling). The flag matters:
     sampling_rate defaults to 1, so 'field absent' and 'explicit inline 1'
     (unsampled flows from an otherwise-sampling exporter) are otherwise
     indistinguishable to the exporter-rate inheritance."""
+    if end is None:
+        end = len(data)
     msg = FlowMessage(type=flow_type, time_received=now, sequence_num=seq,
                       sampling_rate=1)
     times = {}
     etype = 0x0800
     has_sampling = False
     for ftype, flen in fields:
+        if flen == VARLEN:
+            # Variable-length content (RFC 7011 §7) is strings/opaque data;
+            # every field this pipeline maps is fixed-width, so consume the
+            # bytes and move on — the record stays decodable.
+            _, off = _varlen_slice(data, off, end)
+            continue
+        # In a varlen-bearing template the outer loop's min-length check
+        # cannot guarantee the fixed tail fits: a long varlen value can
+        # leave fewer bytes than the remaining fixed fields, and slicing
+        # past ``end`` would silently read the NEXT set's bytes as content.
+        if off + flen > end:
+            raise ValueError("record field overruns set")
         raw = data[off : off + flen]
         off += flen
         if ftype in _INT_FIELDS:
@@ -278,18 +321,23 @@ _SAMPLING_FIELDS = {34, 305}  # SAMPLING_INTERVAL, samplingPacketInterval
 def _decode_options_data(fields, data, off, end, source, domain, cache):
     """Scan option data records for a sampling interval; cache it
     exporter-wide."""
-    rec_len = sum(flen for _, flen in fields)
+    rec_len = _min_record_len(fields)
     if rec_len <= 0:
         return
     while off + rec_len <= end:
         p = off
         for ftype, flen in fields:
+            if flen == VARLEN:
+                _, p = _varlen_slice(data, p, end)
+                continue
+            if p + flen > end:  # fixed tail after a long varlen value
+                raise ValueError("options record field overruns set")
             if ftype in _SAMPLING_FIELDS:
                 rate = _uint(data[p : p + flen])
                 if rate:
                     cache.sampling[(source, domain)] = rate
             p += flen
-        off += rec_len
+        off = p  # varlen fields make records variable-width
 
 
 def decode_v9(data: bytes, cache: TemplateCache, source: str = "",
@@ -321,14 +369,17 @@ def decode_v9(data: bytes, cache: TemplateCache, source: str = "",
             fields = cache.get(source, source_id, set_id)
             if fields is not None:
                 if cache.is_options(source, source_id, set_id):
-                    _decode_options_data(fields, data, body, body_end,
-                                         source, source_id, cache)
+                    try:
+                        _decode_options_data(fields, data, body, body_end,
+                                             source, source_id, cache)
+                    except ValueError:
+                        pass  # a corrupt options record must not drop the datagram's flows
                 else:
-                    rec_len = sum(flen for _, flen in fields)
+                    rec_len = _min_record_len(fields)
                     while body + rec_len <= body_end and rec_len > 0:
                         msg, body, has_sampling = _record_from_fields(
                             fields, data, body, FlowType.NETFLOW_V9, now,
-                            unix_secs, sysuptime, seq,
+                            unix_secs, sysuptime, seq, end=body_end,
                         )
                         msgs.append(msg)
                         if not has_sampling:
@@ -367,14 +418,17 @@ def decode_ipfix(data: bytes, cache: TemplateCache, source: str = "",
             fields = cache.get(source, domain, set_id)
             if fields is not None:
                 if cache.is_options(source, domain, set_id):
-                    _decode_options_data(fields, data, body, body_end,
-                                         source, domain, cache)
+                    try:
+                        _decode_options_data(fields, data, body, body_end,
+                                             source, domain, cache)
+                    except ValueError:
+                        pass  # a corrupt options record must not drop the datagram's flows
                 else:
-                    rec_len = sum(flen for _, flen in fields)
+                    rec_len = _min_record_len(fields)
                     while body + rec_len <= body_end and rec_len > 0:
                         msg, body, has_sampling = _record_from_fields(
                             fields, data, body, FlowType.IPFIX, now,
-                            export_secs, 0, seq,
+                            export_secs, 0, seq, end=body_end,
                         )
                         msgs.append(msg)
                         if not has_sampling:

@@ -99,14 +99,21 @@ def decode_sflow(data: bytes, now: Optional[int] = None) -> list[FlowMessage]:
                 in_if, out_if = in_val, out_val
                 p += 44
             for _ in range(n_rec):
+                # Bounds discipline matches the v9 flowset checks: a corrupt
+                # rlen/n_rec must not read into the next sample's bytes and
+                # silently mis-parse records.
+                if p + 8 > s_end:
+                    raise ValueError("truncated sFlow flow-record header")
                 rfmt, rlen = struct.unpack_from(">II", data, p)
                 p += 8
                 r_end = p + rlen
-                if (rfmt & 0xFFF) == _REC_RAW_PACKET:
+                if r_end > s_end:
+                    raise ValueError("sFlow flow record overruns sample")
+                if (rfmt & 0xFFF) == _REC_RAW_PACKET and rlen >= 16:
                     proto, frame_len, _stripped, hdr_len = struct.unpack_from(
                         ">IIII", data, p
                     )
-                    hdr = data[p + 16 : p + 16 + hdr_len]
+                    hdr = data[p + 16 : min(p + 16 + hdr_len, r_end)]
                     if proto == _PROTO_ETHERNET:
                         msg = FlowMessage(
                             type=FlowType.SFLOW_5,

@@ -78,7 +78,12 @@ class CollectorServer:
         self.m_udp_pkts.inc()
         t0 = time.perf_counter()
         try:
-            msgs = decode_netflow(data, self.templates, source)
+            # Stamp receive time here (as the reference collector does) so a
+            # skewed exporter clock cannot shift window assignment; the
+            # exporter header clock remains the fallback only when now=None
+            # (direct decode_netflow callers, e.g. tests).
+            msgs = decode_netflow(data, self.templates, source,
+                                  now=int(time.time()))
         except (ValueError, struct.error) as e:
             # struct.error covers malformed datagrams that trip fixed-layout
             # unpacks before a bounds check — one spoofed packet must never

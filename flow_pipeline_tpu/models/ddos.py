@@ -137,6 +137,11 @@ class DDoSDetector:
         self.folds = 0  # closed sub-windows; alerts suppressed during warmup
         self.alerts: list[dict] = []  # drained by the worker per flush
         self.recent = deque(maxlen=1000)  # retained for live queries
+        # Late rows (sub-window already closed and its rates reset) are
+        # dropped, mirroring WindowedHeavyHitter: folding them into the
+        # CURRENT sub-window would inflate its rates and can fire spurious
+        # z-score alerts after a burst of late arrivals.
+        self.late_flows_dropped = 0
 
     def update(self, batch: FlowBatch) -> None:
         if len(batch) == 0:
@@ -161,6 +166,9 @@ class DDoSDetector:
             elif sub > self.current_sub:
                 self.close_sub_window()
                 self.current_sub = sub
+            elif sub < self.current_sub:
+                self.late_flows_dropped += len(part)
+                continue
             self._accumulate(part)
 
     def _accumulate(self, batch: FlowBatch) -> None:

@@ -34,9 +34,16 @@ def sort_groupby(keys, values, valid):
       n_groups:    [] int32 — number of real groups; rows >= n_groups are
                    padding (keys all-1s, sums/counts zero).
 
-    Caveat: invalid rows are sent to the all-0xFFFFFFFF key, so a *valid* row
-    whose whole key tuple is all-1s would be dropped; key layouts here always
-    lead with a timeslot lane, which never hits 2^32-1.
+    Caveat: invalid rows are sent to the all-0xFFFFFFFF key, so a *valid*
+    row whose whole key tuple is all-1s (e.g. the ff..ff address in a raw
+    address-keyed layout) lands in the same sorted segment as the padding
+    rows. That is still correct: padding rows contribute 0 to sums/counts,
+    so the group survives the ``counts > 0`` reality test with exact values
+    and its reported key IS the all-1s tuple. The only residual ambiguity
+    is that such a group is indistinguishable from padding by key alone —
+    reality is judged by counts, never by key. (Consumers that DO use the
+    sentinel key as an empty-slot marker — ops.topk — cannot represent it
+    and drop it explicitly; see topk_merge.)
     """
     n, w = keys.shape
     v = values.shape[1]
@@ -62,10 +69,13 @@ def sort_groupby(keys, values, valid):
     # Keys are constant within a segment: max == the key.
     unique_keys = jax.ops.segment_max(sk, seg_ids, num_segments=n)
 
-    row_valid = sc > 0  # sorted invalid rows have cnt 0
-    n_groups = jnp.sum((is_boundary & row_valid).astype(jnp.int32))
-    # Zero out any group that contains no valid rows (the sentinel group).
+    # A group is real iff it holds at least one valid row. Judging by
+    # counts (not by key != sentinel) keeps a valid all-1s key tuple
+    # countable: its rows share a segment with padding, but padding adds 0
+    # to counts/sums. All-padding groups have counts == 0 and sort last,
+    # so real groups occupy a contiguous prefix and n_groups is exact.
     group_real = counts > 0
+    n_groups = jnp.sum(group_real.astype(jnp.int32))
     sums = jnp.where(group_real[:, None], sums, 0)
     unique_keys = jnp.where(group_real[:, None], unique_keys, sentinel)
     return unique_keys, sums, counts, n_groups
@@ -112,7 +122,10 @@ def sort_groupby_float(keys, values, valid):
     counts = jax.ops.segment_sum(sc, seg_ids, num_segments=n)
     uniq = jax.ops.segment_max(sk, seg_ids, num_segments=n)
 
-    real = (counts > 0) & ~jnp.all(uniq == sentinel, axis=1)
+    # counts>0 alone decides reality (see sort_groupby): a valid all-1s
+    # key shares the padding segment but padding contributes 0 to counts,
+    # so the group — and its exact float sums — survive.
+    real = counts > 0
     sums = jnp.where(real[:, None], sums, 0.0)
     uniq = jnp.where(real[:, None], uniq, sentinel)
     counts = jnp.where(real, counts, 0)

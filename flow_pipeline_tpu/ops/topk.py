@@ -50,6 +50,13 @@ def topk_merge(table_keys, table_vals, cand_keys, cand_vals, cand_valid):
     """
     c = table_keys.shape[0]
     table_valid = jnp.any(table_keys != SENTINEL, axis=1)
+    # The all-sentinel key tuple is UNREPRESENTABLE in this table: sentinel
+    # keys mark empty slots, so admitting a real all-1s key (e.g. the ff..ff
+    # IPv6 address as raw lanes) would let it steal a capacity slot while
+    # being invisible to topk_extract and zeroed on the next merge. Drop it
+    # here, explicitly — the exact aggregation path (ops.segment) still
+    # counts it; only the approximate top-K table excludes this one key.
+    cand_valid = cand_valid & jnp.any(cand_keys != SENTINEL, axis=1)
     all_keys = jnp.concatenate([table_keys, cand_keys.astype(jnp.uint32)], axis=0)
     all_vals = jnp.concatenate(
         [table_vals, cand_vals.astype(jnp.float32)], axis=0

@@ -79,7 +79,8 @@ def sharded_hh_merge(mesh: Mesh, config: hh.HeavyHitterConfig):
         tv = lax.all_gather(state.table_vals[0], DATA_AXIS)
         mk, mv = tk[0], tv[0]
         for d in range(1, n_dev):  # static fold: n_dev is compile-time
-            cand_valid = jnp.any(tk[d] != topk_ops.SENTINEL, axis=1)
+            # topk_merge self-filters sentinel (empty-slot) rows
+            cand_valid = jnp.ones(tk[d].shape[0], bool)
             mk, mv = topk_ops.topk_merge(mk, mv, tk[d], tv[d], cand_valid)
         return hh.HHState(cms=cms, table_keys=mk, table_vals=mv)
 

@@ -221,6 +221,25 @@ class TestOptionsSampling:
         msgs = decode_netflow(datagram, cache, "r1")
         assert len(msgs) == 1  # the flow survived the bad options set
 
+    def test_corrupt_options_data_record_does_not_drop_flows(self):
+        # an options-DATA record with a corrupt varlen prefix must be
+        # swallowed like a malformed options template: the datagram's
+        # flow records still decode
+        cache = TemplateCache()
+        # IPFIX options template 600: one varlen field
+        otmpl = struct.pack(">HHH", 600, 1, 0) + struct.pack(">HH", 371, 0xFFFF)
+        oset = struct.pack(">HH", 3, 4 + len(otmpl)) + otmpl
+        # options data whose varlen prefix (200) exceeds the set's bytes
+        odata = struct.pack(">HH", 600, 4 + 3) + bytes([200, 0, 0])
+        # regular template + one flow record
+        tmpl = struct.pack(">HH", 601, 1) + struct.pack(">HH", 1, 4)
+        tset = struct.pack(">HH", 2, 4 + len(tmpl)) + tmpl
+        dset = struct.pack(">HH", 601, 4 + 4) + struct.pack(">I", 4242)
+        body = oset + odata + tset + dset
+        header = struct.pack(">HHIII", 10, 16 + len(body), NOW, 1, 5)
+        msgs = decode_netflow(header + body, cache)
+        assert len(msgs) == 1 and msgs[0].bytes == 4242
+
     def test_v9_vendor_field_type_no_enterprise_skip(self):
         # v9 has no IPFIX enterprise encoding: type >= 0x8000 is 4 bytes of
         # spec like any other, not 8
@@ -265,6 +284,77 @@ class TestIPFIX:
         assert m.time_flow_end == NOW - 1
 
 
+class TestIPFIXVarlen:
+    """RFC 7011 §7 variable-length fields: records decode, varlen content
+    (strings/opaque) is consumed and skipped, corrupt prefixes raise."""
+
+    @staticmethod
+    def varlen_datagram(payloads, long_form=False):
+        # template 310: IN_BYTES(1,4), an unknown varlen field, IN_PKTS(2,4)
+        fields = [(1, 4), (371, 0xFFFF), (2, 4)]
+        tmpl_body = struct.pack(">HH", 310, len(fields))
+        for t, l in fields:
+            tmpl_body += struct.pack(">HH", t, l)
+        tmpl_set = struct.pack(">HH", 2, 4 + len(tmpl_body)) + tmpl_body
+        recs = b""
+        for i, payload in enumerate(payloads):
+            prefix = (bytes([255]) + struct.pack(">H", len(payload))
+                      if long_form else bytes([len(payload)]))
+            recs += struct.pack(">I", 100 + i) + prefix + payload
+            recs += struct.pack(">I", 10 + i)
+        data_set = struct.pack(">HH", 310, 4 + len(recs)) + recs
+        total = 16 + len(tmpl_set) + len(data_set)
+        header = struct.pack(">HHIII", 10, total, NOW, 1, 5)
+        return header + tmpl_set + data_set
+
+    def test_varlen_records_decode(self):
+        cache = TemplateCache()
+        msgs = decode_netflow(
+            self.varlen_datagram([b"", b"interface-name", b"x" * 200]), cache
+        )
+        assert [(m.bytes, m.packets) for m in msgs] == [
+            (100, 10), (101, 11), (102, 12)
+        ]
+
+    def test_varlen_long_form(self):
+        cache = TemplateCache()
+        msgs = decode_netflow(
+            self.varlen_datagram([b"y" * 300, b"z"], long_form=True), cache
+        )
+        assert [(m.bytes, m.packets) for m in msgs] == [(100, 10), (101, 11)]
+
+    def test_varlen_starved_fixed_tail_raises(self):
+        # a varlen value that fits the set but leaves fewer bytes than the
+        # remaining fixed fields must raise — slicing past the set end
+        # would silently read the next set's bytes as field content
+        fields = [(1, 4), (371, 0xFFFF), (2, 4)]
+        tmpl_body = struct.pack(">HH", 311, len(fields))
+        for t, l in fields:
+            tmpl_body += struct.pack(">HH", t, l)
+        tmpl_set = struct.pack(">HH", 2, 4 + len(tmpl_body)) + tmpl_body
+        # record: IN_BYTES, varlen(payload 3), then only 2 bytes remain for
+        # the 4-byte IN_PKTS
+        rec = struct.pack(">I", 100) + bytes([3]) + b"abc" + b"\x00\x07"
+        data_set = struct.pack(">HH", 311, 4 + len(rec)) + rec
+        trailing = struct.pack(">HH", 312, 4)  # a following (empty) set
+        total = 16 + len(tmpl_set) + len(data_set) + len(trailing)
+        header = struct.pack(">HHIII", 10, total, NOW, 1, 5)
+        with pytest.raises(ValueError):
+            decode_netflow(header + tmpl_set + data_set + trailing,
+                           TemplateCache())
+
+    def test_varlen_content_overrun_raises(self):
+        cache = TemplateCache()
+        good = self.varlen_datagram([b"abcdef"])
+        # inflate the 1-byte varlen prefix so the content overruns the set
+        bad = bytearray(good)
+        prefix_at = len(good) - (4 + 6 + 1)  # prefix, payload, trailing IN_PKTS
+        assert bad[prefix_at] == 6
+        bad[prefix_at] = 200
+        with pytest.raises(ValueError):
+            decode_netflow(bytes(bad), cache)
+
+
 class TestSFlow:
     def test_flow_sample_with_raw_header(self):
         msgs = decode_sflow(sflow_datagram(), now=NOW)
@@ -286,6 +376,26 @@ class TestSFlow:
         bad = struct.pack(">II", 4, 1) + bytes(24)
         with pytest.raises(ValueError):
             decode_sflow(bad)
+
+    def test_record_overrunning_sample_raises(self):
+        # corrupt rlen pointing past the sample boundary must raise, not
+        # silently mis-parse the next sample's bytes as record content
+        good = sflow_datagram()
+        bad = bytearray(good)
+        # record header (rfmt, rlen) sits 8 bytes into the sample body,
+        # which starts at 28 (header) + 8 (sample fmt+len) + 32 (body fixed)
+        rlen_off = 28 + 8 + 32 + 4
+        struct.pack_into(">I", bad, rlen_off, 0xFFFF)
+        with pytest.raises(ValueError):
+            decode_sflow(bytes(bad), now=NOW)
+
+    def test_overstated_record_count_raises(self):
+        good = sflow_datagram()
+        bad = bytearray(good)
+        n_rec_off = 28 + 8 + 28  # last word of the fixed sample body
+        struct.pack_into(">I", bad, n_rec_off, 5)  # claims 5 records, has 1
+        with pytest.raises(ValueError):
+            decode_sflow(bytes(bad), now=NOW)
 
 
 class TestCollectorServer:
@@ -347,6 +457,20 @@ class TestCollectorServer:
         assert msgs[0].time_received == NOW + 500
         # flow times still anchor to the exporter clock
         assert msgs[0].time_flow_start == NOW - 10
+
+    def test_handle_netflow_stamps_receive_time(self):
+        # the server stamps wall-clock receive time (reference collector
+        # behavior); a skewed exporter header clock (NOW, ~2023) must not
+        # leak into time_received and shift window assignment
+        from flow_pipeline_tpu.transport import Consumer
+
+        bus, producer, server = self.make()
+        before = int(time.time())
+        assert server.handle_netflow(v5_datagram()) == 2
+        batch = Consumer(bus, "flows", fixedlen=True).poll()
+        received = batch.columns["time_received"]
+        assert (received >= before).all()
+        assert (received <= int(time.time()) + 1).all()
 
     def test_udp_end_to_end(self):
         bus, producer, server = self.make()

@@ -180,6 +180,28 @@ class TestDDoS:
         assert det.folds == 1  # first sub-window closed by the straddle
         assert det.current_sub == 1_699_999_810
 
+    def test_late_rows_dropped_not_accumulated(self):
+        # rows for an already-closed sub-window must be dropped (and
+        # counted), never folded into the CURRENT sub-window where they
+        # would inflate rates and can fire spurious z-score alerts
+        g = FlowGenerator(MockerProfile(), seed=45, t0=1_699_999_800, rate=100.0)
+        det = DDoSDetector(DDoSConfig(batch_size=2048, n_buckets=256,
+                                      sub_window_seconds=10))
+        current = g.batch(1000)  # 10s, fills sub-window 0 exactly
+        det.update(current)
+        det.update(g.batch(500))  # advances into sub-window 1
+        assert det.current_sub == 1_699_999_810
+        rates_before = np.asarray(det.state.rates).copy()
+        late = FlowBatch(
+            {k: v[:200].copy() for k, v in current.columns.items()},
+            current.partition,
+        )
+        late.columns["time_received"][:] = 1_699_999_805  # sub-window 0
+        det.update(late)
+        assert det.late_flows_dropped == 200
+        np.testing.assert_array_equal(np.asarray(det.state.rates), rates_before)
+        assert det.current_sub == 1_699_999_810  # no spurious close either
+
     def test_padding_rows_never_touch_last_bucket(self):
         # regression: -1 "drop" index used to wrap to bucket n_buckets-1
         import jax.numpy as jnp

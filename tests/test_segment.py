@@ -58,6 +58,47 @@ class TestSortGroupby:
         assert sums[0].tolist() == [n, n]
         assert int(counts[0]) == n
 
+    def test_valid_all_ones_key_counted(self):
+        # a VALID row whose whole key tuple is the 0xFFFFFFFF sentinel
+        # (e.g. the ff..ff address in a raw address-keyed layout) shares a
+        # segment with padding rows but must still be counted exactly
+        n = 16
+        keys = np.zeros((n, 2), np.uint32)
+        keys[3] = 0xFFFFFFFF  # valid all-ones key
+        keys[7] = 0xFFFFFFFF
+        values = np.arange(n, dtype=np.int32)[:, None] + 1
+        valid = np.ones(n, bool)
+        valid[8:] = False  # padding also lands on the sentinel key
+        uk, sums, counts, ng = sort_groupby(
+            jnp.asarray(keys), jnp.asarray(values), jnp.asarray(valid)
+        )
+        ng = int(ng)
+        assert ng == 2  # the zero group and the all-ones group
+        rows = {
+            tuple(np.asarray(uk[i])): (int(sums[i, 0]), int(counts[i]))
+            for i in range(ng)
+        }
+        assert rows[(0, 0)] == (1 + 2 + 3 + 5 + 6 + 7, 6)
+        assert rows[(0xFFFFFFFF, 0xFFFFFFFF)] == (4 + 8, 2)
+
+    def test_valid_all_ones_key_counted_float(self):
+        from flow_pipeline_tpu.ops.segment import sort_groupby_float
+
+        keys = np.zeros((8, 1), np.uint32)
+        keys[2] = 0xFFFFFFFF
+        values = np.ones((8, 1), np.float32) * 2.5
+        valid = np.array([1, 1, 1, 1, 0, 0, 0, 0], bool)
+        uk, sums, counts = sort_groupby_float(
+            jnp.asarray(keys), jnp.asarray(values), jnp.asarray(valid)
+        )
+        rows = {
+            int(np.asarray(uk[i, 0])): (float(sums[i, 0]), int(counts[i]))
+            for i in range(8)
+            if int(counts[i]) > 0
+        }
+        assert rows[0] == (7.5, 3)
+        assert rows[0xFFFFFFFF] == (2.5, 1)
+
     def test_groups_lead_output(self, rng):
         keys = rng.integers(0, 4, size=(128, 1)).astype(np.uint32)
         valid = rng.random(128) > 0.5

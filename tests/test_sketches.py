@@ -139,6 +139,27 @@ class TestTopKTable:
                               jnp.ones((4, 1), jnp.float32), jnp.zeros(4, bool))
         np.testing.assert_array_equal(np.asarray(tk), np.asarray(tk2))
 
+    def test_all_sentinel_key_excluded_not_slot_stealing(self):
+        # the all-0xFFFFFFFF key tuple is the table's empty-slot marker and
+        # therefore unrepresentable: a valid candidate carrying it must be
+        # dropped at the merge boundary, never admitted where it would
+        # occupy (or win) a capacity slot while being invisible to
+        # topk_extract and zeroed on the next merge
+        tk, tv = topk_init(2, 2, 1)
+        cand_k = np.array(
+            [[0xFFFFFFFF, 0xFFFFFFFF], [5, 6], [7, 8]], np.uint32
+        )
+        cand_v = np.array([[1e9], [10.0], [20.0]], np.float32)
+        tk, tv = topk_merge(tk, tv, jnp.asarray(cand_k),
+                            jnp.asarray(cand_v), jnp.ones(3, bool))
+        out_k, out_v, valid = topk_extract(tk, tv, 2)
+        assert np.asarray(valid).all()  # both capacity slots hold real keys
+        assert np.asarray(out_k).tolist() == [[7, 8], [5, 6]]
+        # a second merge keeps the real rows' mass intact
+        tk, tv = topk_merge(tk, tv, jnp.asarray(cand_k),
+                            jnp.asarray(cand_v), jnp.ones(3, bool))
+        assert np.asarray(tv)[:, 0].tolist() == [40.0, 20.0]
+
 
 class TestEWMA:
     def test_fold_matches_scalar_recurrence(self, rng):
