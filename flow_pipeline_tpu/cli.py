@@ -133,10 +133,9 @@ def _build_models(vals):
             models["flows_5m"] = ShardedWindowAggregator(cfg, mesh)
         else:
             models["flows_5m"] = WindowAggregator(cfg)
-    if vals["model.talkers"]:
-        hh_cfg = HeavyHitterConfig(
-            key_cols=("src_addr", "dst_addr", "src_port", "dst_port",
-                      "proto"),
+    def windowed_hh(key_cols):
+        cfg = HeavyHitterConfig(
+            key_cols=key_cols,
             batch_size=batch,
             width=vals["sketch.width"],
             capacity=vals["sketch.capacity"],
@@ -144,14 +143,21 @@ def _build_models(vals):
         if mesh:
             from .parallel import ShardedHeavyHitter
 
-            models["top_talkers"] = WindowedHeavyHitter(
-                hh_cfg, k=vals["sketch.topk"],
-                model_cls=ShardedHeavyHitter, mesh=mesh,
-            )
-        else:
-            models["top_talkers"] = WindowedHeavyHitter(
-                hh_cfg, k=vals["sketch.topk"]
-            )
+            return WindowedHeavyHitter(cfg, k=vals["sketch.topk"],
+                                       model_cls=ShardedHeavyHitter,
+                                       mesh=mesh)
+        return WindowedHeavyHitter(cfg, k=vals["sketch.topk"])
+
+    if vals["model.talkers"]:
+        models["top_talkers"] = windowed_hh(
+            ("src_addr", "dst_addr", "src_port", "dst_port", "proto")
+        )
+    if vals["model.ports"]:
+        # Top src/dst port tables (ref: viz.json top port panels). Port
+        # key space is tiny (2^16), so a modest sketch is effectively
+        # exact; one windowed HH per direction, same window cadence.
+        models["top_src_ports"] = windowed_hh(("src_port",))
+        models["top_dst_ports"] = windowed_hh(("dst_port",))
     if vals["model.ddos"]:
         if mesh:
             from .parallel import ShardedDDoSDetector
@@ -171,6 +177,7 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
                                     "(0 = single chip)")
     fs.boolean("model.flows5m", True, "Exact 5m rollup model")
     fs.boolean("model.talkers", True, "5-tuple top-K talkers model")
+    fs.boolean("model.ports", True, "Top src/dst port models")
     fs.boolean("model.ddos", True, "DDoS spike detector")
     fs.integer("sketch.width", 1 << 16, "Count-min width")
     fs.integer("sketch.capacity", 1024, "Top-K table capacity")

@@ -39,6 +39,24 @@ _TYPE_NAMES = {
 }
 
 
+def _export_clock(data: bytes) -> int:
+    """Exporter wall-clock from a NetFlow/IPFIX header (0 if unreadable).
+
+    v5/v9 carry unix_secs at offset 8; IPFIX carries export time at
+    offset 4 (RFC 7011 §3.1). Used for the delay summary only — flow
+    timestamps come from the full decode.
+    """
+    try:
+        version = struct.unpack_from(">H", data, 0)[0]
+        if version in (5, 9):
+            return struct.unpack_from(">I", data, 8)[0]
+        if version == 10:
+            return struct.unpack_from(">I", data, 4)[0]
+    except struct.error:
+        pass
+    return 0
+
+
 @dataclass(frozen=True)
 class CollectorConfig:
     netflow_addr: Optional[tuple[str, int]] = ("0.0.0.0", 2055)
@@ -69,6 +87,10 @@ class CollectorServer:
         self.m_nf_templates = registry.gauge("flow_process_nf_templates_count")
         self.m_sf_samples = registry.counter("flow_process_sf_samples_sum")
         self.m_decode_us = registry.summary("flow_summary_decoding_time_us")
+        self.m_nf_delay = registry.summary(
+            "flow_process_nf_delay_summary_seconds",
+            "seconds between the exporter's header clock and processing",
+        )
         self.m_workers = registry.gauge("flow_decoder_count")
 
     # ---- datagram handling (also the direct test surface) -----------------
@@ -76,6 +98,7 @@ class CollectorServer:
     def handle_netflow(self, data: bytes, source: str = "") -> int:
         self.m_udp_bytes.inc(len(data))
         self.m_udp_pkts.inc()
+        now = time.time()
         t0 = time.perf_counter()
         try:
             # Stamp receive time here (as the reference collector does) so a
@@ -83,7 +106,7 @@ class CollectorServer:
             # exporter header clock remains the fallback only when now=None
             # (direct decode_netflow callers, e.g. tests).
             msgs = decode_netflow(data, self.templates, source,
-                                  now=int(time.time()))
+                                  now=int(now))
         except (ValueError, struct.error) as e:
             # struct.error covers malformed datagrams that trip fixed-layout
             # unpacks before a bounds check — one spoofed packet must never
@@ -95,6 +118,14 @@ class CollectorServer:
             self.m_decode_us.observe((time.perf_counter() - t0) * 1e6)
         self.m_nf_templates.set(len(self.templates))
         self.m_nf_records.inc(len(msgs))
+        # "time between flow and processing" (the reference perfs.json
+        # NFDelaySummary panel): exporter header clock -> now, observed once
+        # per record so busy exporters weight the quantiles like GoFlow's.
+        export_clock = _export_clock(data)
+        if export_clock:
+            delay = max(0.0, now - export_clock)
+            for _ in msgs:
+                self.m_nf_delay.observe(delay)
         return self._publish(msgs)
 
     def handle_sflow(self, data: bytes, source: str = "") -> int:

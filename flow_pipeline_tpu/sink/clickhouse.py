@@ -32,7 +32,10 @@ class ClickHouseSink:
             # a bare clickhouse-server has no schema; without this the first
             # flush 400s and the processor crash-loops
             for stmt in (ddl.CLICKHOUSE_FLOWS_RAW, ddl.CLICKHOUSE_FLOWS_5M,
-                         ddl.CLICKHOUSE_TOP_TALKERS, ddl.CLICKHOUSE_DDOS_ALERTS):
+                         ddl.CLICKHOUSE_TOP_TALKERS,
+                         ddl.CLICKHOUSE_TOP_SRC_PORTS,
+                         ddl.CLICKHOUSE_TOP_DST_PORTS,
+                         ddl.CLICKHOUSE_DDOS_ALERTS):
                 self._post(stmt)
 
     def _post(self, query: str, body: bytes = b"") -> None:
@@ -70,6 +73,12 @@ class ClickHouseSink:
         if not records:
             return
         ddl.assign_ranks(table, records)
+        cols = ddl.TABLE_COLUMNS.get(table)
+        if cols is not None:
+            # Keep only DDL'd columns: flush rows carry extra keys (e.g.
+            # the *_est CMS bounds) that JSONEachRow would reject as
+            # unknown fields against the CREATEd tables.
+            records = [{c: r.get(c) for c in cols if c in r} for r in records]
         if table == "flows_5m":
             records = [
                 {self._FLOWS_5M_COLS.get(k, k): v for k, v in r.items()}

@@ -55,6 +55,28 @@ CREATE TABLE IF NOT EXISTS top_talkers (
 );
 """
 
+POSTGRES_TOP_SRC_PORTS = """
+CREATE TABLE IF NOT EXISTS top_src_ports (
+    timeslot  BIGINT,
+    rank      INT,
+    src_port  INT,
+    bytes     BIGINT,
+    packets   BIGINT,
+    count     BIGINT
+);
+"""
+
+POSTGRES_TOP_DST_PORTS = """
+CREATE TABLE IF NOT EXISTS top_dst_ports (
+    timeslot  BIGINT,
+    rank      INT,
+    dst_port  INT,
+    bytes     BIGINT,
+    packets   BIGINT,
+    count     BIGINT
+);
+"""
+
 POSTGRES_DDOS_ALERTS = """
 CREATE TABLE IF NOT EXISTS ddos_alerts (
     sub_window         BIGINT,
@@ -104,6 +126,30 @@ CREATE TABLE IF NOT EXISTS top_talkers (
 ORDER BY (timeslot, rank);
 """
 
+CLICKHOUSE_TOP_SRC_PORTS = """
+CREATE TABLE IF NOT EXISTS top_src_ports (
+    timeslot UInt64,
+    rank UInt32,
+    src_port UInt32,
+    bytes UInt64,
+    packets UInt64,
+    count UInt64
+) ENGINE = MergeTree()
+ORDER BY (timeslot, rank);
+"""
+
+CLICKHOUSE_TOP_DST_PORTS = """
+CREATE TABLE IF NOT EXISTS top_dst_ports (
+    timeslot UInt64,
+    rank UInt32,
+    dst_port UInt32,
+    bytes UInt64,
+    packets UInt64,
+    count UInt64
+) ENGINE = MergeTree()
+ORDER BY (timeslot, rank);
+"""
+
 CLICKHOUSE_DDOS_ALERTS = """
 CREATE TABLE IF NOT EXISTS ddos_alerts (
     sub_window UInt64,
@@ -137,6 +183,10 @@ TABLE_COLUMNS = {
                  "count"],
     "top_talkers": ["timeslot", "rank", "src_addr", "dst_addr", "src_port",
                     "dst_port", "proto", "bytes", "packets", "count"],
+    "top_src_ports": ["timeslot", "rank", "src_port", "bytes", "packets",
+                      "count"],
+    "top_dst_ports": ["timeslot", "rank", "dst_port", "bytes", "packets",
+                      "count"],
     "ddos_alerts": ["sub_window", "bucket", "dst_addr", "rate", "zscore",
                     "baseline_quantile"],
     "flows": ["time_flow", "type", "sampling_rate", "src_as", "dst_as",
@@ -145,9 +195,12 @@ TABLE_COLUMNS = {
 }
 
 
+RANKED_TABLES = {"top_talkers", "top_src_ports", "top_dst_ports"}
+
+
 def assign_ranks(table: str, records: list[dict]) -> list[dict]:
-    """top_talkers rows are emitted in rank order; materialize the rank."""
-    if table == "top_talkers":
+    """Top-K tables' rows are emitted in rank order; materialize the rank."""
+    if table in RANKED_TABLES:
         for rank, r in enumerate(records):
             r.setdefault("rank", rank)
     return records
@@ -183,6 +236,18 @@ CREATE TABLE IF NOT EXISTS flows_5m (
 CREATE TABLE IF NOT EXISTS top_talkers (
     timeslot INTEGER, rank INTEGER, src_addr TEXT, dst_addr TEXT,
     src_port INTEGER, dst_port INTEGER, proto INTEGER,
+    bytes INTEGER, packets INTEGER, count INTEGER
+);
+""",
+    "top_src_ports": """
+CREATE TABLE IF NOT EXISTS top_src_ports (
+    timeslot INTEGER, rank INTEGER, src_port INTEGER,
+    bytes INTEGER, packets INTEGER, count INTEGER
+);
+""",
+    "top_dst_ports": """
+CREATE TABLE IF NOT EXISTS top_dst_ports (
+    timeslot INTEGER, rank INTEGER, dst_port INTEGER,
     bytes INTEGER, packets INTEGER, count INTEGER
 );
 """,

@@ -458,6 +458,19 @@ class TestCollectorServer:
         # flow times still anchor to the exporter clock
         assert msgs[0].time_flow_start == NOW - 10
 
+    def test_nf_delay_summary_observed(self):
+        # "time between flow and processing": exporter header clock ->
+        # wall clock, weighted per record (2 records in the v5 datagram)
+        bus, producer, server = self.make()
+        dgram = bytearray(v5_datagram())
+        struct.pack_into(">I", dgram, 8, int(time.time()) - 3)  # unix_secs
+        assert server.handle_netflow(bytes(dgram)) == 2
+        assert server.m_nf_delay._count == 2
+        p50 = server.m_nf_delay.quantile(0.5)
+        assert 2.0 <= p50 <= 5.0
+        rendered = server.m_nf_delay.render()
+        assert "flow_process_nf_delay_summary_seconds{quantile=" in rendered
+
     def test_handle_netflow_stamps_receive_time(self):
         # the server stamps wall-clock receive time (reference collector
         # behavior); a skewed exporter header clock (NOW, ~2023) must not
