@@ -183,6 +183,8 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.integer("sketch.capacity", 1024, "Top-K table capacity")
     fs.integer("sketch.topk", 100, "Rows emitted per window")
     fs.integer("window.lateness", 0, "Allowed lateness seconds")
+    fs.boolean("archive.raw", False, "Archive full-fidelity rows to "
+                                     "flows_raw on sinks that support it")
     fs.string("checkpoint.path", "", "Snapshot directory")
     fs.integer("flush.count", 50, "Batches between snapshots")
     fs.string("metrics.addr", "127.0.0.1:8081", "host:port for /metrics "
@@ -322,6 +324,7 @@ def processor_main(argv=None) -> int:
                 poll_max=vals["processor.batch"],
                 snapshot_every=vals["flush.count"],
                 checkpoint_path=vals["checkpoint.path"] or None,
+                archive_raw=vals["archive.raw"],
             ),
         )
         if vals["query.addr"]:
@@ -468,7 +471,8 @@ def pipeline_main(argv=None) -> int:
         _make_sinks(vals["sink"]),
         WorkerConfig(poll_max=vals["processor.batch"],
                      snapshot_every=vals["flush.count"],
-                     checkpoint_path=vals["checkpoint.path"] or None),
+                     checkpoint_path=vals["checkpoint.path"] or None,
+                     archive_raw=vals["archive.raw"]),
     )
     query = None
     if vals["query.addr"]:

@@ -31,6 +31,12 @@ class WorkerConfig:
     snapshot_every: int = 50  # batches between snapshots (0 = never)
     checkpoint_path: Optional[str] = None
     idle_sleep: float = 0.05
+    # Full-fidelity raw archiving (the reference's flows_raw path,
+    # ref: compose/clickhouse/create.sh:36-62): every consumed batch is
+    # handed to sinks exposing archive_raw(batch). Off by default — the
+    # pre-aggregated tables are the serving path; raw rows are for
+    # drill-down/audit and cost one row per flow.
+    archive_raw: bool = False
 
 
 class StreamWorker:
@@ -64,8 +70,16 @@ class StreamWorker:
         self.m_rows = REGISTRY.counter("insert_count",
                                        "rows flushed to sinks")
         self.m_lag = REGISTRY.gauge("consumer_lag", "bus messages behind")
+        self.m_raw = REGISTRY.counter("raw_rows_archived",
+                                      "rows archived to flows_raw")
         self.m_proc = REGISTRY.summary("flow_processing_time_us",
                                        "per-batch processing time")
+        if config.archive_raw:
+            # fail fast on schema drift instead of crash-looping on 400s
+            for sink in self.sinks:
+                check = getattr(sink, "check_raw_schema", None)
+                if check is not None:
+                    check()
 
     # ---- main loop --------------------------------------------------------
 
@@ -79,6 +93,20 @@ class StreamWorker:
 
     def _process(self, batch) -> bool:
         t0 = time.perf_counter()
+        if self.config.archive_raw:
+            archived = False
+            for sink in self.sinks:
+                fn = getattr(sink, "archive_raw", None)
+                if fn is not None:
+                    self.m_raw.inc(fn(batch))
+                    archived = True
+            # Raw rows have no merge semantics to absorb replayed batches
+            # (unlike the aggregate partials), so force the snapshot/commit
+            # right after archiving: the duplicate exposure shrinks to a
+            # crash inside the archive -> snapshot gap — the same
+            # irreducible at-least-once window as sink flushes (_process
+            # below), not snapshot_every batches' worth of raw rows.
+            self._emitted_since_snapshot |= archived
         for model in self.models.values():
             model.update(batch)
         self.batches_seen += 1
@@ -208,7 +236,14 @@ class StreamWorker:
         self.batches_seen = snap["batches_seen"]
         self.flows_seen = snap["flows_seen"]
         for name, ms in snap["models"].items():
-            model = self.models[name]
+            model = self.models.get(name)
+            if model is None:
+                # e.g. a checkpoint written with -model.ports on, restarted
+                # with it off: skip rather than crash-loop on a KeyError;
+                # that model's state simply starts over if re-enabled later
+                log.warning("checkpoint has state for unconfigured model "
+                            "%r; skipping", name)
+                continue
             if ms["kind"] == "window_agg":
                 model.windows = {
                     int(slot): {k: v for k, v in store.items()}

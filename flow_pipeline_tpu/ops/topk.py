@@ -24,11 +24,15 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .segment import sort_groupby_float
 
-SENTINEL = jnp.uint32(0xFFFFFFFF)
+# numpy scalar, NOT jnp: a module-level jnp constant would initialize the
+# XLA backend at import time, which breaks jax.distributed.initialize
+# (multi-host bootstrap must precede any backend init).
+SENTINEL = np.uint32(0xFFFFFFFF)
 
 
 def topk_init(capacity: int, key_width: int, planes: int):

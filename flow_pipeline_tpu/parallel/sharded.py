@@ -129,6 +129,12 @@ class ShardedHeavyHitter:
             cols, valid = shard_batch_columns(self.mesh, cols, mask)
             self.state = self._update(self.state, cols, valid)
 
+    def update_device_columns(self, cols, valid) -> None:
+        """Update from already-placed global arrays of exactly global_batch
+        rows — the multi-host feed path, where each process supplies only
+        its local devices' shards (parallel.multihost.LocalShardFeeder)."""
+        self.state = self._update(self.state, cols, valid)
+
     def merged_state(self) -> hh.HHState:
         return self._merge(self.state)
 

@@ -11,14 +11,49 @@ aggregator emits.
 
 from __future__ import annotations
 
+import ipaddress
 import json
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Optional
 
+import numpy as np
+
 from . import ddl
 from .base import rows_to_records
+from ..schema.batch import words_to_addr
+
+
+def raw_records(batch) -> list[dict]:
+    """FlowBatch -> flows_raw rows (ref: compose/clickhouse/create.sh:36-62
+    column names). Addresses render as IPv6 text for the IPv6 columns; all
+    16 address bytes round-trip exactly. Date is MATERIALIZED server-side
+    from TimeReceived (see ddl.CLICKHOUSE_FLOWS_RAW), so it is not built
+    here — no per-row strftime in the archive hot loop."""
+    c = batch.columns
+    n = len(batch)
+    src = np.asarray(c["src_addr"], dtype=np.uint32)
+    dst = np.asarray(c["dst_addr"], dtype=np.uint32)
+    out = []
+    for i in range(n):
+        out.append({
+            "TimeReceived": int(c["time_received"][i]),
+            "TimeFlowStart": int(c["time_flow_start"][i]),
+            "SequenceNum": int(c["sequence_num"][i]),
+            "SamplingRate": int(c["sampling_rate"][i]),
+            "SrcAddr": str(ipaddress.IPv6Address(words_to_addr(src[i]))),
+            "DstAddr": str(ipaddress.IPv6Address(words_to_addr(dst[i]))),
+            "SrcAS": int(c["src_as"][i]),
+            "DstAS": int(c["dst_as"][i]),
+            "EType": int(c["etype"][i]),
+            "Proto": int(c["proto"][i]),
+            "SrcPort": int(c["src_port"][i]),
+            "DstPort": int(c["dst_port"][i]),
+            "Bytes": int(c["bytes"][i]),
+            "Packets": int(c["packets"][i]),
+        })
+    return out
 
 
 class ClickHouseSink:
@@ -38,7 +73,7 @@ class ClickHouseSink:
                          ddl.CLICKHOUSE_DDOS_ALERTS):
                 self._post(stmt)
 
-    def _post(self, query: str, body: bytes = b"") -> None:
+    def _post(self, query: str, body: bytes = b"") -> bytes:
         req = urllib.request.Request(
             f"{self.url}/?database={self.database}&query="
             + urllib.parse.quote(query),
@@ -46,7 +81,7 @@ class ClickHouseSink:
             method="POST",
         )
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            resp.read()
+            return resp.read()
 
     def ping(self) -> bool:
         try:
@@ -88,3 +123,41 @@ class ClickHouseSink:
                 r.setdefault("Date", int(r.get("Timeslot", 0)) // 86400)
         body = "\n".join(json.dumps(r, default=str) for r in records).encode()
         self._post(f"INSERT INTO {table} FORMAT JSONEachRow", body)
+
+    def check_raw_schema(self) -> None:
+        """Fail fast with remediation if flows_raw predates the IPv6
+        address columns: CREATE IF NOT EXISTS silently keeps an old
+        FixedString(16) schema, and the first archive insert would then
+        400 and crash-loop the processor with no hint why."""
+        try:
+            out = self._post(
+                "SELECT name, type FROM system.columns "
+                "WHERE database = currentDatabase() AND table = 'flows_raw' "
+                "AND name IN ('SrcAddr', 'DstAddr') FORMAT JSONEachRow"
+            )
+        except (urllib.error.URLError, OSError):
+            return  # server unreachable: the insert path will surface it
+        bad = [
+            r["name"]
+            for r in (json.loads(l) for l in out.decode().splitlines() if l)
+            if r["type"] != "IPv6"
+        ]
+        if bad:
+            raise RuntimeError(
+                f"flows_raw columns {bad} are not type IPv6 (a table created "
+                "by an older DDL?); migrate with e.g. ALTER TABLE flows_raw "
+                "MODIFY COLUMN SrcAddr IPv6, MODIFY COLUMN DstAddr IPv6 "
+                "(or DROP the table) before enabling -archive.raw"
+            )
+
+    def archive_raw(self, batch) -> int:
+        """Opt-in full-fidelity archive into flows_raw (the reference's
+        raw-rows query path, ref: compose/clickhouse/create.sh:36-62;
+        queried by its viz-ch.json). The worker calls this only on sinks
+        that expose it and only when archiving is enabled."""
+        records = raw_records(batch)
+        if not records:
+            return 0
+        body = "\n".join(json.dumps(r) for r in records).encode()
+        self._post("INSERT INTO flows_raw FORMAT JSONEachRow", body)
+        return len(records)

@@ -245,3 +245,101 @@ class TestDDoSInWorker:
         assert alerts, "attack must produce an alert row"
         assert any(a["dst_addr"].endswith(".0.0.5") or "::5" in a["dst_addr"]
                    or a["dst_addr"].endswith(":5") for a in alerts)
+
+
+class TestRawArchive:
+    """Opt-in flows_raw archiving (ref: compose/clickhouse/create.sh:36-62):
+    the worker hands every consumed batch to sinks exposing archive_raw."""
+
+    class ArchivingSink(MemorySink):
+        def archive_raw(self, batch):
+            from flow_pipeline_tpu.sink.clickhouse import raw_records
+
+            recs = raw_records(batch)
+            self.tables.setdefault("flows_raw", []).extend(recs)
+            return len(recs)
+
+    def run_worker(self, archive: bool):
+        bus, all_flows = fill_bus(n=1000)
+        consumer = Consumer(bus, fixedlen=True)
+        sink = self.ArchivingSink()
+        worker = StreamWorker(
+            consumer,
+            {"flows_5m": WindowAggregator(WindowAggConfig(batch_size=512))},
+            [sink],
+            WorkerConfig(poll_max=512, archive_raw=archive),
+        )
+        worker.run(stop_when_idle=True)
+        return worker, sink, all_flows
+
+    def test_disabled_by_default_archives_nothing(self):
+        _, sink, _ = self.run_worker(archive=False)
+        assert "flows_raw" not in sink.tables
+
+    def test_every_flow_archived_full_fidelity(self):
+        worker, sink, all_flows = self.run_worker(archive=True)
+        rows = sink.tables["flows_raw"]
+        assert len(rows) == len(all_flows)
+        assert worker.m_raw.value() == len(all_flows)
+        # spot-check full fidelity on the first flow, including exact
+        # 16-byte address round-trip through the IPv6 text form
+        import ipaddress
+
+        from flow_pipeline_tpu.schema.batch import words_to_addr
+
+        c = all_flows.columns
+        r = rows[0]
+        assert r["Bytes"] == int(c["bytes"][0])
+        assert r["Packets"] == int(c["packets"][0])
+        assert r["SrcAS"] == int(c["src_as"][0])
+        assert r["TimeReceived"] == int(c["time_received"][0])
+        assert (ipaddress.IPv6Address(r["SrcAddr"]).packed
+                == words_to_addr(np.asarray(c["src_addr"][0], np.uint32)))
+        assert (ipaddress.IPv6Address(r["DstAddr"]).packed
+                == words_to_addr(np.asarray(c["dst_addr"][0], np.uint32)))
+        # Date is MATERIALIZED server-side from TimeReceived, not shipped
+        assert set(r) == {
+            "TimeReceived", "TimeFlowStart", "SequenceNum",
+            "SamplingRate", "SrcAddr", "DstAddr", "SrcAS", "DstAS",
+            "EType", "Proto", "SrcPort", "DstPort", "Bytes", "Packets",
+        }
+
+    def test_archive_forces_snapshot_commit(self):
+        # raw rows have no merge dedup, so every archived batch must be
+        # followed by an offset commit (duplicate window = one batch, not
+        # snapshot_every batches)
+        bus, _ = fill_bus(n=1000)
+        consumer = Consumer(bus, fixedlen=True)
+        sink = self.ArchivingSink()
+        worker = StreamWorker(
+            consumer,
+            {"flows_5m": WindowAggregator(WindowAggConfig(batch_size=512))},
+            [sink],
+            # snapshot_every=0: only the archive coupling can trigger commits
+            WorkerConfig(poll_max=512, snapshot_every=0, archive_raw=True),
+        )
+        worker.run_once()
+        # the one consumed batch's offsets are committed immediately
+        assert worker._covered  # one partition consumed
+        for p, next_off in worker._covered.items():
+            assert consumer.committed(p) == next_off
+
+
+class TestRestoreModelMismatch:
+    def test_checkpoint_with_extra_model_skipped(self, tmp_path):
+        # checkpoint written with a model that is later disabled must not
+        # crash restore (e.g. -model.ports flipped off between runs)
+        path = str(tmp_path / "ckpt")
+        bus, _ = fill_bus(n=1000)
+        worker, _ = make_worker(bus, checkpoint=path, snapshot_every=1)
+        worker.run(stop_when_idle=True)
+
+        consumer = Consumer(bus, fixedlen=True)
+        slim = StreamWorker(
+            consumer,
+            {"flows_5m": WindowAggregator(WindowAggConfig(batch_size=512))},
+            [MemorySink()],
+            WorkerConfig(poll_max=512, checkpoint_path=path),
+        )
+        assert slim.restore()  # top_talkers state present but unconfigured
+        assert slim.batches_seen == worker.batches_seen

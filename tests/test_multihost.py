@@ -1,0 +1,152 @@
+"""DCN multi-host tests (SURVEY.md §2: "DCN for multi-host").
+
+Two layers:
+- LocalShardFeeder's single-process path on the 8-device CPU mesh, fed
+  into a real sharded model (the code path every worker uses).
+- A genuine 2-process jax.distributed bootstrap over loopback, each
+  process contributing its local shard of a global array and running a
+  cross-process collective — the smallest real DCN-shaped exercise that
+  can run without two hosts.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import jax
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.parallel import make_mesh
+from flow_pipeline_tpu.parallel.multihost import LocalShardFeeder
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+class TestLocalShardFeederSingleProcess:
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        assert len(jax.devices()) == 8
+        return make_mesh()
+
+    def test_feed_shards_rows_over_mesh(self, mesh):
+        feeder = LocalShardFeeder(mesh)
+        n = 64  # 8 rows per device
+        cols = {
+            "bytes": np.arange(n, dtype=np.uint64),
+            "src_addr": np.tile(np.arange(4, dtype=np.uint32), (n, 1)),
+        }
+        valid = np.ones(n, bool)
+        out, v = feeder.feed_columns(cols, valid)
+        assert out["bytes"].shape == (n,)
+        assert out["src_addr"].shape == (n, 4)
+        # row-sharded: each of the 8 devices holds one 8-row shard
+        assert len(out["bytes"].sharding.device_set) == 8
+        shard = next(iter(out["bytes"].addressable_shards))
+        assert shard.data.shape == (8,)
+        np.testing.assert_array_equal(np.asarray(out["bytes"]), cols["bytes"])
+        np.testing.assert_array_equal(np.asarray(v), valid)
+
+    def test_fed_arrays_drive_sharded_model(self, mesh):
+        from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+        from flow_pipeline_tpu.models import HeavyHitterConfig
+        from flow_pipeline_tpu.models.oracle import topk_exact
+        from flow_pipeline_tpu.parallel import ShardedHeavyHitter
+
+        config = HeavyHitterConfig(batch_size=256, width=1 << 10, capacity=32)
+        model = ShardedHeavyHitter(config, mesh)
+        feeder = LocalShardFeeder(mesh)
+        g = FlowGenerator(ZipfProfile(n_keys=40, alpha=1.6), seed=77)
+        batch = g.batch(2048)
+        # feed through the multihost placement path instead of device_put
+        padded, mask = batch.pad_to(2048)
+        cols = padded.device_columns(
+            ["src_addr", "dst_addr", "bytes", "packets"]
+        )
+        fed, valid = feeder.feed_columns(
+            {k: np.asarray(v) for k, v in cols.items()}, np.asarray(mask)
+        )
+        model.update_device_columns(fed, valid)
+        oracle = topk_exact(batch, ["src_addr", "dst_addr"], 1)
+        top = model.top(1)
+        assert (top["src_addr"][0] == oracle["src_addr"][0]).all()
+
+
+WORKER_SCRIPT = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    sys.path.insert(0, {repo!r})
+    from flow_pipeline_tpu.utils.platform import force_cpu
+    force_cpu()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flow_pipeline_tpu.parallel import make_mesh
+    from flow_pipeline_tpu.parallel.multihost import (
+        LocalShardFeeder, init_distributed)
+
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    init_distributed(f"127.0.0.1:{{port}}", 2, pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4  # 2 local x 2 processes
+    assert len(jax.local_devices()) == 2
+    mesh = make_mesh()
+    feeder = LocalShardFeeder(mesh)
+    # each "host" contributes its own half of the global batch
+    local = np.full(8, float(pid + 1), np.float32)
+    cols, valid = feeder.feed_columns({{"x": local}}, np.ones(8, bool))
+    x = cols["x"]
+    assert x.shape == (16,)  # global rows = both hosts' halves
+    assert len(x.addressable_shards) == 2  # only this host's devices
+    total = float(jax.jit(jnp.sum)(x))  # cross-process collective
+    assert total == 8 * 1 + 8 * 2, total
+    print("MULTIHOST_OK", pid, total, flush=True)
+""")
+
+
+class TestTwoProcessDistributed:
+    def test_bootstrap_feed_and_collective(self, tmp_path):
+        port = _free_port()
+        script = tmp_path / "worker.py"
+        script.write_text(WORKER_SCRIPT.format(repo=os.path.abspath(REPO)))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)  # worker sets its own device count
+        # jax.distributed.initialize must run before ANY backend init, so
+        # the workers get a bare interpreter: no inherited PYTHONPATH (a
+        # sitecustomize there could eagerly register a backend — this
+        # environment has one) and no user site. The script inserts the
+        # repo itself into sys.path.
+        env["PYTHONPATH"] = ""
+        env["PYTHONNOUSERSITE"] = "1"
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(pid), str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env,
+            )
+            for pid in (0, 1)
+        ]
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("distributed worker timed out")
+            outs.append(out)
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+            assert f"MULTIHOST_OK {pid} 24.0" in out
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
