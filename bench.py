@@ -120,60 +120,186 @@ def bench_decode() -> None:
 
 
 def bench_cms() -> None:
-    """XLA scatter-add vs Pallas one-hot MXU kernel for the CMS update."""
+    """CMS update shootout: XLA scatter vs Pallas dense-tile kernels, for
+    both the linear and conservative updates (all four share one bucket
+    scheme/state — ops.cms / ops.cms_pallas). The flagship config is
+    conservative, so the row to watch is cu_*."""
     import numpy as np
 
     import jax
     import jax.numpy as jnp
 
-    from flow_pipeline_tpu.ops.cms import cms_add, cms_init
-    from flow_pipeline_tpu.ops.cms_pallas import cms_add_pallas
+    from flow_pipeline_tpu.ops.cms import (
+        cms_add,
+        cms_add_conservative,
+        cms_init,
+    )
+    from flow_pipeline_tpu.ops.cms_pallas import (
+        cms_add_conservative_pallas,
+        cms_add_pallas,
+    )
 
     rng = np.random.default_rng(0)
-    n, planes, depth, width = 4096, 3, 4, 1 << 16
+    n, planes, depth, width = 8192, 3, 4, 1 << 16
     keys = jnp.asarray(rng.integers(0, 2**31, size=(n, 8), dtype=np.int64)
                        .astype(np.int32))
     vals = jnp.asarray(rng.integers(1, 1500, size=(n, planes))
                        .astype(np.float32))
     valid = jnp.ones(n, bool)
     on_tpu = jax.devices()[0].platform != "cpu"
+    interp = {"interpret": not on_tpu}
 
+    variants = {
+        "lin_xla": jax.jit(cms_add),
+        "lin_pallas": lambda c, k, v, m: cms_add_pallas(c, k, v, m, **interp),
+        "cu_xla": jax.jit(cms_add_conservative),
+        "cu_pallas": lambda c, k, v, m: cms_add_conservative_pallas(
+            c, k, v, m, **interp),
+    }
     results = {}
-    scatter = jax.jit(cms_add)
-    s = scatter(cms_init(planes, depth, width), keys, vals, valid)
-    jax.block_until_ready(s)
-    t0 = time.perf_counter()
-    for _ in range(20):
-        s = scatter(s, keys, vals, valid)
-    jax.block_until_ready(s)
-    results["xla_scatter_us"] = round((time.perf_counter() - t0) / 20 * 1e6, 1)
-
-    p = cms_add_pallas(cms_init(planes, depth, width), keys, vals, valid,
-                       interpret=not on_tpu)
-    jax.block_until_ready(p)
-    t0 = time.perf_counter()
-    for _ in range(20 if on_tpu else 2):
-        p = cms_add_pallas(p, keys, vals, valid, interpret=not on_tpu)
-    jax.block_until_ready(p)
-    reps = 20 if on_tpu else 2
-    results["pallas_onehot_us"] = round((time.perf_counter() - t0) / reps * 1e6, 1)
+    for name, fn in variants.items():
+        reps = 20 if (on_tpu or "xla" in name) else 2
+        s = fn(cms_init(planes, depth, width), keys, vals, valid)
+        jax.block_until_ready(s)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            s = fn(s, keys, vals, valid)
+        jax.block_until_ready(s)
+        us = (time.perf_counter() - t0) / reps * 1e6
+        results[f"{name}_us"] = round(us, 1)
+        results[f"{name}_mflows_s"] = round(n / us, 2)
+    cu = {k: v for k, v in results.items()
+          if k.startswith("cu_") and k.endswith("_us")}
+    results["cu_winner"] = min(cu, key=cu.get).removesuffix("_us")
     results["pallas_compiled"] = on_tpu
     print(json.dumps({"metric": "cms update step", "unit": "us/batch",
-                      **results}))
+                      "batch": n, **results}))
 
 
 def bench_e2e() -> None:
-    """Full in-process pipeline (host decode + device models + sinks)."""
-    from flow_pipeline_tpu.cli import main as cli_main
+    """Full in-process pipeline flows/sec: bus fetch + wire decode +
+    columnarization + ALL device models + sink flushes. The north star is
+    a pipeline rate, so this is measured as flows/sec like the kernel
+    bench — produce time is excluded (production happens upstream of the
+    processor in the reference architecture too)."""
+    from flow_pipeline_tpu.cli import (
+        _batch_frames, _make_generator, _processor_flags, _common_flags,
+        _gen_flags,
+    )
+    from flow_pipeline_tpu.engine import StreamWorker, WorkerConfig
+    from flow_pipeline_tpu.transport import Consumer, InProcessBus
+    from flow_pipeline_tpu.utils.flags import FlagSet
 
+    n = 400_000
+    fs = _processor_flags(_gen_flags(_common_flags(FlagSet("bench"))))
+    vals = fs.parse(["-produce.profile", "zipf",
+                     "-processor.batch", "16384"])
+    bus = InProcessBus()
+    bus.create_topic("flows", 2)
+    gen = _make_generator(vals)
+    produced = 0
+    while produced < n:
+        for frame in _batch_frames(gen.batch(16384)):
+            bus.produce("flows", frame)
+        produced += 16384
+
+    from flow_pipeline_tpu.cli import _build_models
+
+    worker = StreamWorker(
+        Consumer(bus, fixedlen=True),
+        _build_models(vals),
+        [],  # stdout sink noise excluded; sink writes are benched via insert paths
+        WorkerConfig(poll_max=vals["processor.batch"], snapshot_every=0),
+    )
+    worker.run_once()  # warm the compile caches on the first batch
     t0 = time.perf_counter()
-    cli_main(["pipeline", "-produce.count", "200000", "-produce.profile",
-              "zipf", "-processor.batch", "16384", "-sink", "stdout",
-              "-metrics.addr", "", "-loglevel", "warning"])
-    # the pipeline command logs its own rate; emit a coarse one here too
-    print(json.dumps({"metric": "e2e wall time (200k flows, all models)",
-                      "value": round(time.perf_counter() - t0, 2),
-                      "unit": "seconds"}))
+    worker.run(stop_when_idle=True)
+    dt = time.perf_counter() - t0
+    rate = (produced - vals["processor.batch"]) / dt
+    print(json.dumps({
+        "metric": "e2e pipeline throughput (decode + all models + flush)",
+        "value": round(rate, 1),
+        "unit": "flows/sec",
+        "vs_baseline": round(rate / 100_000.0, 3),
+        "platform": _PLATFORM,
+    }))
+
+
+def bench_sharded(n_devices: int = 8) -> None:
+    """Multi-chip flagship step over an n-device mesh: aggregate flows/sec
+    across shards plus the window-close merge cost (psum + table fold over
+    ICI on real hardware). On CPU the mesh is virtual host devices, which
+    validates the sharding program and grounds the v5e-8 extrapolation the
+    day multi-chip hardware is attached."""
+    import os
+
+    import jax
+
+    if _PLATFORM == "cpu" and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    have = len(jax.devices())
+    n_devices = min(n_devices, have)
+
+    from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+    from flow_pipeline_tpu.models import heavy_hitter as hh
+    from flow_pipeline_tpu.parallel import ShardedHeavyHitter, make_mesh
+
+    PER_CHIP = 16384
+    STEPS = 24
+    mesh = make_mesh(n_devices)
+    config = hh.HeavyHitterConfig(
+        key_cols=("src_addr", "dst_addr"), batch_size=PER_CHIP,
+        width=1 << 16, capacity=1024,
+    )
+    model = ShardedHeavyHitter(config, mesh)
+    gen = FlowGenerator(ZipfProfile(n_keys=100_000, alpha=1.1), seed=0)
+    # pre-shard onto the mesh outside the timed loop — same methodology as
+    # the single-chip bench (the metric is the aggregation tier, not the
+    # host columnarize/transfer path)
+    from flow_pipeline_tpu.parallel import shard_batch_columns
+
+    staged = []
+    for _ in range(4):
+        b = gen.batch(model.global_batch)
+        cols = b.device_columns([*config.key_cols, *config.value_cols])
+        import numpy as np
+
+        staged.append(shard_batch_columns(
+            mesh, {k: np.asarray(v) for k, v in cols.items()},
+            np.ones(model.global_batch, bool),
+        ))
+
+    model.update_device_columns(*staged[0])  # warm / compile
+    jax.block_until_ready(model.state)
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        model.update_device_columns(*staged[i % len(staged)])
+    jax.block_until_ready(model.state)
+    dt = time.perf_counter() - t0
+    rate = model.global_batch * STEPS / dt
+
+    merged = model.merged_state()  # warm the merge path
+    jax.block_until_ready(merged)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        merged = model.merged_state()
+    jax.block_until_ready(merged)
+    merge_us = (time.perf_counter() - t0) / 10 * 1e6
+
+    print(json.dumps({
+        "metric": f"sharded heavy-hitter throughput ({n_devices}-device mesh)",
+        "value": round(rate, 1),
+        "unit": "flows/sec",
+        "vs_baseline": round(rate / 100_000.0, 3),
+        "per_chip_flows_sec": round(rate / n_devices, 1),
+        "merge_us": round(merge_us, 1),
+        "n_devices": n_devices,
+        "platform": _PLATFORM,
+    }))
 
 
 if __name__ == "__main__":
@@ -187,6 +313,8 @@ if __name__ == "__main__":
         bench_cms()
     elif mode == "e2e":
         bench_e2e()
+    elif mode == "sharded":
+        bench_sharded(int(sys.argv[2]) if len(sys.argv) > 2 else 8)
     else:
         print(json.dumps({"error": f"unknown mode {mode}"}))
         sys.exit(2)

@@ -139,6 +139,7 @@ def _build_models(vals):
             batch_size=batch,
             width=vals["sketch.width"],
             capacity=vals["sketch.capacity"],
+            cms_impl=vals["sketch.cms"],
         )
         if mesh:
             from .parallel import ShardedHeavyHitter
@@ -180,6 +181,7 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.boolean("model.ports", True, "Top src/dst port models")
     fs.boolean("model.ddos", True, "DDoS spike detector")
     fs.integer("sketch.width", 1 << 16, "Count-min width")
+    fs.string("sketch.cms", "xla", "CMS update impl: xla | pallas")
     fs.integer("sketch.capacity", 1024, "Top-K table capacity")
     fs.integer("sketch.topk", 100, "Rows emitted per window")
     fs.integer("window.lateness", 0, "Allowed lateness seconds")

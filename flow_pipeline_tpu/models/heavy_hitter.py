@@ -45,6 +45,11 @@ class HeavyHitterConfig:
     capacity: int = 1024  # candidate table rows
     batch_size: int = 8192
     conservative: bool = True
+    # CMS update implementation: "xla" (scatter) or "pallas" (dense tile
+    # kernels, ops.cms_pallas — same bucket scheme/state, so the choice is
+    # purely a per-hardware performance call; bench.py cms measures both).
+    # On CPU the pallas path runs in interpret mode (tests only).
+    cms_impl: str = "xla"
 
 
 class HHState(NamedTuple):
@@ -80,6 +85,38 @@ def _key_lanes(cols: dict, key_cols) -> jnp.ndarray:
     return jnp.concatenate(lanes, axis=1)
 
 
+def _cms_add(config: HeavyHitterConfig):
+    """Select the CMS update op for (conservative, cms_impl). All four
+    share ops.cms's bucket scheme and state layout, so the selection can
+    change between runs (even mid-stream) without invalidating a sketch."""
+    if config.cms_impl == "pallas":
+        import math
+
+        from ..ops import cms_pallas
+
+        # Derive kernel tilings from the config so any width/batch the
+        # xla impl accepts works here too (instead of crashing on the
+        # first batch with a divisibility error from the defaults).
+        if config.width % 128:
+            raise ValueError(
+                f"cms_impl='pallas' needs width % 128 == 0, got {config.width}"
+            )
+        tile = next(t for t in (2048, 1024, 512, 256, 128)
+                    if config.width % t == 0)
+        interpret = jax.default_backend() == "cpu"
+        if config.conservative:
+            chunk = math.gcd(config.batch_size, 512)
+            return partial(cms_pallas.cms_add_conservative_pallas,
+                           tile=min(tile, 512), chunk=chunk,
+                           interpret=interpret)
+        return partial(cms_pallas.cms_add_pallas, tile=tile,
+                       interpret=interpret)
+    if config.cms_impl != "xla":
+        raise ValueError(f"unknown cms_impl {config.cms_impl!r}")
+    return (cms_ops.cms_add_conservative if config.conservative
+            else cms_ops.cms_add)
+
+
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("state",))
 def hh_update(state: HHState, cols: dict, valid, *, config: HeavyHitterConfig) -> HHState:
     """One batch step, fully on device."""
@@ -97,8 +134,7 @@ def hh_update(state: HHState, cols: dict, valid, *, config: HeavyHitterConfig) -
     )
     uniq, sums, counts = sort_groupby_float(keys, values, valid)
     row_valid = counts > 0
-    add = cms_ops.cms_add_conservative if config.conservative else cms_ops.cms_add
-    new_cms = add(state.cms, uniq, sums, row_valid)
+    new_cms = _cms_add(config)(state.cms, uniq, sums, row_valid)
     tk, tv = topk_ops.topk_merge(
         state.table_keys, state.table_vals, uniq, sums, row_valid
     )

@@ -1,22 +1,26 @@
-"""Pallas CMS update kernel: scatter-add as one-hot matmul on the MXU.
+"""Pallas CMS update kernels: scatter as dense tile math on the MXU/VPU.
 
-XLA lowers ``counts.at[buckets].add(v)`` to a scatter, which the TPU
-executes with serialized conflict handling. The TPU-native formulation
-turns the histogram update into dense linear algebra:
+XLA lowers ``counts.at[buckets].add(v)`` / ``.max(v)`` to scatters, which
+the TPU executes with serialized conflict handling. The TPU-native
+formulation turns both CMS updates into dense per-tile work:
 
-    onehot[n, w] = (bucket[n] == w)          # VPU compare vs iota
-    counts[p, d, :] += vals[:, p] @ onehot   # [P,N] x [N,W] on the MXU
+- linear add:  onehot[n, w] = (bucket[n] == w) built against the tile's
+  column range on the VPU, then ``counts[p, d, tile] += vals.T @ onehot``
+  — one [P,N]x[N,T] matmul per grid cell on the MXU.
+- conservative update: the per-key ceiling ``target = est + vals`` is
+  computed first (the estimate gather is already fast under XLA — it is
+  scatters, not gathers, that serialize), then a max-scatter kernel
+  raises each tile cell to ``max over keys in cell of target`` by
+  streaming N in chunks through a masked VPU max-reduce.
 
-The kernel fuses, per (depth, width-tile) grid cell: murmur3 bucket hashing
-of the key word-lanes (seeded per depth), one-hot construction against the
-tile's column range, and the accumulate matmul. State stays in VMEM across
-the grid via input/output aliasing; nothing round-trips to HBM between
-depth rows.
+Both kernels use the SAME bucket scheme as ops.cms (cms_buckets): they are
+drop-in replacements for cms_add / cms_add_conservative on the same sketch
+state, and ops.cms.cms_query serves either path. State stays in VMEM per
+grid cell via input/output aliasing.
 
-This mirrors the update semantics of ops.cms.cms_add exactly (linear,
-mergeable). Use ``cms_add_pallas`` as a drop-in replacement; bench.py can
-compare both paths on hardware. Correctness is tested in interpret mode on
-CPU (tests/test_cms_pallas.py).
+Correctness is tested in interpret mode on CPU (tests/test_cms_pallas.py);
+bench.py cms compares the XLA and Pallas paths on hardware, and
+models.heavy_hitter dispatches on HeavyHitterConfig.cms_impl.
 """
 
 from __future__ import annotations
@@ -27,16 +31,15 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ..schema.keys import hash_words
+from .cms import cms_buckets, cms_query
 
 _LANE = 128  # TPU lane width; width tiles are multiples of this
 
 
-def _kernel(buckets_ref, vals_ref, counts_ref, out_ref, *, tile: int):
+def _add_kernel(buckets_ref, vals_ref, counts_ref, out_ref, *, tile: int):
     """Grid cell (d, j): accumulate depth row d's contributions to columns
-    [j*tile, (j+1)*tile). Buckets are precomputed once on the host side of
-    the jit (hashing all keys per grid cell would redo width/tile times the
-    work on the VPU)."""
+    [j*tile, (j+1)*tile). Buckets are precomputed once outside the kernel
+    (hashing per grid cell would redo width/tile times the work)."""
     j = pl.program_id(1)
 
     bucket = buckets_ref[0, :]  # [N] this depth row's bucket per key
@@ -50,40 +53,23 @@ def _kernel(buckets_ref, vals_ref, counts_ref, out_ref, *, tile: int):
     out_ref[:] = counts_ref[:] + update[:, None, :]  # [P, 1, T]
 
 
-def cms_buckets_mixed(keys, depth: int, width: int):
-    """Bucket indices matching the kernel's depth-mixing scheme (host/query
-    side twin). [depth, N] int32."""
-    h = hash_words(jnp.asarray(keys).astype(jnp.uint32), seed=0)
-    rows = []
-    for d in range(depth):
-        hd = hash_words(
-            jnp.stack([h, jnp.full_like(h, jnp.uint32(d))], axis=-1), seed=0
-        )
-        rows.append((hd % jnp.uint32(width)).astype(jnp.int32))
-    return jnp.stack(rows, axis=0)
-
-
 @functools.partial(jax.jit, static_argnames=("tile", "interpret"))
 def cms_add_pallas(counts, keys, values, valid=None, *, tile: int = 2048,
                    interpret: bool = False):
-    """Linear CMS update via the one-hot MXU kernel.
-
-    counts: [P, D, W] float32; keys: [N, Wk] int lanes; values: [N, P].
-    Bucket placement uses the depth-mixed murmur scheme (cms_buckets_mixed),
-    which differs from ops.cms.cms_buckets seeding but has identical
-    statistical properties; query with cms_query_mixed.
-    """
+    """Linear CMS update via the one-hot MXU kernel; drop-in for
+    ops.cms.cms_add (same bucket scheme, same state, query with
+    ops.cms.cms_query)."""
     p, d, w = counts.shape
     if w % tile:
         raise ValueError(f"width {w} must be a multiple of tile {tile}")
     vals = values.astype(jnp.float32)
     if valid is not None:
         vals = jnp.where(valid[:, None], vals, 0.0)
-    buckets = cms_buckets_mixed(keys, d, w)  # [D, N], hashed exactly once
+    buckets = cms_buckets(keys, d, w)  # [D, N], hashed exactly once
 
     grid = (d, w // tile)
     return pl.pallas_call(
-        functools.partial(_kernel, tile=tile),
+        functools.partial(_add_kernel, tile=tile),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, buckets.shape[1]), lambda di, j: (di, 0)),
@@ -97,9 +83,74 @@ def cms_add_pallas(counts, keys, values, valid=None, *, tile: int = 2048,
     )(buckets, vals, counts)
 
 
-def cms_query_mixed(counts, keys):
-    """Point estimates under the kernel's bucket scheme. [N, P] float32."""
+def _max_kernel(buckets_ref, target_ref, counts_ref, out_ref, *,
+                tile: int, chunk: int):
+    """Grid cell (d, j): raise columns [j*tile, (j+1)*tile) of depth row d
+    to the max target of any key hashing there. N is streamed in chunks so
+    the [chunk, tile] mask stays VMEM-resident."""
+    j = pl.program_id(1)
+    n, p = target_ref.shape
+
+    col0 = j * tile
+    cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)  # [1,T]
+
+    def body(c, acc):
+        # [C] bucket slice, [C, P] targets for this chunk of keys
+        bucket = jax.lax.dynamic_slice(buckets_ref[0, :], (c * chunk,),
+                                       (chunk,))
+        tgt = jax.lax.dynamic_slice(target_ref[:], (c * chunk, 0),
+                                    (chunk, p))
+        mask = bucket[:, None] == cols  # [C, T]
+        # per plane: max over the chunk's keys of (in-cell ? target : 0);
+        # cells are >= 0, so 0 never raises anything
+        planes = [
+            jnp.max(jnp.where(mask, tgt[:, pi][:, None], 0.0), axis=0)
+            for pi in range(p)
+        ]
+        return jnp.maximum(acc, jnp.stack(planes, axis=0))  # [P, T]
+
+    acc = jax.lax.fori_loop(0, n // chunk, body, counts_ref[:, 0, :])
+    out_ref[:] = acc[:, None, :]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tile", "chunk", "interpret"))
+def cms_add_conservative_pallas(counts, keys, values, valid=None, *,
+                                tile: int = 512, chunk: int = 512,
+                                interpret: bool = False):
+    """Conservative CMS update; drop-in for ops.cms.cms_add_conservative.
+
+    The current-estimate gather runs in XLA (gathers do not serialize);
+    only the conflict-prone scatter-max is a Pallas kernel. Keys must be
+    unique within the call (sort_groupby first), matching the XLA path's
+    contract."""
     p, d, w = counts.shape
-    buckets = cms_buckets_mixed(keys, d, w)
-    ests = [counts[:, di, buckets[di]] for di in range(d)]
-    return jnp.min(jnp.stack(ests, axis=0), axis=0).T
+    n = keys.shape[0]
+    if w % tile:
+        raise ValueError(f"width {w} must be a multiple of tile {tile}")
+    if n % chunk:
+        raise ValueError(f"rows {n} must be a multiple of chunk {chunk}")
+    vals = values.astype(jnp.float32)
+    if valid is not None:
+        vals = jnp.where(valid[:, None], vals, 0.0)
+    buckets = cms_buckets(keys, d, w)  # [D, N]
+    est = cms_query(counts, keys)  # [N, P]
+    target = est + vals  # the CU ceiling per key
+    if valid is not None:
+        # invalid rows must not raise any cell (est alone could)
+        target = jnp.where(valid[:, None], target, 0.0)
+
+    grid = (d, w // tile)
+    return pl.pallas_call(
+        functools.partial(_max_kernel, tile=tile, chunk=chunk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, n), lambda di, j: (di, 0)),
+            pl.BlockSpec(target.shape, lambda di, j: (0, 0)),
+            pl.BlockSpec((p, 1, tile), lambda di, j: (0, di, j)),
+        ],
+        out_specs=pl.BlockSpec((p, 1, tile), lambda di, j: (0, di, j)),
+        out_shape=jax.ShapeDtypeStruct(counts.shape, jnp.float32),
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(buckets, target, counts)
