@@ -168,9 +168,12 @@ def bench_cms() -> None:
         us = (time.perf_counter() - t0) / reps * 1e6
         results[f"{name}_us"] = round(us, 1)
         results[f"{name}_mflows_s"] = round(n / us, 2)
-    cu = {k: v for k, v in results.items()
-          if k.startswith("cu_") and k.endswith("_us")}
-    results["cu_winner"] = min(cu, key=cu.get).removesuffix("_us")
+    if on_tpu:
+        # only meaningful when both paths ran compiled; a CPU run would
+        # compare compiled XLA against interpret-mode Pallas
+        cu = {k: v for k, v in results.items()
+              if k.startswith("cu_") and k.endswith("_us")}
+        results["cu_winner"] = min(cu, key=cu.get).removesuffix("_us")
     results["pallas_compiled"] = on_tpu
     print(json.dumps({"metric": "cms update step", "unit": "us/batch",
                       "batch": n, **results}))

@@ -90,13 +90,11 @@ def _cms_add(config: HeavyHitterConfig):
     share ops.cms's bucket scheme and state layout, so the selection can
     change between runs (even mid-stream) without invalidating a sketch."""
     if config.cms_impl == "pallas":
-        import math
-
         from ..ops import cms_pallas
 
-        # Derive kernel tilings from the config so any width/batch the
-        # xla impl accepts works here too (instead of crashing on the
-        # first batch with a divisibility error from the defaults).
+        # Derive the width tile from the config so any width the xla impl
+        # accepts works here too (the conservative kernel pads the row
+        # dimension itself, so batch size is unconstrained).
         if config.width % 128:
             raise ValueError(
                 f"cms_impl='pallas' needs width % 128 == 0, got {config.width}"
@@ -105,10 +103,8 @@ def _cms_add(config: HeavyHitterConfig):
                     if config.width % t == 0)
         interpret = jax.default_backend() == "cpu"
         if config.conservative:
-            chunk = math.gcd(config.batch_size, 512)
             return partial(cms_pallas.cms_add_conservative_pallas,
-                           tile=min(tile, 512), chunk=chunk,
-                           interpret=interpret)
+                           tile=min(tile, 512), interpret=interpret)
         return partial(cms_pallas.cms_add_pallas, tile=tile,
                        interpret=interpret)
     if config.cms_impl != "xla":

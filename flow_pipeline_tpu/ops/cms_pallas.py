@@ -128,24 +128,27 @@ def cms_add_conservative_pallas(counts, keys, values, valid=None, *,
     n = keys.shape[0]
     if w % tile:
         raise ValueError(f"width {w} must be a multiple of tile {tile}")
-    if n % chunk:
-        raise ValueError(f"rows {n} must be a multiple of chunk {chunk}")
-    vals = values.astype(jnp.float32)
-    if valid is not None:
-        vals = jnp.where(valid[:, None], vals, 0.0)
     buckets = cms_buckets(keys, d, w)  # [D, N]
     est = cms_query(counts, keys)  # [N, P]
-    target = est + vals  # the CU ceiling per key
+    target = est + values.astype(jnp.float32)  # the CU ceiling per key
     if valid is not None:
-        # invalid rows must not raise any cell (est alone could)
+        # invalid rows must not raise any cell (their est alone could);
+        # a 0 target is inert — cells are >= 0 and only move via max
         target = jnp.where(valid[:, None], target, 0.0)
+    if n % chunk:
+        # pad the streamed dimension to a chunk multiple with inert rows
+        # (zero targets) so chunk stays large for ANY batch size instead
+        # of collapsing to gcd(n, chunk)
+        pad = chunk - n % chunk
+        buckets = jnp.pad(buckets, ((0, 0), (0, pad)))
+        target = jnp.pad(target, ((0, pad), (0, 0)))
 
     grid = (d, w // tile)
     return pl.pallas_call(
         functools.partial(_max_kernel, tile=tile, chunk=chunk),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, n), lambda di, j: (di, 0)),
+            pl.BlockSpec((1, buckets.shape[1]), lambda di, j: (di, 0)),
             pl.BlockSpec(target.shape, lambda di, j: (0, 0)),
             pl.BlockSpec((p, 1, tile), lambda di, j: (0, di, j)),
         ],
