@@ -187,6 +187,8 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.integer("window.lateness", 0, "Allowed lateness seconds")
     fs.boolean("archive.raw", False, "Archive full-fidelity rows to "
                                      "flows_raw on sinks that support it")
+    fs.integer("feed.prefetch", 2, "Decoded batches fetched ahead of the "
+                                   "device step (0 disables)")
     fs.string("checkpoint.path", "", "Snapshot directory")
     fs.integer("flush.count", 50, "Batches between snapshots")
     fs.string("metrics.addr", "127.0.0.1:8081", "host:port for /metrics "
@@ -327,6 +329,7 @@ def processor_main(argv=None) -> int:
                 snapshot_every=vals["flush.count"],
                 checkpoint_path=vals["checkpoint.path"] or None,
                 archive_raw=vals["archive.raw"],
+                prefetch=vals["feed.prefetch"],
             ),
         )
         if vals["query.addr"]:
@@ -474,7 +477,8 @@ def pipeline_main(argv=None) -> int:
         WorkerConfig(poll_max=vals["processor.batch"],
                      snapshot_every=vals["flush.count"],
                      checkpoint_path=vals["checkpoint.path"] or None,
-                     archive_raw=vals["archive.raw"]),
+                     archive_raw=vals["archive.raw"],
+                     prefetch=vals["feed.prefetch"]),
     )
     query = None
     if vals["query.addr"]:

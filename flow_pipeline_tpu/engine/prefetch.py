@@ -1,0 +1,170 @@
+"""Prefetching consumer: overlap host fetch+decode with the device step.
+
+At the north-star rate the host path (bus fetch, wire decode,
+columnarization) and the device path (jitted model updates) each take a
+meaningful fraction of the batch budget; run serially they add up
+(SURVEY.md §7 hard part (b): "double-buffered host->HBM feed"). This
+wrapper runs the wrapped consumer on a dedicated thread, keeping a small
+bounded queue of decoded batches ready, so the worker's device step for
+batch i overlaps the host work for batch i+1 (JAX's async dispatch then
+overlaps the device work itself with the NEXT poll).
+
+Threading contract: the wrapped consumer is owned ENTIRELY by the
+prefetch thread after start — kafka-python consumers are not thread-safe,
+so commits are routed to that thread through a command queue and executed
+between polls. ``flush_commits()`` blocks until queued commits have hit
+the broker; the worker calls it after each snapshot so the at-least-once
+protocol (state durable -> offsets committed) keeps its ordering.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+from ..obs import get_logger
+
+log = get_logger("prefetch")
+
+
+class PrefetchConsumer:
+    """Wraps a transport consumer with a fetch-ahead thread.
+
+    depth is the max decoded batches held ready (2 = classic double
+    buffering). The wrapper exposes the consumer surface the worker uses:
+    poll / commit / committed / lag / positions.
+    """
+
+    def __init__(self, consumer, depth: int = 2, poll_max: int = 8192,
+                 idle_sleep: float = 0.02):
+        self.inner = consumer
+        self.depth = depth
+        self.poll_max = poll_max
+        self.idle_sleep = idle_sleep
+        self._batches: queue.Queue = queue.Queue(maxsize=depth)
+        self._commits: queue.Queue = queue.Queue()
+        # pending-commit accounting: incremented on enqueue, decremented
+        # after execution on the owner thread; a bare "queue empty" test
+        # would race with a commit that is cleared-but-not-yet-enqueued
+        self._pending = 0
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._idle = threading.Event()  # last inner.poll returned nothing
+        self._rounds = 0  # completed inner.poll attempts (feed thread)
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- consumer surface --------------------------------------------------
+
+    def poll(self, max_messages: int = 8192):
+        """Next prefetched batch, or None when the UNDERLYING consumer is
+        idle. Blocks briefly while a fetch is in flight — returning None
+        mid-fetch would make stop_when_idle callers quit a non-empty
+        stream just because the thread hadn't finished its first poll."""
+        if self._thread is None:
+            self.poll_max = max_messages
+            self._start()
+        # Return None only after a poll round that STARTED after this call
+        # came back empty: the sticky idle flag alone could be stale (a
+        # producer may have published while the feed thread slept), and a
+        # premature None makes stop_when_idle callers abandon the tail.
+        start_rounds = self._rounds
+        while True:
+            try:
+                return self._batches.get(timeout=self.idle_sleep)
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    return None
+                if self._idle.is_set() and self._rounds > start_rounds:
+                    return None
+
+    def commit(self, partition: int, next_offset: int) -> None:
+        """Queue the commit for the owner thread (kafka-python consumers
+        are not thread-safe). flush_commits() awaits execution."""
+        if self._thread is None:
+            # nothing polled yet -> no thread owns the consumer; commit
+            # directly (restore-time / idle-shutdown path)
+            self.inner.commit(partition, next_offset)
+            return
+        with self._cv:
+            self._pending += 1
+        self._commits.put((partition, next_offset))
+
+    def flush_commits(self, timeout: float = 30.0) -> None:
+        """Block until every queued commit has executed on the consumer."""
+        if self._thread is None:
+            return
+        with self._cv:
+            if not self._cv.wait_for(lambda: self._pending == 0, timeout):
+                raise TimeoutError("prefetch commit queue did not drain")
+
+    def __getattr__(self, name):
+        # committed / lag / positions etc. delegate to the wrapped
+        # consumer, and only exist if IT has them (callers feature-test
+        # with hasattr). restore() adjusts .positions BEFORE the first
+        # poll starts the thread; afterwards the thread owns them.
+        return getattr(self.inner, name)
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def _start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="feed-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Drain queued commits and stop the thread (batches already
+        prefetched but unread are dropped — uncommitted, so they replay)."""
+        if self._thread is None:
+            return
+        self.flush_commits(timeout)
+        self._stop.set()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            # The thread is stuck in a blocking inner call (broker stall).
+            # Refuse to relinquish ownership: _stop stays set so it exits
+            # when the call returns, and commit()/poll() keep routing
+            # through the queue instead of touching the non-thread-safe
+            # consumer concurrently.
+            raise TimeoutError("prefetch thread did not stop in time")
+        self._thread = None
+        self._stop.clear()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._drain_commits()
+            if self._batches.full():
+                # device side is behind; yield instead of spinning
+                self._stop.wait(self.idle_sleep)
+                continue
+            try:
+                batch = self.inner.poll(self.poll_max)
+            except Exception:  # noqa: BLE001 — surface, don't kill the feed
+                log.exception("prefetch poll failed")
+                self._stop.wait(self.idle_sleep)
+                continue
+            if batch is None or len(batch) == 0:
+                self._idle.set()
+                self._rounds += 1
+                self._stop.wait(self.idle_sleep)
+                continue
+            self._idle.clear()
+            self._rounds += 1
+            self._batches.put(batch)
+        self._drain_commits()
+
+    def _drain_commits(self) -> None:
+        while True:
+            try:
+                partition, next_offset = self._commits.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                self.inner.commit(partition, next_offset)
+            except Exception:  # noqa: BLE001
+                log.exception("prefetch commit failed")
+            finally:
+                with self._cv:
+                    self._pending -= 1
+                    self._cv.notify_all()

@@ -20,6 +20,7 @@ from ..models.heavy_hitter import HHState
 from ..models.window_agg import WindowAggregator
 from ..obs import REGISTRY, get_logger
 from .checkpoint import load_checkpoint, save_checkpoint
+from .prefetch import PrefetchConsumer
 from .windowed import WindowedHeavyHitter
 
 log = get_logger("worker")
@@ -31,6 +32,10 @@ class WorkerConfig:
     snapshot_every: int = 50  # batches between snapshots (0 = never)
     checkpoint_path: Optional[str] = None
     idle_sleep: float = 0.05
+    # Double-buffered feed (SURVEY §7): >0 wraps the consumer in a
+    # PrefetchConsumer holding this many decoded batches ready, so host
+    # fetch+decode for batch i+1 overlaps the device step for batch i.
+    prefetch: int = 2
     # Full-fidelity raw archiving (the reference's flows_raw path,
     # ref: compose/clickhouse/create.sh:36-62): every consumed batch is
     # handed to sinks exposing archive_raw(batch). Off by default — the
@@ -51,6 +56,10 @@ class StreamWorker:
 
     def __init__(self, consumer, models: dict[str, Any],
                  sinks: Sequence[Any] = (), config: WorkerConfig = WorkerConfig()):
+        if config.prefetch and consumer is not None and not isinstance(
+                consumer, PrefetchConsumer):
+            consumer = PrefetchConsumer(consumer, depth=config.prefetch,
+                                        poll_max=config.poll_max)
         self.consumer = consumer
         self.models = models
         self.sinks = list(sinks)
@@ -133,15 +142,27 @@ class StreamWorker:
 
     def run(self, max_batches: Optional[int] = None,
             stop_when_idle: bool = False) -> None:
-        done = 0
-        while max_batches is None or done < max_batches:
-            if self.run_once():
-                done += 1
-            elif stop_when_idle:
-                break
-            else:
-                time.sleep(self.config.idle_sleep)
-        self.finalize()
+        try:
+            done = 0
+            while max_batches is None or done < max_batches:
+                if self.run_once():
+                    done += 1
+                elif stop_when_idle:
+                    break
+                else:
+                    time.sleep(self.config.idle_sleep)
+            self.finalize()
+        finally:
+            # A crash mid-loop (e.g. a sink raising in _emit) must not
+            # leak the feed thread: it owns the wrapped consumer, and with
+            # a real broker a zombie would keep the partitions assigned
+            # while a supervisor-built replacement starves. Best effort —
+            # never mask the original exception.
+            if isinstance(self.consumer, PrefetchConsumer):
+                try:
+                    self.consumer.stop()
+                except Exception:  # noqa: BLE001
+                    log.exception("prefetch stop failed during unwind")
 
     # ---- flushing ---------------------------------------------------------
 
@@ -177,6 +198,8 @@ class StreamWorker:
             self.snapshot_and_commit()
         if hasattr(self.consumer, "lag"):
             self.m_lag.set(self.consumer.lag())
+        if isinstance(self.consumer, PrefetchConsumer):
+            self.consumer.stop()
 
     # ---- checkpoint / offsets --------------------------------------------
 
@@ -188,6 +211,10 @@ class StreamWorker:
         self._emitted_since_snapshot = False
         for partition, next_off in sorted(self._covered.items()):
             self.consumer.commit(partition, next_off)
+        if isinstance(self.consumer, PrefetchConsumer):
+            # commits execute on the feed thread; wait so the protocol's
+            # ordering (state durable -> offsets committed) stays true
+            self.consumer.flush_commits()
         if hasattr(self.consumer, "lag"):
             self.m_lag.set(self.consumer.lag())
 
