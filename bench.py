@@ -19,8 +19,10 @@ Modes (default ``hh`` is what the driver records):
 
     python bench.py              # flagship heavy-hitter step, one JSON line
     python bench.py decode       # native host decode throughput
-    python bench.py cms          # XLA scatter vs Pallas one-hot CMS update
+    python bench.py cms          # XLA scatter vs Pallas CMS updates (x4)
     python bench.py e2e          # full in-process pipeline flows/sec
+    python bench.py sharded [n]  # n-device mesh rate + merge cost
+    python bench.py sweep        # batch x width x impl tuning sweep
 """
 
 from __future__ import annotations
@@ -228,6 +230,57 @@ def bench_e2e() -> None:
     }))
 
 
+def bench_sweep() -> None:
+    """Tuning sweep for the flagship step: batch size x CMS width x impl.
+    One JSON line per point plus a final best-config line — run this the
+    moment real hardware is attached to pick hh defaults empirically."""
+    import jax
+    import jax.numpy as jnp
+
+    from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+    from flow_pipeline_tpu.models import heavy_hitter as hh
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    batches = (16384, 32768, 65536) if on_tpu else (16384,)
+    widths = (1 << 15, 1 << 16, 1 << 17) if on_tpu else (1 << 16,)
+    impls = ("xla", "pallas") if on_tpu else ("xla",)
+    gen = FlowGenerator(ZipfProfile(n_keys=100_000, alpha=1.1), seed=0)
+    best = None
+    for batch in batches:
+        staged = []
+        for _ in range(4):
+            b = gen.batch(batch)
+            cols = b.device_columns(("src_addr", "dst_addr", "bytes",
+                                     "packets"))
+            staged.append({k: jax.device_put(jnp.asarray(v))
+                           for k, v in cols.items()})
+        valid = jax.device_put(jnp.ones(batch, bool))
+        for width in widths:
+            for impl in impls:
+                config = hh.HeavyHitterConfig(
+                    key_cols=("src_addr", "dst_addr"), batch_size=batch,
+                    width=width, capacity=1024, cms_impl=impl,
+                )
+                state = hh.hh_init(config)
+                state = hh.hh_update(state, staged[0], valid, config=config)
+                jax.block_until_ready(state)
+                steps = 24
+                t0 = time.perf_counter()
+                for i in range(steps):
+                    state = hh.hh_update(state, staged[i % 4], valid,
+                                         config=config)
+                jax.block_until_ready(state)
+                rate = batch * steps / (time.perf_counter() - t0)
+                point = {"batch": batch, "width": width, "impl": impl,
+                         "flows_per_sec": round(rate, 1)}
+                print(json.dumps({"metric": "hh sweep point", **point}))
+                if best is None or rate > best["flows_per_sec"]:
+                    best = point
+    print(json.dumps({"metric": "hh sweep best", "unit": "flows/sec",
+                      "value": best["flows_per_sec"], "platform": _PLATFORM,
+                      **best}))
+
+
 def bench_sharded(n_devices: int = 8) -> None:
     """Multi-chip flagship step over an n-device mesh: aggregate flows/sec
     across shards plus the window-close merge cost (psum + table fold over
@@ -318,6 +371,8 @@ if __name__ == "__main__":
         bench_e2e()
     elif mode == "sharded":
         bench_sharded(int(sys.argv[2]) if len(sys.argv) > 2 else 8)
+    elif mode == "sweep":
+        bench_sweep()
     else:
         print(json.dumps({"error": f"unknown mode {mode}"}))
         sys.exit(2)

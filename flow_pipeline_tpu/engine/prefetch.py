@@ -51,7 +51,14 @@ class PrefetchConsumer:
         self._cv = threading.Condition()
         self._stop = threading.Event()
         self._idle = threading.Event()  # last inner.poll returned nothing
-        self._rounds = 0  # completed inner.poll attempts (feed thread)
+        # freshness accounting for poll(): _started counts rounds begun,
+        # _completed_start is the start-number of the last finished round
+        self._started = 0
+        self._completed_start = 0
+        # first error from the feed thread; surfaced to the caller so a
+        # poison message / dead broker crashes the worker (supervisor
+        # restart semantics) instead of hanging or silently looping
+        self._error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
 
     # ---- consumer surface --------------------------------------------------
@@ -61,21 +68,25 @@ class PrefetchConsumer:
         idle. Blocks briefly while a fetch is in flight — returning None
         mid-fetch would make stop_when_idle callers quit a non-empty
         stream just because the thread hadn't finished its first poll."""
+        self.poll_max = max_messages  # picked up by the next feed round
         if self._thread is None:
-            self.poll_max = max_messages
             self._start()
         # Return None only after a poll round that STARTED after this call
         # came back empty: the sticky idle flag alone could be stale (a
-        # producer may have published while the feed thread slept), and a
+        # producer may have published while the feed thread slept — or
+        # while an in-flight round was already past its fetch), and a
         # premature None makes stop_when_idle callers abandon the tail.
-        start_rounds = self._rounds
+        started_before = self._started
         while True:
+            if self._error is not None:
+                raise self._error
             try:
                 return self._batches.get(timeout=self.idle_sleep)
             except queue.Empty:
                 if not self._thread.is_alive():
                     return None
-                if self._idle.is_set() and self._rounds > start_rounds:
+                if self._idle.is_set() and \
+                        self._completed_start > started_before:
                     return None
 
     def commit(self, partition: int, next_offset: int) -> None:
@@ -97,6 +108,8 @@ class PrefetchConsumer:
         with self._cv:
             if not self._cv.wait_for(lambda: self._pending == 0, timeout):
                 raise TimeoutError("prefetch commit queue did not drain")
+        if self._error is not None:
+            raise self._error
 
     def __getattr__(self, name):
         # committed / lag / positions etc. delegate to the wrapped
@@ -138,19 +151,24 @@ class PrefetchConsumer:
                 # device side is behind; yield instead of spinning
                 self._stop.wait(self.idle_sleep)
                 continue
+            self._started += 1
+            round_no = self._started
             try:
                 batch = self.inner.poll(self.poll_max)
-            except Exception:  # noqa: BLE001 — surface, don't kill the feed
-                log.exception("prefetch poll failed")
-                self._stop.wait(self.idle_sleep)
-                continue
+            except Exception as e:  # noqa: BLE001 — hand to the caller:
+                # retrying forever would turn a poison message or a dead
+                # broker (which crashes the unwrapped worker for the
+                # supervisor to restart) into a silent infinite loop
+                log.exception("prefetch poll failed; surfacing to caller")
+                self._error = e
+                break
             if batch is None or len(batch) == 0:
                 self._idle.set()
-                self._rounds += 1
+                self._completed_start = round_no
                 self._stop.wait(self.idle_sleep)
                 continue
             self._idle.clear()
-            self._rounds += 1
+            self._completed_start = round_no
             self._batches.put(batch)
         self._drain_commits()
 
@@ -162,8 +180,12 @@ class PrefetchConsumer:
                 return
             try:
                 self.inner.commit(partition, next_offset)
-            except Exception:  # noqa: BLE001
-                log.exception("prefetch commit failed")
+            except Exception as e:  # noqa: BLE001 — flush_commits raises it:
+                # reporting success for a commit that never reached the
+                # broker would falsify "state durable -> offsets committed"
+                log.exception("prefetch commit failed; surfacing to caller")
+                if self._error is None:
+                    self._error = e
             finally:
                 with self._cv:
                     self._pending -= 1

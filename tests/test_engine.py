@@ -343,3 +343,38 @@ class TestRestoreModelMismatch:
         )
         assert slim.restore()  # top_talkers state present but unconfigured
         assert slim.batches_seen == worker.batches_seen
+
+
+class TestMultiWorkerPartitionSplit:
+    def test_two_workers_disjoint_partitions_sum_to_oracle(self):
+        # the sarama consumer-group model (ref: inserter/inserter.go:
+        # 238-256): scale-out is more workers on disjoint partition
+        # subsets; their merged sink output must equal the exact oracle
+        import threading
+
+        bus, all_flows = fill_bus(n=4000, partitions=4)
+        # shared sink: both workers append concurrently; MemorySink.write
+        # is a single list.extend, atomic under the GIL
+        sink = MemorySink()
+        workers = []
+        for part_set in ([0, 1], [2, 3]):
+            consumer = Consumer(bus, fixedlen=True, partitions=part_set)
+            workers.append(StreamWorker(
+                consumer,
+                {"flows_5m": WindowAggregator(WindowAggConfig(batch_size=512))},
+                [sink],
+                WorkerConfig(poll_max=512),
+            ))
+        threads = [
+            threading.Thread(target=w.run, kwargs={"stop_when_idle": True})
+            for w in workers
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive()
+        assert_matches_oracle(flows5m_totals(sink), all_flows)
+        # each worker committed exactly its own partitions
+        for w, parts in zip(workers, ([0, 1], [2, 3])):
+            assert sorted(w._covered) == parts

@@ -225,3 +225,33 @@ class TestPrefetchRobustness:
         release.set()  # un-stick; thread sees _stop and exits, draining
         pref._thread.join(5)
         assert inner.commits == [(0, 5)]
+
+    def test_poll_error_surfaces_to_caller(self):
+        # a poison message / dead broker must crash the caller (supervisor
+        # restart semantics), not loop silently in the feed thread
+        class PoisonConsumer:
+            def poll(self, max_messages):
+                raise ValueError("poison frame")
+
+            def commit(self, partition, next_offset):
+                pass
+
+        pref = PrefetchConsumer(PoisonConsumer(), poll_max=512,
+                                idle_sleep=0.01)
+        with pytest.raises(ValueError, match="poison frame"):
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                pref.poll(512)
+
+    def test_commit_error_surfaces_via_flush(self):
+        # flush_commits must not report success for commits that never
+        # reached the broker
+        bus, _ = fill_bus(n=500)
+        inner = Consumer(bus, fixedlen=True)
+        broken = RuntimeError("group rebalanced")
+        inner.commit = lambda p, o: (_ for _ in ()).throw(broken)
+        pref = PrefetchConsumer(inner, poll_max=512, idle_sleep=0.01)
+        b = pref.poll(512)
+        pref.commit(b.partition, b.last_offset + 1)
+        with pytest.raises(RuntimeError, match="group rebalanced"):
+            pref.flush_commits()
