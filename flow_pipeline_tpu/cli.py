@@ -153,6 +153,11 @@ def _build_models(vals):
         models["top_talkers"] = windowed_hh(
             ("src_addr", "dst_addr", "src_port", "dst_port", "proto")
         )
+    if vals["model.ips"]:
+        # Top src/dst IP tables (ref: viz.json "Top source/destination
+        # IPs"); per-address windowed HH, one per direction.
+        models["top_src_ips"] = windowed_hh(("src_addr",))
+        models["top_dst_ips"] = windowed_hh(("dst_addr",))
     if vals["model.ports"]:
         # Top src/dst port tables (ref: viz.json top port panels). Port
         # key space is tiny (2^16), so a modest sketch is effectively
@@ -178,6 +183,7 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
                                     "(0 = single chip)")
     fs.boolean("model.flows5m", True, "Exact 5m rollup model")
     fs.boolean("model.talkers", True, "5-tuple top-K talkers model")
+    fs.boolean("model.ips", True, "Top src/dst IP models")
     fs.boolean("model.ports", True, "Top src/dst port models")
     fs.boolean("model.ddos", True, "DDoS spike detector")
     fs.integer("sketch.width", 1 << 16, "Count-min width")

@@ -84,6 +84,12 @@ class PrefetchConsumer:
                 return self._batches.get(timeout=self.idle_sleep)
             except queue.Empty:
                 if not self._thread.is_alive():
+                    # the thread may have died DURING our get() — re-check
+                    # the error before calling it end-of-stream, or the
+                    # crash-the-worker semantics silently become a clean
+                    # exit for stop_when_idle callers
+                    if self._error is not None:
+                        raise self._error
                     return None
                 if self._idle.is_set() and \
                         self._completed_start > started_before:
@@ -92,9 +98,11 @@ class PrefetchConsumer:
     def commit(self, partition: int, next_offset: int) -> None:
         """Queue the commit for the owner thread (kafka-python consumers
         are not thread-safe). flush_commits() awaits execution."""
-        if self._thread is None:
-            # nothing polled yet -> no thread owns the consumer; commit
-            # directly (restore-time / idle-shutdown path)
+        if self._thread is None or not self._thread.is_alive():
+            # no live thread owns the consumer (nothing polled yet, or the
+            # feed died after surfacing its error): commit directly — an
+            # enqueued commit would never drain and flush_commits would
+            # stall for its full timeout
             self.inner.commit(partition, next_offset)
             return
         with self._cv:
@@ -106,10 +114,16 @@ class PrefetchConsumer:
         if self._thread is None:
             return
         with self._cv:
-            if not self._cv.wait_for(lambda: self._pending == 0, timeout):
-                raise TimeoutError("prefetch commit queue did not drain")
+            done = self._cv.wait_for(
+                lambda: self._pending == 0 or self._error is not None,
+                timeout,
+            )
         if self._error is not None:
+            # the real failure, not a misleading timeout: the exiting
+            # thread's final drain still executes any queued commits
             raise self._error
+        if not done:
+            raise TimeoutError("prefetch commit queue did not drain")
 
     def __getattr__(self, name):
         # committed / lag / positions etc. delegate to the wrapped

@@ -81,6 +81,10 @@ class StreamWorker:
         self.m_lag = REGISTRY.gauge("consumer_lag", "bus messages behind")
         self.m_raw = REGISTRY.counter("raw_rows_archived",
                                       "rows archived to flows_raw")
+        self.m_late = REGISTRY.gauge(
+            "late_flows_dropped",
+            "rows dropped because their sketch window had closed",
+        )
         self.m_proc = REGISTRY.summary("flow_processing_time_us",
                                        "per-batch processing time")
         if config.archive_raw:
@@ -116,8 +120,11 @@ class StreamWorker:
             # irreducible at-least-once window as sink flushes (_process
             # below), not snapshot_every batches' worth of raw rows.
             self._emitted_since_snapshot |= archived
-        for model in self.models.values():
+        for name, model in self.models.items():
             model.update(batch)
+            dropped = getattr(model, "late_flows_dropped", None)
+            if dropped:
+                self.m_late.set(dropped, model=name)
         self.batches_seen += 1
         self.flows_seen += len(batch)
         self.m_flows.inc(len(batch))

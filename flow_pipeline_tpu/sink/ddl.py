@@ -55,6 +55,28 @@ CREATE TABLE IF NOT EXISTS top_talkers (
 );
 """
 
+POSTGRES_TOP_SRC_IPS = """
+CREATE TABLE IF NOT EXISTS top_src_ips (
+    timeslot  BIGINT,
+    rank      INT,
+    src_addr  TEXT,
+    bytes     BIGINT,
+    packets   BIGINT,
+    count     BIGINT
+);
+"""
+
+POSTGRES_TOP_DST_IPS = """
+CREATE TABLE IF NOT EXISTS top_dst_ips (
+    timeslot  BIGINT,
+    rank      INT,
+    dst_addr  TEXT,
+    bytes     BIGINT,
+    packets   BIGINT,
+    count     BIGINT
+);
+"""
+
 POSTGRES_TOP_SRC_PORTS = """
 CREATE TABLE IF NOT EXISTS top_src_ports (
     timeslot  BIGINT,
@@ -133,6 +155,30 @@ CREATE TABLE IF NOT EXISTS top_talkers (
 ORDER BY (timeslot, rank);
 """
 
+CLICKHOUSE_TOP_SRC_IPS = """
+CREATE TABLE IF NOT EXISTS top_src_ips (
+    timeslot UInt64,
+    rank UInt32,
+    src_addr String,
+    bytes UInt64,
+    packets UInt64,
+    count UInt64
+) ENGINE = MergeTree()
+ORDER BY (timeslot, rank);
+"""
+
+CLICKHOUSE_TOP_DST_IPS = """
+CREATE TABLE IF NOT EXISTS top_dst_ips (
+    timeslot UInt64,
+    rank UInt32,
+    dst_addr String,
+    bytes UInt64,
+    packets UInt64,
+    count UInt64
+) ENGINE = MergeTree()
+ORDER BY (timeslot, rank);
+"""
+
 CLICKHOUSE_TOP_SRC_PORTS = """
 CREATE TABLE IF NOT EXISTS top_src_ports (
     timeslot UInt64,
@@ -190,6 +236,10 @@ TABLE_COLUMNS = {
                  "count"],
     "top_talkers": ["timeslot", "rank", "src_addr", "dst_addr", "src_port",
                     "dst_port", "proto", "bytes", "packets", "count"],
+    "top_src_ips": ["timeslot", "rank", "src_addr", "bytes", "packets",
+                    "count"],
+    "top_dst_ips": ["timeslot", "rank", "dst_addr", "bytes", "packets",
+                    "count"],
     "top_src_ports": ["timeslot", "rank", "src_port", "bytes", "packets",
                       "count"],
     "top_dst_ports": ["timeslot", "rank", "dst_port", "bytes", "packets",
@@ -202,7 +252,8 @@ TABLE_COLUMNS = {
 }
 
 
-RANKED_TABLES = {"top_talkers", "top_src_ports", "top_dst_ports"}
+RANKED_TABLES = {"top_talkers", "top_src_ips", "top_dst_ips",
+                 "top_src_ports", "top_dst_ports"}
 
 
 def assign_ranks(table: str, records: list[dict]) -> list[dict]:
@@ -243,6 +294,18 @@ CREATE TABLE IF NOT EXISTS flows_5m (
 CREATE TABLE IF NOT EXISTS top_talkers (
     timeslot INTEGER, rank INTEGER, src_addr TEXT, dst_addr TEXT,
     src_port INTEGER, dst_port INTEGER, proto INTEGER,
+    bytes INTEGER, packets INTEGER, count INTEGER
+);
+""",
+    "top_src_ips": """
+CREATE TABLE IF NOT EXISTS top_src_ips (
+    timeslot INTEGER, rank INTEGER, src_addr TEXT,
+    bytes INTEGER, packets INTEGER, count INTEGER
+);
+""",
+    "top_dst_ips": """
+CREATE TABLE IF NOT EXISTS top_dst_ips (
+    timeslot INTEGER, rank INTEGER, dst_addr TEXT,
     bytes INTEGER, packets INTEGER, count INTEGER
 );
 """,

@@ -144,15 +144,17 @@ class TestGrafana:
                        "consumer_lag", "flow_processing_time_us"):
             assert metric in text
 
-    def test_traffic_dashboard_has_port_panels(self):
-        # reference viz.json serves four top-N tables (src/dst IPs AND
-        # src/dst ports); the port breakdown must exist here too
-        with open(os.path.join(DEPLOY, "grafana", "dashboards",
-                               "traffic.json")) as f:
-            dash = json.load(f)
-        titles = {p["title"] for p in dash["panels"]}
-        assert "Top source ports" in titles
-        assert "Top destination ports" in titles
+    def test_traffic_dashboards_have_four_topn_tables(self):
+        # reference viz.json serves four top-N tables: src/dst IPs AND
+        # src/dst ports — both dashboard variants must carry all four
+        for sub in ("dashboards", "dashboards-ch"):
+            with open(os.path.join(DEPLOY, "grafana", sub,
+                                   "traffic.json")) as f:
+                dash = json.load(f)
+            titles = {p["title"] for p in dash["panels"]}
+            for want in ("Top source IPs", "Top destination IPs",
+                         "Top source ports", "Top destination ports"):
+                assert want in titles, (sub, want)
 
     def test_datasource_provisioning(self):
         pg = load("grafana/datasources.yml")
@@ -207,10 +209,13 @@ class TestDashboardHonesty:
         from flow_pipeline_tpu.engine.worker import StreamWorker
         from flow_pipeline_tpu.obs import REGISTRY, MetricsRegistry
 
+        from flow_pipeline_tpu.engine import Supervisor
+
         reg = MetricsRegistry()
         CollectorServer(None, CollectorConfig(netflow_addr=None,
                                               sflow_addr=None), registry=reg)
         StreamWorker(consumer=None, models={})  # registers on the global
+        Supervisor(lambda: None)  # worker_restarts_total
         return set(reg._metrics) | set(REGISTRY._metrics)
 
     def test_prometheus_exprs_use_registered_metrics(self):

@@ -255,3 +255,45 @@ class TestPrefetchRobustness:
         pref.commit(b.partition, b.last_offset + 1)
         with pytest.raises(RuntimeError, match="group rebalanced"):
             pref.flush_commits()
+
+    def test_single_poll_call_observes_late_error(self):
+        # the error can land while the caller is already blocked inside
+        # poll(); the dead-thread branch must surface it, not return None
+        # (a None here turns a broker death into a clean end-of-stream)
+        class LateExplodingConsumer:
+            def poll(self, max_messages):
+                time.sleep(0.05)  # caller is inside its get() by now
+                raise OSError("broker died")
+
+            def commit(self, partition, next_offset):
+                pass
+
+        pref = PrefetchConsumer(LateExplodingConsumer(), poll_max=512,
+                                idle_sleep=0.01)
+        with pytest.raises(OSError, match="broker died"):
+            pref.poll(512)  # ONE call must observe it
+
+    def test_flush_after_feed_death_raises_real_error_fast(self):
+        # commits issued after the feed thread died must execute inline
+        # and flush_commits must raise the original error, not stall for
+        # its full timeout on a queue nobody drains
+        class PoisonConsumer:
+            def __init__(self):
+                self.commits = []
+
+            def poll(self, max_messages):
+                raise ValueError("poison frame")
+
+            def commit(self, partition, next_offset):
+                self.commits.append((partition, next_offset))
+
+        inner = PoisonConsumer()
+        pref = PrefetchConsumer(inner, poll_max=512, idle_sleep=0.01)
+        with pytest.raises(ValueError):
+            pref.poll(512)
+        pref.commit(0, 9)
+        assert inner.commits == [(0, 9)]  # executed inline, thread dead
+        t0 = time.time()
+        with pytest.raises(ValueError, match="poison frame"):
+            pref.flush_commits(timeout=30)
+        assert time.time() - t0 < 5  # the real error, promptly
