@@ -32,15 +32,16 @@ import sys
 import time
 
 _PLATFORM = None
+_DEGRADE_REASON = None  # why the probe fell back to CPU (None if it didn't)
 
 
 def _resolve_platform(probe_timeout: float = 90.0) -> str:
     """Shared probe-or-degrade logic (utils.platform), memoized per run."""
-    global _PLATFORM
+    global _PLATFORM, _DEGRADE_REASON
     if not _PLATFORM:
-        from flow_pipeline_tpu.utils.platform import resolve_platform
+        from flow_pipeline_tpu.utils.platform import resolve_platform_info
 
-        _PLATFORM = resolve_platform(probe_timeout)
+        _PLATFORM, _DEGRADE_REASON = resolve_platform_info(probe_timeout)
     return _PLATFORM
 
 
@@ -84,17 +85,18 @@ def main() -> None:
 
     flows_per_sec = BATCH * STEPS / dt
     baseline = 100_000.0  # reference production ">100k flows/s"
-    print(
-        json.dumps(
-            {
-                "metric": "heavy-hitter sketch aggregation throughput (single chip)",
-                "value": round(flows_per_sec, 1),
-                "unit": "flows/sec",
-                "vs_baseline": round(flows_per_sec / baseline, 3),
-                "platform": platform,
-            }
-        )
-    )
+    result = {
+        "metric": "heavy-hitter sketch aggregation throughput (single chip)",
+        "value": round(flows_per_sec, 1),
+        "unit": "flows/sec",
+        "vs_baseline": round(flows_per_sec / baseline, 3),
+        "platform": platform,
+    }
+    if _DEGRADE_REASON:
+        # the probe DEGRADED to CPU: record why, so the artifact says
+        # "chip was unreachable", not just "platform: cpu"
+        result["tpu_unavailable"] = _DEGRADE_REASON
+    print(json.dumps(result))
 
 
 def bench_decode() -> None:

@@ -212,9 +212,23 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
 
 def _apply_backend(backend: str) -> None:
     if backend == "cpu":
-        import jax
+        from .utils.platform import force_cpu
 
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu()
+    elif backend == "tpu":
+        # Probe-or-degrade (same policy as bench.py): a wedged chip grant
+        # blocks forever inside backend init, which would hang the whole
+        # processor before its first batch. Probing in a subprocess turns
+        # that into a logged CPU fallback. Trade-offs, accepted: a healthy
+        # start pays one extra backend init (the probe child claims and
+        # releases before the parent claims), and a chip that is merely
+        # busy during startup pins this process to CPU until restart — a
+        # hung processor would be strictly worse.
+        from .utils.platform import resolve_platform_info
+
+        platform, reason = resolve_platform_info()
+        if reason:
+            log.warning("TPU unavailable (%s); degraded to CPU", reason)
 
 
 def _pg_dsn(dsn: str) -> str:

@@ -40,16 +40,27 @@ def honor_platform_env() -> None:
 
 
 def resolve_platform(probe_timeout: float = 90.0) -> str:
+    """resolve_platform_info without the degrade reason."""
+    return resolve_platform_info(probe_timeout)[0]
+
+
+def resolve_platform_info(probe_timeout: float = 90.0):
     """Decide the platform for a benchmark/driver run.
 
-    CPU-only request -> 'cpu' (enforced). Otherwise probe backend init in a
-    subprocess: the child reports the platform it actually got (so a
-    CPU-only machine is never mislabeled), and a timeout/failure — the
-    wedged-chip case — degrades to CPU instead of deadlocking.
+    CPU-only request -> ('cpu', None) (enforced). Otherwise probe backend
+    init in a subprocess: the child reports the platform it actually got
+    (so a CPU-only machine is never mislabeled), and a timeout/failure —
+    the wedged-chip case — degrades to CPU instead of deadlocking.
+
+    Returns (platform, degrade_reason): reason is None unless the probe
+    DEGRADED to CPU, in which case it carries the probe's actual failure
+    (child stderr for init errors, relay diagnosis for grant timeouts) so
+    benchmark artifacts can say why, not just "platform: cpu".
     """
     if cpu_requested():
         force_cpu()
-        return "cpu"
+        return "cpu", None
+    reason = None
     try:
         out = subprocess.run(
             [sys.executable, "-c",
@@ -58,8 +69,43 @@ def resolve_platform(probe_timeout: float = 90.0) -> str:
         )
         lines = out.stdout.strip().splitlines()
         platform = lines[-1] if lines else "unknown"
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+    except subprocess.TimeoutExpired:
         platform = "cpu"
+        reason = (f"backend init timed out after {probe_timeout:.0f}s; "
+                  + _relay_diagnosis())
+    except subprocess.CalledProcessError as e:
+        platform = "cpu"
+        tail = (e.stderr or "").strip().splitlines()
+        reason = "backend init failed: " + (tail[-1] if tail else "unknown")
     if platform == "cpu":
         force_cpu()
-    return platform
+    return platform, reason
+
+
+def _relay_diagnosis() -> str:
+    """Poke the axon relay the TPU tunnel rides (AXON_POOL_SVC_OVERRIDE in
+    this environment's sitecustomize). Only called AFTER a grant timeout —
+    the claim channel is already suspect, and a healthy relay holds an
+    accepted connection open while a dead one accepts and instantly
+    closes."""
+    import socket
+
+    host = os.environ.get("AXON_POOL_SVC_OVERRIDE", "127.0.0.1")
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return "no TPU tunnel configured in this environment"
+    try:
+        s = socket.create_connection((host, 2024), timeout=3)
+    except OSError as e:
+        return f"TPU relay {host}:2024 unreachable ({e})"
+    try:
+        s.settimeout(2)
+        try:
+            data = s.recv(16)
+        except socket.timeout:
+            return "relay reachable; chip grant timed out (held elsewhere?)"
+        if data == b"":
+            return ("TPU relay accepts and immediately closes connections "
+                    "(upstream pool link down); chip grant never arrives")
+        return "relay responded; grant timed out during backend init"
+    finally:
+        s.close()
