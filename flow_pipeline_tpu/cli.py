@@ -229,6 +229,15 @@ def _apply_backend(backend: str) -> None:
         platform, reason = resolve_platform_info()
         if reason:
             log.warning("TPU unavailable (%s); degraded to CPU", reason)
+        elif platform == "cpu":
+            # healthy probe, CPU answer: env requested cpu or no
+            # accelerator exists — pinned to CPU by resolve
+            log.info("no accelerator; running on CPU")
+        elif platform not in ("tpu", "axon"):
+            # probe produced something unexpected (e.g. empty output ->
+            # "unknown"): proceed, but leave a trace for the operator
+            log.warning("accelerator probe reported %r; proceeding with "
+                        "default backend init", platform)
 
 
 def _pg_dsn(dsn: str) -> str:

@@ -90,9 +90,13 @@ def _relay_diagnosis() -> str:
     closes."""
     import socket
 
-    host = os.environ.get("AXON_POOL_SVC_OVERRIDE", "127.0.0.1")
-    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+    pool_ips = os.environ.get("PALLAS_AXON_POOL_IPS", "")
+    if not pool_ips:
         return "no TPU tunnel configured in this environment"
+    # the override is where the relay actually listens; without it, probe
+    # the pool address itself rather than assuming loopback
+    host = (os.environ.get("AXON_POOL_SVC_OVERRIDE")
+            or pool_ips.split(",")[0].strip())
     try:
         s = socket.create_connection((host, 2024), timeout=3)
     except OSError as e:
@@ -103,6 +107,8 @@ def _relay_diagnosis() -> str:
             data = s.recv(16)
         except socket.timeout:
             return "relay reachable; chip grant timed out (held elsewhere?)"
+        except OSError as e:  # e.g. RST mid-probe — still just a diagnosis
+            return f"relay connection dropped during probe ({e})"
         if data == b"":
             return ("TPU relay accepts and immediately closes connections "
                     "(upstream pool link down); chip grant never arrives")

@@ -1,0 +1,158 @@
+"""Platform selection + degrade diagnosis (utils.platform)."""
+
+import os
+import socket
+import struct
+import threading
+from unittest import mock
+
+from flow_pipeline_tpu.utils import platform as plat
+
+
+class TestCpuRequested:
+    def test_only_cpu_counts(self):
+        with mock.patch.dict(os.environ, {"JAX_PLATFORMS": "cpu"}):
+            assert plat.cpu_requested()
+        with mock.patch.dict(os.environ, {"JAX_PLATFORMS": "tpu,cpu"}):
+            assert not plat.cpu_requested()  # priority list != cpu request
+        with mock.patch.dict(os.environ, {"JAX_PLATFORMS": "axon"}):
+            assert not plat.cpu_requested()
+
+
+class TestResolvePlatformInfo:
+    def test_cpu_request_short_circuits(self):
+        with mock.patch.dict(os.environ, {"JAX_PLATFORMS": "cpu"}):
+            platform, reason = plat.resolve_platform_info()
+        assert platform == "cpu" and reason is None
+
+    def test_probe_failure_carries_child_stderr(self):
+        import subprocess
+
+        err = subprocess.CalledProcessError(
+            1, ["python"], output="", stderr="Trace...\nRuntimeError: boom\n"
+        )
+        with mock.patch.dict(os.environ, {"JAX_PLATFORMS": "axon"}), \
+                mock.patch.object(plat.subprocess, "run", side_effect=err):
+            platform, reason = plat.resolve_platform_info()
+        assert platform == "cpu"
+        assert reason == "backend init failed: RuntimeError: boom"
+
+    def test_probe_timeout_carries_relay_diagnosis(self):
+        import subprocess
+
+        to = subprocess.TimeoutExpired(["python"], 1.0)
+        with mock.patch.dict(os.environ, {"JAX_PLATFORMS": "axon"}), \
+                mock.patch.object(plat.subprocess, "run", side_effect=to), \
+                mock.patch.object(plat, "_relay_diagnosis",
+                                  return_value="relay dead"):
+            platform, reason = plat.resolve_platform_info(probe_timeout=1.0)
+        assert platform == "cpu"
+        assert reason == "backend init timed out after 1s; relay dead"
+
+
+class FakeRelay:
+    """Minimal TCP server standing in for the axon relay."""
+
+    def __init__(self, behavior):
+        self.behavior = behavior  # "close" | "hold" | "banner"
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(1)
+        self.port = self.sock.getsockname()[1]
+        self._conns = []
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        try:
+            conn, _ = self.sock.accept()
+        except OSError:
+            return
+        if self.behavior == "close":
+            conn.close()
+        elif self.behavior == "banner":
+            conn.sendall(b"hello")
+            self._conns.append(conn)
+        else:  # hold
+            self._conns.append(conn)
+
+    def close(self):
+        for c in self._conns:
+            c.close()
+        self.sock.close()
+
+
+class TestRelayDiagnosis:
+    def diag(self, relay):
+        env = {"PALLAS_AXON_POOL_IPS": "127.0.0.1",
+               "AXON_POOL_SVC_OVERRIDE": "127.0.0.1"}
+        real_connect = socket.create_connection
+
+        def to_fake(addr, timeout):
+            return real_connect(("127.0.0.1", relay.port), timeout)
+
+        with mock.patch.dict(os.environ, env), \
+                mock.patch.object(socket, "create_connection", to_fake):
+            return plat._relay_diagnosis()
+
+    def test_no_tunnel_configured(self):
+        with mock.patch.dict(os.environ, {}, clear=False):
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+            assert "no TPU tunnel" in plat._relay_diagnosis()
+
+    def test_accept_then_close_means_upstream_down(self):
+        relay = FakeRelay("close")
+        try:
+            assert "immediately closes" in self.diag(relay)
+        finally:
+            relay.close()
+
+    def test_held_connection_means_grant_contention(self):
+        relay = FakeRelay("hold")
+        try:
+            assert "held elsewhere" in self.diag(relay)
+        finally:
+            relay.close()
+
+    def test_banner_means_init_stage_timeout(self):
+        relay = FakeRelay("banner")
+        try:
+            assert "relay responded" in self.diag(relay)
+        finally:
+            relay.close()
+
+    def test_reset_during_probe_is_a_diagnosis_not_a_crash(self):
+        # an RST mid-probe must come back as a reason string — raising
+        # would crash the exact degrade path this code exists to survive
+        class RstRelay(FakeRelay):
+            def _serve(self):
+                try:
+                    conn, _ = self.sock.accept()
+                except OSError:
+                    return
+                # force an RST instead of FIN
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                struct.pack("ii", 1, 0))
+                conn.close()
+
+        relay = RstRelay("rst")
+        try:
+            out = self.diag(relay)
+            assert isinstance(out, str) and out
+        finally:
+            relay.close()
+
+    def test_host_falls_back_to_pool_ip(self):
+        env = {"PALLAS_AXON_POOL_IPS": "203.0.113.9,203.0.113.10"}
+        seen = {}
+
+        def spy(addr, timeout):
+            seen["addr"] = addr
+            raise OSError("refused")
+
+        with mock.patch.dict(os.environ, env), \
+                mock.patch.object(socket, "create_connection", spy):
+            os.environ.pop("AXON_POOL_SVC_OVERRIDE", None)
+            out = plat._relay_diagnosis()
+        assert seen["addr"] == ("203.0.113.9", 2024)
+        assert "unreachable" in out
