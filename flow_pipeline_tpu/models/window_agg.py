@@ -22,6 +22,7 @@ set ``allowed_lateness`` high enough to make reopening impossible.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
@@ -44,16 +45,24 @@ class WindowAggConfig:
 
 
 def _build_update(config: WindowAggConfig):
-    """One jitted device step: columns -> (keys, sums, counts, n_groups)."""
+    """One jitted device step: columns -> (keys, sums, counts, n_groups).
+    Cached on exactly the fields the program depends on — batch_size only
+    shapes the inputs (jit re-specializes per shape anyway) and
+    allowed_lateness is host-side, so neither may fragment the cache."""
+    return _cached_update(config.window_seconds, config.key_cols,
+                          config.value_cols)
 
-    window = jnp.uint32(config.window_seconds)
+
+@functools.lru_cache(maxsize=None)
+def _cached_update(window_seconds: int, key_cols: tuple, value_cols: tuple):
+    window = jnp.uint32(window_seconds)
 
     @jax.jit
     def update(cols: dict, valid):
         ts = cols["time_received"].astype(jnp.uint32)
         timeslot = ts - ts % window
         lanes = [timeslot]
-        for name in config.key_cols:
+        for name in key_cols:
             arr = cols[name].astype(jnp.uint32)
             if arr.ndim == 1:
                 lanes.append(arr)
@@ -65,7 +74,7 @@ def _build_update(config: WindowAggConfig):
         # guarantees plane sums < 2^31); the host recombines lo + (hi << 16)
         # in uint64.
         planes = []
-        for name in config.value_cols:
+        for name in value_cols:
             v = cols[name].astype(jnp.uint32)
             planes.append((v & jnp.uint32(0xFFFF)).astype(jnp.int32))
             planes.append((v >> jnp.uint32(16)).astype(jnp.int32))
