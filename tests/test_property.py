@@ -120,3 +120,70 @@ class TestWindowAggProperty:
             assert got[key] == (int(oracle["bytes"][i]),
                                 int(oracle["packets"][i]),
                                 int(oracle["count"][i]))
+
+
+class TestCollectorDecodeProperty:
+    """The UDP decoders must never raise anything but ValueError/struct
+    hygiene regardless of datagram content — one spoofed packet must not
+    kill a listener (collector/udp.py catches exactly those)."""
+
+    @given(st.binary(max_size=512))
+    @settings(max_examples=300, deadline=None)
+    def test_netflow_decoder_contained(self, blob):
+        import struct as struct_mod
+
+        from flow_pipeline_tpu.collector import TemplateCache, decode_netflow
+
+        try:
+            decode_netflow(blob, TemplateCache())
+        except (ValueError, struct_mod.error):
+            pass
+
+    @given(st.binary(max_size=512))
+    @settings(max_examples=300, deadline=None)
+    def test_sflow_decoder_contained(self, blob):
+        import struct as struct_mod
+
+        from flow_pipeline_tpu.collector import decode_sflow
+
+        try:
+            decode_sflow(blob)
+        except (ValueError, struct_mod.error):
+            pass
+
+    @given(
+        st.lists(st.binary(max_size=80), min_size=0, max_size=4),
+        st.booleans(),
+        st.integers(0, 3),
+    )
+    @settings(max_examples=200, deadline=None)
+    def test_ipfix_varlen_payloads_decode_or_raise(self, payloads, long_form,
+                                                   extra_fixed):
+        """Structured fuzz of the RFC 7011 varlen path: ANY payload sizes
+        (incl. 3-byte-form lengths and starved fixed tails from mutation)
+        either decode to records with the right fixed values or raise
+        ValueError — never a crash, never a silent mis-parse."""
+        import struct as struct_mod
+
+        from flow_pipeline_tpu.collector import TemplateCache, decode_netflow
+
+        fields = [(1, 4), (371, 0xFFFF)] + [(2, 4)] * extra_fixed
+        tmpl_body = struct_mod.pack(">HH", 310, len(fields))
+        for t, ln in fields:
+            tmpl_body += struct_mod.pack(">HH", t, ln)
+        tmpl_set = struct_mod.pack(">HH", 2, 4 + len(tmpl_body)) + tmpl_body
+        recs = b""
+        for i, payload in enumerate(payloads):
+            prefix = (bytes([255]) + struct_mod.pack(">H", len(payload))
+                      if long_form else bytes([min(len(payload), 254)]))
+            payload = payload[:254] if not long_form else payload
+            recs += struct_mod.pack(">I", 100 + i) + prefix + payload
+            recs += struct_mod.pack(">I", 10 + i) * extra_fixed
+        data_set = struct_mod.pack(">HH", 310, 4 + len(recs)) + recs
+        total = 16 + len(tmpl_set) + len(data_set)
+        header = struct_mod.pack(">HHIII", 10, total, 1_700_000_000, 1, 5)
+        msgs = decode_netflow(header + tmpl_set + data_set, TemplateCache())
+        assert [m.bytes for m in msgs] == [100 + i
+                                           for i in range(len(payloads))]
+        assert all(m.packets == (10 + i if extra_fixed else 0)
+                   for i, m in enumerate(msgs))
