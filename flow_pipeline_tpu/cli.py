@@ -159,11 +159,26 @@ def _build_models(vals):
         models["top_src_ips"] = windowed_hh(("src_addr",))
         models["top_dst_ips"] = windowed_hh(("dst_addr",))
     if vals["model.ports"]:
-        # Top src/dst port tables (ref: viz.json top port panels). Port
-        # key space is tiny (2^16), so a modest sketch is effectively
-        # exact; one windowed HH per direction, same window cadence.
-        models["top_src_ports"] = windowed_hh(("src_port",))
-        models["top_dst_ports"] = windowed_hh(("dst_port",))
+        # Top src/dst port tables (ref: viz.json top port panels). The
+        # 2^16 port space fits a dense EXACT accumulator — one segment
+        # add per batch, no sketch error, top-K is one lax.top_k
+        # (models.dense_top) — under the same window lifecycle.
+        from .models import DenseTopConfig, DenseTopKModel
+
+        for col, name in (("src_port", "top_src_ports"),
+                          ("dst_port", "top_dst_ports")):
+            cfg = DenseTopConfig(key_col=col, batch_size=batch)
+            if mesh:
+                from .parallel import ShardedDenseTopK
+
+                models[name] = WindowedHeavyHitter(
+                    cfg, k=vals["sketch.topk"],
+                    model_cls=ShardedDenseTopK, mesh=mesh,
+                )
+            else:
+                models[name] = WindowedHeavyHitter(
+                    cfg, k=vals["sketch.topk"], model_cls=DenseTopKModel,
+                )
     if vals["model.ddos"]:
         if mesh:
             from .parallel import ShardedDDoSDetector

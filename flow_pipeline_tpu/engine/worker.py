@@ -237,11 +237,22 @@ class StreamWorker:
                     "watermark": model.watermark,
                 }
             elif isinstance(model, WindowedHeavyHitter):
-                models_state[name] = {
-                    "kind": "windowed_hh",
-                    "hh": model.model.state,
-                    "current_slot": model.current_slot,
-                }
+                # backing models declare their checkpoint tag explicitly
+                # (duck-typing on attribute names mis-dispatches the day
+                # a model grows an attribute another kind uses)
+                kind = model.model.snapshot_kind
+                if kind == "windowed_hh":
+                    models_state[name] = {
+                        "kind": kind,
+                        "hh": model.model.state,
+                        "current_slot": model.current_slot,
+                    }
+                else:  # "windowed_dense" (models.dense_top)
+                    models_state[name] = {
+                        "kind": kind,
+                        "totals": model.model.totals,
+                        "current_slot": model.current_slot,
+                    }
             elif isinstance(model, DDoSDetector):
                 models_state[name] = {
                     "kind": "ddos",
@@ -284,13 +295,29 @@ class StreamWorker:
                     for slot, store in ms["windows"].items()
                 }
                 model.watermark = ms["watermark"]
-            elif ms["kind"] == "windowed_hh":
-                hh = ms["hh"]  # NamedTuple decoded as field dict
-                model.model.state = HHState(
-                    cms=jnp.asarray(hh["cms"]),
-                    table_keys=jnp.asarray(hh["table_keys"]),
-                    table_vals=jnp.asarray(hh["table_vals"]),
-                )
+            elif ms["kind"] in ("windowed_hh", "windowed_dense"):
+                want = getattr(model.model, "snapshot_kind", None)
+                if want != ms["kind"]:
+                    # e.g. a checkpoint from a build whose port models were
+                    # sketch-backed restored into a dense-backed one:
+                    # restoring the wrong state shape would silently lose
+                    # the open window (and corrupt future snapshots); skip
+                    # loudly instead — that window's sketch starts over
+                    log.warning(
+                        "checkpoint kind %r does not match model %r "
+                        "backing (%r); skipping its state",
+                        ms["kind"], name, want,
+                    )
+                    continue
+                if ms["kind"] == "windowed_hh":
+                    hh = ms["hh"]  # NamedTuple decoded as field dict
+                    model.model.state = HHState(
+                        cms=jnp.asarray(hh["cms"]),
+                        table_keys=jnp.asarray(hh["table_keys"]),
+                        table_vals=jnp.asarray(hh["table_vals"]),
+                    )
+                else:
+                    model.model.totals = jnp.asarray(ms["totals"])
                 model.current_slot = ms["current_slot"]
             elif ms["kind"] == "ddos":
                 st = ms["state"]

@@ -9,12 +9,14 @@ reference delegates to ClickHouse materialized views
 - ``window_agg``    exact device aggregation: sort+segment-sum per batch,
                     host merge per 5-min window (flows_5m semantics)
 - ``heavy_hitter``  count-min sketch + device top-K candidate table
+- ``dense_top``     exact dense top-K for small key domains (ports)
 - ``ddos``          per-DstAddr EWMA + quantile spike detection
 """
 
 from .oracle import exact_groupby, flows_5m, topk_exact
 from .window_agg import WindowAggregator, WindowAggConfig
 from .heavy_hitter import HeavyHitterModel, HeavyHitterConfig, hh_init, hh_update
+from .dense_top import DenseTopKModel, DenseTopConfig
 from .ddos import DDoSDetector, DDoSConfig
 
 __all__ = [
@@ -27,6 +29,8 @@ __all__ = [
     "HeavyHitterConfig",
     "hh_init",
     "hh_update",
+    "DenseTopKModel",
+    "DenseTopConfig",
     "DDoSDetector",
     "DDoSConfig",
 ]

@@ -1,0 +1,142 @@
+"""Dense exact top-K for SMALL key domains (ports, protocols, AS-lets).
+
+The sketch pipeline (CMS + candidate table) exists because the 5-tuple
+key space is unbounded; a 16-bit port space is not. For domains that fit
+in device memory, an exact dense accumulator is strictly better than any
+sketch: one scatter-add per batch (vs depth scatters + a table-merge
+sort), zero error, and top-K is one `lax.top_k` over the totals. This is
+the TPU-first replacement for the reference's "top ports" raw-scan
+panels (ref: compose/grafana/dashboards/viz.json port tables) at
+O(domain) memory and O(batch) update cost.
+
+Exactness design (same int32 discipline as models.window_agg, which
+cannot use floats either): float32 scatter-adds lose integer increments
+past 2^24 — a single busy port can blow through that inside one window —
+so each value rides as two 16-bit planes in int32 with an explicit carry
+propagation per batch:
+
+    batch partial: scatter-add of (v & 0xFFFF, v >> 16) — bounded by
+        batch_size * 2^16 < 2^31, so int32-exact per batch;
+    fold: lo := (lo + p_lo) & 0xFFFF, hi := hi + p_hi + carry — hi
+        counts 2^16 units, so totals stay exact to 2^47 per cell
+        (~140 TB per port per window).
+
+Ranking uses float32(hi)*65536 + lo (relative error ~6e-8, only capable
+of swapping keys whose totals differ by less than that); the REPORTED
+values are recombined exactly from the planes in uint64 on the host.
+
+The model implements the surface WindowedHeavyHitter drives
+(update/top/reset), so the tumbling-window lifecycle, worker flushes and
+ranked sink tables are shared with the sketch models unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..schema.batch import FlowBatch
+
+
+@dataclass(frozen=True)
+class DenseTopConfig:
+    key_col: str = "src_port"
+    domain: int = 1 << 16  # distinct key values; keys are ints in [0, domain)
+    value_cols: tuple[str, ...] = ("bytes", "packets")  # plane 0 ranks
+    batch_size: int = 8192
+
+    def __post_init__(self):
+        # 32767 * 0xFFFF + 0xFFFF (normalized lo) = 0xFFFF * 2^15 < 2^31:
+        # the per-batch partial plus the carried-in lo plane stays
+        # int32-exact even if every row hits one cell with a max value
+        if self.batch_size > 32767:
+            raise ValueError(
+                "batch_size must be <= 32767 (int32 exactness of the "
+                "16-bit per-batch partials + carry)"
+            )
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("totals",))
+def dense_update(totals, cols, valid, *, config: DenseTopConfig):
+    """totals: [domain, P+1, 2] int32 — (lo, hi) 16-bit planes per value
+    column plus the count plane, lo normalized to [0, 2^16)."""
+    key = cols[config.key_col].astype(jnp.int32)
+    # invalid rows -> index `domain`, out of range HIGH, dropped by the
+    # "drop" mode (a negative index would wrap before the check)
+    key = jnp.where(valid, key, config.domain)
+    lanes = [cols[name].astype(jnp.uint32) for name in config.value_cols]
+    lanes.append(jnp.ones(key.shape[0], jnp.uint32))  # count
+    lo = jnp.stack([(v & jnp.uint32(0xFFFF)).astype(jnp.int32)
+                    for v in lanes], axis=1)
+    hi = jnp.stack([(v >> jnp.uint32(16)).astype(jnp.int32)
+                    for v in lanes], axis=1)
+    planes = jnp.stack([lo, hi], axis=2)  # [N, P+1, 2]
+    planes = jnp.where(valid[:, None, None], planes, 0)
+    partial_ = jnp.zeros_like(totals).at[key].add(planes, mode="drop")
+    # fold with carry: int32-exact because each side is < 2^31
+    lo_sum = totals[:, :, 0] + partial_[:, :, 0]
+    new_lo = lo_sum & jnp.int32(0xFFFF)
+    carry = lo_sum >> jnp.int32(16)
+    new_hi = totals[:, :, 1] + partial_[:, :, 1] + carry
+    return jnp.stack([new_lo, new_hi], axis=2)
+
+
+@partial(jax.jit, static_argnames=("config", "k"))
+def dense_top(totals, *, config: DenseTopConfig, k: int):
+    """Rank by plane 0; returns (keys [k], planes [k, P+1, 2], valid [k])."""
+    rank = (totals[:, 0, 1].astype(jnp.float32) * 65536.0
+            + totals[:, 0, 0].astype(jnp.float32))
+    vals, idx = jax.lax.top_k(rank, k)
+    return idx, totals[idx], vals > 0
+
+
+def _planes_to_uint64(planes: np.ndarray) -> np.ndarray:
+    """[..., 2] int32 (lo, hi) -> exact uint64 totals."""
+    p = planes.astype(np.uint64)
+    return p[..., 0] + (p[..., 1] << np.uint64(16))
+
+
+class DenseTopKModel:
+    """Host wrapper with the HeavyHitterModel surface (update/top/reset),
+    so WindowedHeavyHitter can drive it interchangeably."""
+
+    snapshot_kind = "windowed_dense"  # worker checkpoint dispatch tag
+
+    def __init__(self, config: DenseTopConfig = DenseTopConfig()):
+        self.config = config
+        planes = len(config.value_cols) + 1
+        self.totals = jnp.zeros((config.domain, planes, 2), jnp.int32)
+
+    def update(self, batch: FlowBatch) -> None:
+        bs = self.config.batch_size
+        for start in range(0, len(batch), bs):
+            padded, mask = batch.slice(start, start + bs).pad_to(bs)
+            cols = padded.device_columns(
+                [self.config.key_col, *self.config.value_cols]
+            )
+            cols = {k: jnp.asarray(v) for k, v in cols.items()}
+            self.totals = dense_update(
+                self.totals, cols, jnp.asarray(mask), config=self.config
+            )
+
+    def _merged_totals(self):
+        return self.totals  # sharded subclass reduces over the device axis
+
+    def top(self, k: int | None = None) -> dict[str, np.ndarray]:
+        k = min(k or 100, self.config.domain)
+        idx, planes, valid = dense_top(self._merged_totals(),
+                                       config=self.config, k=k)
+        rows = _planes_to_uint64(np.asarray(planes))  # exact values
+        out: dict[str, np.ndarray] = {self.config.key_col: np.asarray(idx)}
+        for j, name in enumerate(self.config.value_cols):
+            out[name] = rows[:, j]
+        out["count"] = rows[:, -1]
+        out["valid"] = np.asarray(valid)
+        return out
+
+    def reset(self) -> None:
+        self.totals = jnp.zeros_like(self.totals)

@@ -146,6 +146,8 @@ def hh_estimates(state: HHState, *, config: HeavyHitterConfig):
 class HeavyHitterModel:
     """Host wrapper: feed batches, extract top-K at window close."""
 
+    snapshot_kind = "windowed_hh"  # worker checkpoint dispatch tag
+
     def __init__(self, config: HeavyHitterConfig = HeavyHitterConfig()):
         self.config = config
         self.state = hh_init(config)

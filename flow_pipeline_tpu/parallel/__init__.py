@@ -20,6 +20,7 @@ Multi-host runs extend the same mesh over DCN: jax.distributed.initialize()
 from .mesh import make_mesh, shard_batch_columns
 from .sharded import (
     ShardedDDoSDetector,
+    ShardedDenseTopK,
     ShardedHeavyHitter,
     ShardedWindowAggregator,
     sharded_hh_update,
@@ -31,6 +32,7 @@ __all__ = [
     "make_mesh",
     "shard_batch_columns",
     "ShardedDDoSDetector",
+    "ShardedDenseTopK",
     "ShardedHeavyHitter",
     "ShardedWindowAggregator",
     "sharded_hh_update",

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..models import ddos as ddos_mod
+from ..models import dense_top as dense_mod
 from ..models import heavy_hitter as hh
 from ..models.window_agg import WindowAggConfig, WindowAggregator
 from ..ops import topk as topk_ops
@@ -101,6 +102,8 @@ class ShardedHeavyHitter:
     Same surface as models.HeavyHitterModel, but update() consumes a global
     batch sharded over the mesh and top() runs the ICI merge first.
     """
+
+    snapshot_kind = "windowed_hh"  # worker checkpoint dispatch tag
 
     def __init__(self, config: hh.HeavyHitterConfig, mesh: Mesh | None = None):
         self.config = config
@@ -320,3 +323,68 @@ class ShardedDDoSDetector(ddos_mod.DDoSDetector):
             self.state.hist[0],
             self.state.addrs[0],
         )
+
+
+# ---------------------------------------------------------------------------
+# Dense exact top-K (small key domains), sharded
+# ---------------------------------------------------------------------------
+
+
+class ShardedDenseTopK(dense_mod.DenseTopKModel):
+    """Multi-chip dense accumulator — per-chip (lo, hi) plane totals are a
+    sum monoid (carry re-normalization happens inside dense_top's exact
+    uint64 recombination), so the hot path needs no collectives and the
+    window close is one cross-chip reduce. top()/reset()/checkpointing
+    are inherited; only placement and the merge differ."""
+
+    def __init__(self, config: dense_mod.DenseTopConfig,
+                 mesh: Mesh | None = None):
+        super().__init__(config)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_dev = self.mesh.devices.size
+        cfg = config
+
+        def per_chip(totals, cols, valid):
+            new = dense_mod.dense_update.__wrapped__(
+                totals[0], cols, valid, config=cfg
+            )
+            return new[None]
+
+        self._update = jax.jit(
+            shard_map(
+                per_chip, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=P(DATA_AXIS), check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        self.totals = jax.device_put(
+            jnp.zeros((self.n_dev,) + self.totals.shape, jnp.int32),
+            sharding,
+        )
+
+    @property
+    def global_batch(self) -> int:
+        return self.config.batch_size * self.n_dev
+
+    def update(self, batch: FlowBatch) -> None:
+        gb = self.global_batch
+        for start in range(0, len(batch), gb):
+            padded, mask = batch.slice(start, start + gb).pad_to(gb)
+            cols = padded.device_columns(
+                [self.config.key_col, *self.config.value_cols]
+            )
+            cols, valid = shard_batch_columns(self.mesh, cols, mask)
+            self.totals = self._update(self.totals, cols, valid)
+
+    def _merged_totals(self):
+        # per-chip planes sum exactly in int32: each chip's lo is
+        # normalized < 2^16, so n_dev * 2^16 is far from overflow, and
+        # the hi planes stay within the same 2^47 budget documented in
+        # models.dense_top (now shared across chips)
+        return jnp.sum(self.totals, axis=0)
+
+    def reset(self) -> None:
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        self.totals = jax.device_put(jnp.zeros_like(self.totals), sharding)

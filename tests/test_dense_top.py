@@ -1,0 +1,167 @@
+"""Dense exact top-K (models.dense_top): exact vs the oracle, windowed
+lifecycle compatibility, sharded equivalence, and checkpoint round-trip."""
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.engine import WindowedHeavyHitter
+from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+from flow_pipeline_tpu.models import DenseTopConfig, DenseTopKModel
+from flow_pipeline_tpu.models.oracle import topk_exact
+from flow_pipeline_tpu.schema.batch import FlowBatch
+
+
+def traffic(n=6000, seed=21):
+    return FlowGenerator(ZipfProfile(n_keys=200, alpha=1.3), seed=seed,
+                         t0=1_699_999_800, rate=50.0).batch(n)
+
+
+class TestDenseTopK:
+    def test_exact_vs_oracle(self):
+        batch = traffic()
+        m = DenseTopKModel(DenseTopConfig(key_col="src_port",
+                                          batch_size=1024))
+        m.update(batch)
+        # fetch a buffer past k so rank-boundary TIES (equal byte totals
+        # broken differently) cannot hide an exact-match failure
+        top = m.top(40)
+        got = {int(p): (int(b), int(c))
+               for p, b, c in zip(top["src_port"], top["bytes"],
+                                  top["count"])}
+        oracle = topk_exact(batch, ["src_port"], 10)
+        assert len(oracle["src_port"]) == 10  # enough distinct ports
+        for i in range(10):
+            port = int(np.atleast_1d(oracle["src_port"][i])[0])
+            # EXACT: identical values, not a <=1% gate
+            assert got[port] == (int(oracle["bytes"][i]),
+                                 int(oracle["count"][i]))
+
+    def test_accumulates_and_resets(self):
+        batch = traffic(2000)
+        m = DenseTopKModel(DenseTopConfig(batch_size=512))
+        m.update(batch)
+        m.update(batch)
+        top = m.top(1)
+        oracle = topk_exact(batch, ["src_port"], 1)
+        assert int(top["bytes"][0]) == 2 * int(oracle["bytes"][0])
+        m.reset()
+        assert not m.top(5)["valid"].any()
+
+    def test_windowed_lifecycle(self):
+        # DenseTopKModel drives under WindowedHeavyHitter unchanged
+        g = FlowGenerator(ZipfProfile(n_keys=50, alpha=1.4), seed=5,
+                          t0=1_699_999_800, rate=20.0)
+        wm = WindowedHeavyHitter(
+            DenseTopConfig(key_col="dst_port", batch_size=512),
+            k=10, model_cls=DenseTopKModel,
+        )
+        for _ in range(3):
+            wm.update(g.batch(2000))  # 300s -> crosses a window boundary
+        rows = wm.flush(force=True)
+        assert rows and all("dst_port" in r and "timeslot" in r
+                            for r in [
+                                {k: v[i] for k, v in row.items()}
+                                for row in rows for i in range(1)
+                            ])
+
+    def test_sharded_matches_single_chip(self):
+        from flow_pipeline_tpu.parallel import ShardedDenseTopK, make_mesh
+
+        batch = traffic(4096)
+        cfg = DenseTopConfig(key_col="src_port", batch_size=512)
+        single = DenseTopKModel(cfg)
+        single.update(batch)
+        sharded = ShardedDenseTopK(cfg, make_mesh(4))
+        sharded.update(batch)
+        t1, t2 = single.top(15), sharded.top(15)
+        for k in t1:
+            np.testing.assert_array_equal(t1[k], t2[k])
+
+    def test_checkpoint_roundtrip_via_worker(self, tmp_path):
+        from flow_pipeline_tpu.engine import StreamWorker, WorkerConfig
+        from flow_pipeline_tpu.sink import MemorySink
+        from flow_pipeline_tpu.transport import Consumer, InProcessBus, Producer
+
+        bus = InProcessBus()
+        bus.create_topic("flows", 1)
+        Producer(bus, fixedlen=True).send_many(traffic(1500).to_messages())
+
+        def make(path):
+            return StreamWorker(
+                Consumer(bus, fixedlen=True),
+                {"top_src_ports": WindowedHeavyHitter(
+                    DenseTopConfig(batch_size=512), k=5,
+                    model_cls=DenseTopKModel)},
+                [MemorySink()],
+                WorkerConfig(poll_max=512, snapshot_every=1,
+                             checkpoint_path=path),
+            )
+
+        path = str(tmp_path / "ckpt")
+        w1 = make(path)
+        w1.run_once()
+        totals_before = np.asarray(w1.models["top_src_ports"].model.totals)
+
+        w2 = make(path)
+        assert w2.restore()
+        np.testing.assert_array_equal(
+            np.asarray(w2.models["top_src_ports"].model.totals),
+            totals_before,
+        )
+
+    def test_exact_past_float32_mantissa(self):
+        # the 16-bit-plane + carry design must stay exact where float32
+        # accumulation loses increments (> 2^24 per cell per window)
+        cfg = DenseTopConfig(key_col="src_port", batch_size=1024)
+        m = DenseTopKModel(cfg)
+        n = 1024
+        batch = traffic(n)
+        batch.columns["src_port"][:] = 443  # one hot port
+        batch.columns["bytes"][:] = 60_000
+        rounds = 300  # 1024 * 60000 * 300 = 18.4e9 >> 2^24 (and > 2^32)
+        for _ in range(rounds):
+            m.update(batch)
+        top = m.top(1)
+        assert int(top["src_port"][0]) == 443
+        assert int(top["bytes"][0]) == n * 60_000 * rounds  # EXACT
+        assert int(top["count"][0]) == n * rounds
+
+    def test_checkpoint_kind_mismatch_skipped(self, tmp_path, caplog):
+        # a checkpoint whose port model was sketch-backed must not be
+        # loaded into a dense-backed model (wrong state family): skip
+        # loudly, never corrupt
+        from flow_pipeline_tpu.engine import StreamWorker, WorkerConfig
+        from flow_pipeline_tpu.models import HeavyHitterConfig
+        from flow_pipeline_tpu.models.heavy_hitter import HeavyHitterModel
+        from flow_pipeline_tpu.sink import MemorySink
+        from flow_pipeline_tpu.transport import Consumer, InProcessBus, Producer
+
+        bus = InProcessBus()
+        bus.create_topic("flows", 1)
+        Producer(bus, fixedlen=True).send_many(traffic(1000).to_messages())
+        path = str(tmp_path / "ckpt")
+
+        sketch_backed = StreamWorker(
+            Consumer(bus, fixedlen=True, group="old"),
+            {"top_src_ports": WindowedHeavyHitter(
+                HeavyHitterConfig(key_cols=("src_port",), batch_size=512,
+                                  width=1 << 10, capacity=32), k=5,
+                model_cls=HeavyHitterModel)},
+            [MemorySink()],
+            WorkerConfig(poll_max=512, snapshot_every=1,
+                         checkpoint_path=path),
+        )
+        sketch_backed.run_once()
+
+        dense_backed = StreamWorker(
+            Consumer(bus, fixedlen=True, group="new"),
+            {"top_src_ports": WindowedHeavyHitter(
+                DenseTopConfig(batch_size=512), k=5,
+                model_cls=DenseTopKModel)},
+            [MemorySink()],
+            WorkerConfig(poll_max=512, checkpoint_path=path),
+        )
+        assert dense_backed.restore()
+        inner = dense_backed.models["top_src_ports"].model
+        assert not hasattr(inner, "state")  # no stray sketch attribute
+        assert int(np.asarray(inner.totals).sum()) == 0  # untouched
