@@ -45,7 +45,11 @@ from ..schema.batch import FlowBatch
 @dataclass(frozen=True)
 class DenseTopConfig:
     key_col: str = "src_port"
-    domain: int = 1 << 16  # distinct key values; keys are ints in [0, domain)
+    # distinct key values; keys are ints in [0, domain). Rows whose key
+    # falls outside are dropped silently (same index-redirect that drops
+    # padding), so size the domain to the column's full range — 2^16
+    # covers ports; don't point this at a 32-bit column.
+    domain: int = 1 << 16
     value_cols: tuple[str, ...] = ("bytes", "packets")  # plane 0 ranks
     batch_size: int = 8192
 
