@@ -38,13 +38,23 @@ class StageTimer:
     def __init__(self):
         self._summaries = {}
 
-    @contextlib.contextmanager
-    def stage(self, name: str):
+    def _summary(self, name: str):
         s = self._summaries.get(name)
         if s is None:
             s = REGISTRY.summary(f"flow_summary_{name}_time_us",
                                  f"{name} stage wall time")
             self._summaries[name] = s
+        return s
+
+    def observe(self, name: str, us: float) -> None:
+        """Record one measurement directly (for callers that must decide
+        AFTER the fact whether a timing is worth recording, e.g. skipping
+        no-op flushes that would bury real latency in the quantiles)."""
+        self._summary(name).observe(us)
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        s = self._summary(name)
         t0 = time.perf_counter()
         try:
             yield

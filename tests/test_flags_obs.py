@@ -124,3 +124,51 @@ class TestMetrics:
                 urllib.request.urlopen(f"http://127.0.0.1:{server.port}/nope")
         finally:
             server.stop()
+
+
+class TestTracing:
+    def test_stage_timer_exports_summary_family(self):
+        from flow_pipeline_tpu.obs import REGISTRY
+        from flow_pipeline_tpu.obs.tracing import StageTimer
+
+        t = StageTimer()
+        with t.stage("decoding"):
+            pass
+        rendered = REGISTRY.render()
+        assert "flow_summary_decoding_time_us" in rendered
+
+    def test_worker_observes_stage_metrics(self):
+        from flow_pipeline_tpu.engine import StreamWorker, WorkerConfig
+        from flow_pipeline_tpu.gen import FlowGenerator, MockerProfile
+        from flow_pipeline_tpu.models import WindowAggConfig, WindowAggregator
+        from flow_pipeline_tpu.sink import MemorySink
+        from flow_pipeline_tpu.transport import Consumer, InProcessBus, Producer
+
+        bus = InProcessBus()
+        bus.create_topic("flows", 1)
+        g = FlowGenerator(MockerProfile(), seed=3, t0=1_699_999_800, rate=20.0)
+        Producer(bus, fixedlen=True).send_many(g.batch(1000).to_messages())
+        worker = StreamWorker(
+            Consumer(bus, fixedlen=True),
+            {"flows_5m": WindowAggregator(WindowAggConfig(batch_size=512))},
+            [MemorySink()],
+            WorkerConfig(poll_max=512),
+        )
+        worker.run(stop_when_idle=True)
+        assert worker.stages._summaries["processing"]._count > 0
+        assert worker.stages._summaries["flushing"]._count > 0
+
+    def test_device_trace_writes_profile(self, tmp_path):
+        import jax.numpy as jnp
+
+        from flow_pipeline_tpu.obs.tracing import device_trace
+
+        logdir = str(tmp_path / "trace")
+        with device_trace(logdir):
+            jnp.ones(8).sum().block_until_ready()
+        import glob
+        import os
+
+        assert glob.glob(os.path.join(logdir, "**", "*.pb"),
+                         recursive=True) or glob.glob(
+            os.path.join(logdir, "**", "*.trace.json.gz"), recursive=True)
