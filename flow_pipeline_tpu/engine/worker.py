@@ -180,32 +180,38 @@ class StreamWorker:
 
     def flush_closed(self, force: bool = False) -> None:
         """Emit rows for closed (or all, when force) windows to the sinks."""
-        emitted_before = self._emitted_since_snapshot
         t0 = time.perf_counter()
-        self._flush_closed(force)
+        emitted = self._flush_closed(force)
         # Observe only flushes that DID something: this runs every batch
         # but windows close hundreds of batches apart, so timing the
         # no-ops would bury real flush latency below every exported
-        # quantile of the 1024-sample summary window.
-        if self._emitted_since_snapshot and not emitted_before:
+        # quantile of the 1024-sample summary window. (The return value,
+        # not the shared snapshot flag: raw archiving sets that flag
+        # before the flush and would mask every mid-stream observation.)
+        if emitted:
             self.stages.observe("flushing", (time.perf_counter() - t0) * 1e6)
 
-    def _flush_closed(self, force: bool) -> None:
+    def _flush_closed(self, force: bool) -> bool:
+        emitted = False
         for name, model in self.models.items():
             if isinstance(model, WindowAggregator):
                 rows = model.flush(force)
                 if len(rows["timeslot"]):
                     self._emit(f"{name}", rows, len(rows["timeslot"]))
+                    emitted = True
             elif isinstance(model, WindowedHeavyHitter):
                 for top in model.flush(force):
                     n = int(top["valid"].sum())
                     self._emit(f"{name}", top, n)
+                    emitted = True
             elif isinstance(model, DDoSDetector):
                 if force:
                     model.close_sub_window()
                 if model.alerts:
                     alerts, model.alerts = model.alerts, []
                     self._emit(f"{name}", alerts, len(alerts))
+                    emitted = True
+        return emitted
 
     def _emit(self, table: str, rows, n: int) -> None:
         for sink in self.sinks:

@@ -50,6 +50,14 @@ class HeavyHitterConfig:
     # purely a per-hardware performance call; bench.py cms measures both).
     # On CPU the pallas path runs in interpret mode (tests only).
     cms_impl: str = "xla"
+    # Feed the table merge only the batch's top-`capacity` candidates by
+    # plane-0 sum, shrinking its sort from (capacity + batch) rows to
+    # 2*capacity. The CMS still counts EVERY row (estimates unaffected);
+    # only identity tracking loosens — a key must now rank in some
+    # batch's top-capacity to enter the table, so the Misra-Gries dropped
+    # -mass bound gains at most one batch's rank-capacity value per
+    # round. A per-hardware perf knob; measure before enabling.
+    table_prefilter: bool = False
 
 
 class HHState(NamedTuple):
@@ -131,6 +139,10 @@ def hh_update(state: HHState, cols: dict, valid, *, config: HeavyHitterConfig) -
     uniq, sums, counts = sort_groupby_float(keys, values, valid)
     row_valid = counts > 0
     new_cms = _cms_add(config)(state.cms, uniq, sums, row_valid)
+    if config.table_prefilter and uniq.shape[0] > config.capacity:
+        metric = jnp.where(row_valid, sums[:, 0], -jnp.inf)
+        _, sel = jax.lax.top_k(metric, config.capacity)
+        uniq, sums, row_valid = uniq[sel], sums[sel], row_valid[sel]
     tk, tv = topk_ops.topk_merge(
         state.table_keys, state.table_vals, uniq, sums, row_valid
     )
