@@ -204,8 +204,8 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.boolean("model.ddos", True, "DDoS spike detector")
     fs.integer("sketch.width", 1 << 16, "Count-min width")
     fs.string("sketch.cms", "xla", "CMS update impl: xla | pallas")
-    fs.boolean("sketch.prefilter", False, "Pre-truncate table-merge "
-                                          "candidates to top-capacity")
+    fs.boolean("sketch.prefilter", True, "Pre-truncate table-merge "
+                                         "candidates to top-capacity")
     fs.integer("sketch.capacity", 1024, "Top-K table capacity")
     fs.integer("sketch.topk", 100, "Rows emitted per window")
     fs.integer("window.lateness", 0, "Allowed lateness seconds")

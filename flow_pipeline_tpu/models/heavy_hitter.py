@@ -56,8 +56,11 @@ class HeavyHitterConfig:
     # only identity tracking loosens — a key must now rank in some
     # batch's top-capacity to enter the table, so the Misra-Gries dropped
     # -mass bound gains at most one batch's rank-capacity value per
-    # round. A per-hardware perf knob; measure before enabling.
-    table_prefilter: bool = False
+    # round. Default ON: measured +68% step throughput with zero top-20
+    # error at the flagship config (100k-key alpha=1.1 Zipf, 32k batches
+    # — flatter than real flow traffic); disable for adversarially
+    # uniform streams where no heavy key ranks within any single batch.
+    table_prefilter: bool = True
 
 
 class HHState(NamedTuple):
