@@ -15,6 +15,7 @@ sketches are commutative monoids, so merge == allreduce".
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from functools import partial
 
@@ -28,7 +29,11 @@ from jax import shard_map
 from ..models import ddos as ddos_mod
 from ..models import dense_top as dense_mod
 from ..models import heavy_hitter as hh
-from ..models.window_agg import WindowAggConfig, WindowAggregator
+from ..models.window_agg import (
+    WindowAggConfig,
+    WindowAggregator,
+    _cached_update,
+)
 from ..ops import topk as topk_ops
 from ..schema.batch import FlowBatch
 from .mesh import DATA_AXIS, make_mesh, shard_batch_columns
@@ -161,6 +166,29 @@ class ShardedHeavyHitter:
 # ---------------------------------------------------------------------------
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_window_update(mesh, window_seconds, key_cols, value_cols):
+    """Jitted per-chip window-agg step, cached on (mesh, program fields)
+    so fresh aggregators (supervisor restarts, benches) reuse the
+    compiled executable instead of re-tracing per instance."""
+    base = _cached_update(window_seconds, key_cols, value_cols)
+
+    def per_chip(cols, valid):
+        keys, sums, counts, n = base.__wrapped__(cols, valid)
+        return keys[None], sums[None], counts[None], n[None]
+
+    return jax.jit(
+        shard_map(
+            per_chip,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                       P(DATA_AXIS)),
+            check_vma=False,
+        )
+    )
+
+
 class ShardedWindowAggregator(WindowAggregator):
     """Exact windowed aggregation over a mesh.
 
@@ -176,20 +204,9 @@ class ShardedWindowAggregator(WindowAggregator):
         super().__init__(config)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_dev = self.mesh.devices.size
-        base = self._update  # single-chip jitted step
-
-        def per_chip(cols, valid):
-            keys, sums, counts, n = base.__wrapped__(cols, valid)
-            return keys[None], sums[None], counts[None], n[None]
-
-        self._sharded = jax.jit(
-            shard_map(
-                per_chip,
-                mesh=self.mesh,
-                in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
-                out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-                check_vma=False,
-            )
+        self._sharded = _sharded_window_update(
+            self.mesh, config.window_seconds, config.key_cols,
+            config.value_cols,
         )
 
     @property
