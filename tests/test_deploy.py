@@ -176,9 +176,10 @@ class TestDashboardHonesty:
                   "by", "histogram_quantile"}
     SQL_KEYWORDS = {"select", "from", "where", "group", "by", "order",
                     "limit", "as", "between", "and", "or", "desc", "asc",
-                    "in", "not", "time"}
+                    "in", "not", "time", "case", "when", "then", "else",
+                    "end"}
     SQL_FUNCS = {"to_timestamp", "sum", "max", "min", "avg", "concat",
-                 "toString"}
+                 "toString", "multiIf"}
     GRAFANA_MACROS = {"__timeFrom", "__timeTo", "__timeFilter",
                       "__fromTime", "__toTime"}
 
@@ -269,8 +270,9 @@ class TestDashboardHonesty:
                 allowed.update(c.lower() for c in table_cols[t])
             aliases = {a.lower()
                        for a in re.findall(r"\bAS\s+(\w+)", sql, re.I)}
+            bare = re.sub(r"'[^']*'", "", sql)  # drop string literals
             idents = {i.lower() for i in
-                      re.findall(r"[a-zA-Z_][a-zA-Z0-9_]*", sql)}
+                      re.findall(r"[a-zA-Z_][a-zA-Z0-9_]*", bare)}
             unknown = (idents - self.SQL_KEYWORDS
                        - {f.lower() for f in self.SQL_FUNCS}
                        - {m.lower() for m in self.GRAFANA_MACROS}
