@@ -197,6 +197,8 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.integer("processor.batch", 8192, "Device batch rows (per chip)")
     fs.integer("processor.mesh", 0, "Shard models over this many devices "
                                     "(0 = single chip)")
+    fs.boolean("processor.fused", True, "One fused device step per batch "
+                                        "with shared pre-aggregation")
     fs.boolean("model.flows5m", True, "Exact 5m rollup model")
     fs.boolean("model.talkers", True, "5-tuple top-K talkers model")
     fs.boolean("model.ips", True, "Top src/dst IP models")
@@ -377,6 +379,7 @@ def processor_main(argv=None) -> int:
                 checkpoint_path=vals["checkpoint.path"] or None,
                 archive_raw=vals["archive.raw"],
                 prefetch=vals["feed.prefetch"],
+                fused=vals["processor.fused"],
             ),
         )
         if vals["query.addr"]:

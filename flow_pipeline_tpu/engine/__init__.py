@@ -18,12 +18,14 @@ counting (SURVEY.md §5 checkpoint/resume).
 from .worker import StreamWorker, WorkerConfig
 from .windowed import WindowedHeavyHitter
 from .checkpoint import save_checkpoint, load_checkpoint
+from .fused import FusedPipeline
 from .prefetch import PrefetchConsumer
 from .supervisor import Supervisor, SupervisorConfig
 
 __all__ = [
     "StreamWorker",
     "WorkerConfig",
+    "FusedPipeline",
     "PrefetchConsumer",
     "WindowedHeavyHitter",
     "save_checkpoint",

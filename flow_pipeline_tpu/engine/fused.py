@@ -1,0 +1,427 @@
+"""Fused per-batch device step: one dispatch, shared pre-aggregation.
+
+The unfused worker updates every model serially and each sketch/exact
+model independently re-sorts the same batch — five multi-key sorts and
+~eight dispatches per batch at the default model set. The reference's
+ClickHouse rollup chain makes ONE pass over the raw rows per ingest and
+fans the materialized views out from it (ref: compose/clickhouse/
+create.sh:92-110). This module is the TPU-first equivalent:
+
+- ONE lexicographic master sort on (src_addr, dst_addr, src_port,
+  dst_port, proto) serves every model whose key is a PREFIX of that
+  ordering (5-tuple top-talkers, src-pair, src-address): rows sorted by
+  the full key are already grouped by each prefix, so those models need
+  only the cheap boundary-detect + segment-sum half of the groupby
+  (ops.segment.presorted_groupby_float).
+- ONE dst-keyed sort serves BOTH the top-dst-IP sketch and the DDoS
+  per-dst accumulate (they want the same per-dst sums).
+- The flows_5m exact groupby, the dense port scatters, and all sketch
+  table merges run in the SAME jitted step, so the worker makes one
+  device dispatch per chunk and every column crosses the host boundary
+  once.
+
+Window lifecycle (closing sketches at slot roll, DDoS sub-windows, late
+-row drops) stays host-side and byte-identical to the unfused models':
+the batch is split at (slot, sub-window) boundaries and each homogeneous
+group advances the wrapped models' own lifecycle hooks before the fused
+device call. tests/test_fused.py proves output equivalence against the
+unfused path, late rows included.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models import heavy_hitter as hh
+from ..models.ddos import DDoSDetector, _accumulate_grouped
+from ..models.dense_top import DenseTopKModel, dense_update
+from ..models.heavy_hitter import HeavyHitterModel
+from ..models.window_agg import WindowAggregator
+from ..models.window_agg import _cached_update as _cached_wagg_update
+from ..obs import get_logger
+from ..schema.batch import FlowBatch, lane_width
+from ..ops.segment import (
+    presorted_groupby_float,
+    presorted_segments,
+    sort_groupby_float,
+    sort_rows_float,
+)
+from .windowed import WindowedHeavyHitter
+
+log = get_logger("fused")
+
+# The master sort ordering. Any hh key that is a prefix of this column
+# order rides the single master sort; extending the tuple here (and in
+# _hh_plan) is all it takes to admit more families.
+MASTER_KEY = ("src_addr", "dst_addr", "src_port", "dst_port", "proto")
+_SENTINEL = jnp.uint32(0xFFFFFFFF)
+
+
+def _hh_plan(cfg) -> tuple:
+    """How a heavy-hitter config's pre-agg is computed inside the fused
+    step: ("A", lane_width) = prefix of the master sort; ("B",) = the
+    shared dst-keyed sort; ("own",) = its own sort_groupby_float (still
+    inside the fused dispatch, just not shared)."""
+    if tuple(cfg.value_cols) != ("bytes", "packets"):
+        return ("own",)
+    if cfg.key_cols == MASTER_KEY[: len(cfg.key_cols)]:
+        return ("A", sum(lane_width(c) for c in cfg.key_cols))
+    if cfg.key_cols == ("dst_addr",):
+        return ("B",)
+    return ("own",)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs, master_cols):
+    """Build + jit the fused device step for one static model spec.
+
+    Module-level cache: pipelines are rebuilt freely (bench samples,
+    supervisor restarts), and the fused graph is the most expensive
+    compile in the framework — it must be shared the same way the
+    unfused models' module-level jits are. All spec elements are frozen
+    config dataclasses / string tuples, so the key is hashable.
+    """
+    wagg_fns = tuple(_cached_wagg_update(c.window_seconds, c.key_cols,
+                                         c.value_cols) for c in wagg_cfgs)
+    need_a = any(plan[0] == "A" for plan, _ in hh_specs)
+    hh_b = any(plan[0] == "B" for plan, _ in hh_specs)
+    need_b = hh_b or bool(ddos_cfgs)
+    hh_vals = ("bytes", "packets")  # the A/B shared payload planes
+    # Ports are 16-bit: packing (src_port << 16) | dst_port into ONE sort
+    # lane drops the master sort from 11 to 10 key lanes (sort cost scales
+    # with lane count; lexicographic order is preserved since both fields
+    # are 16-bit). Only when every A consumer's width avoids splitting the
+    # packed lane (4 = src, 8 = src+dst, 11 = full 5-tuple).
+    a_widths = sorted({plan[1] for plan, _ in hh_specs if plan[0] == "A"})
+    pack_ports = (len(master_cols) == 5
+                  and all(w in (4, 8, 11) for w in a_widths))
+
+    def to_f32(col):
+        # int32 bit-patterns of uint32 counters: reinterpret unsigned
+        # before the float cast so saturated values stay positive
+        return col.astype(jnp.uint32).astype(jnp.float32)
+
+    def step(states, cols, valid, valid_hh, valid_dd):
+        hh_states, dense_tots, ddos_states = states
+
+        if need_a:
+            if pack_ports:
+                packed = ((cols["src_port"].astype(jnp.uint32)
+                           << jnp.uint32(16))
+                          | (cols["dst_port"].astype(jnp.uint32)
+                             & jnp.uint32(0xFFFF)))
+                lanes = jnp.concatenate(
+                    [cols["src_addr"].astype(jnp.uint32),
+                     cols["dst_addr"].astype(jnp.uint32),
+                     packed[:, None],
+                     cols["proto"].astype(jnp.uint32)[:, None]], axis=1)
+            else:
+                lanes = hh._key_lanes(cols, master_cols)
+            vals = jnp.stack([to_f32(cols[c]) for c in hh_vals], axis=1)
+            sk_a, sv_a, sc_a = sort_rows_float(lanes, vals, valid_hh)
+            groupby_cache: dict[int, tuple] = {}
+
+            def groupby_a(width):
+                if width not in groupby_cache:
+                    if pack_ports and width > 8:
+                        u, s, c = presorted_groupby_float(
+                            sk_a, sv_a, sc_a, 10)
+                        unpacked = jnp.concatenate(
+                            [u[:, :8],
+                             (u[:, 8] >> jnp.uint32(16))[:, None],
+                             (u[:, 8] & jnp.uint32(0xFFFF))[:, None],
+                             u[:, 9:]], axis=1)
+                        # restore the all-1s sentinel on padding rows the
+                        # unpack split into 0xFFFF halves (ops.topk drops
+                        # the sentinel tuple by comparing whole lanes)
+                        u = jnp.where((c > 0)[:, None], unpacked, _SENTINEL)
+                        groupby_cache[width] = (u, s, c)
+                    else:
+                        groupby_cache[width] = presorted_groupby_float(
+                            sk_a, sv_a, sc_a, width)
+                return groupby_cache[width]
+
+        if need_b:
+            dst = cols["dst_addr"].astype(jnp.uint32)
+            vb = valid_hh if hh_b else jnp.zeros_like(valid_hh)
+            vd = (valid_dd if ddos_cfgs
+                  else jnp.zeros_like(valid_hh))
+            va = vb | vd
+            ku = jnp.where(va[:, None], dst, _SENTINEL)
+            n = ku.shape[0]
+            # iota payload + post-sort gathers (see ops.segment): per-
+            # consumer masks apply to the GATHERED rows, so the dual-mask
+            # planes cost gathers, not extra sort lanes
+            so = lax.sort([ku[:, i] for i in range(4)]
+                          + [lax.iota(jnp.int32, n)], num_keys=4)
+            perm = so[4]
+            sk_b = jnp.stack(so[:4], axis=1)
+            vbp, vdp = vb[perm], vd[perm]
+            planes, cnts = [], []
+            if hh_b:
+                for c in hh_vals:
+                    planes.append(jnp.where(vbp, to_f32(cols[c])[perm], 0.0))
+                cnts.append(vbp.astype(jnp.int32))
+            for dcfg in ddos_cfgs[:1]:  # detectors share cadence+col set
+                planes.append(
+                    jnp.where(vdp, to_f32(cols[dcfg.value_col])[perm], 0.0))
+                cnts.append(vdp.astype(jnp.int32))
+            sv_b = jnp.stack(planes, axis=1)
+            sc_b = jnp.stack(cnts, axis=1)  # [N, nc]
+            seg = presorted_segments(sk_b)
+            sums_b = jax.ops.segment_sum(sv_b, seg, num_segments=n)
+            cnt_b = jax.ops.segment_sum(sc_b, seg, num_segments=n)
+            uniq_b = jax.ops.segment_max(sk_b, seg, num_segments=n)
+
+            def consume_b(plane_ix, cnt_ix, nplanes):
+                counts = cnt_b[:, cnt_ix]
+                real = counts > 0
+                s = jnp.where(real[:, None],
+                              sums_b[:, plane_ix:plane_ix + nplanes], 0.0)
+                u = jnp.where(real[:, None], uniq_b, _SENTINEL)
+                return u, s, counts
+
+        new_hh = []
+        for (plan, cfg), st in zip(hh_specs, hh_states):
+            if plan[0] == "A":
+                uniq, sums, counts = groupby_a(plan[1])
+            elif plan[0] == "B":
+                uniq, sums, counts = consume_b(0, 0, 2)
+            else:
+                lanes = hh._key_lanes(cols, cfg.key_cols)
+                vals = jnp.stack(
+                    [to_f32(cols[c]) for c in cfg.value_cols], axis=1)
+                uniq, sums, counts = sort_groupby_float(
+                    lanes, vals, valid_hh)
+            sums3 = jnp.concatenate(
+                [sums, counts.astype(jnp.float32)[:, None]], axis=1)
+            new_hh.append(
+                hh._apply_grouped(st, uniq, sums3, counts > 0, cfg))
+
+        new_dense = tuple(
+            dense_update(t, cols, valid_hh, config=c)
+            for t, c in zip(dense_tots, dense_cfgs)
+        )
+
+        new_ddos = []
+        for dcfg, dst_state in zip(ddos_cfgs, ddos_states):
+            plane_ix = 2 if hh_b else 0
+            cnt_ix = 1 if hh_b else 0
+            u, s, counts = consume_b(plane_ix, cnt_ix, 1)
+            new_ddos.append(_accumulate_grouped(
+                dst_state, u, s[:, 0], counts > 0, dcfg))
+
+        wagg_parts = tuple(fn(cols, valid) for fn in wagg_fns)
+        return (tuple(new_hh), new_dense, tuple(new_ddos)), wagg_parts
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+class FusedPipeline:
+    """Drives a worker's whole model dict through one jitted step/batch."""
+
+    @staticmethod
+    def supported(models: dict[str, Any]) -> bool:
+        """True iff every model is a plain single-chip kind this pipeline
+        knows how to fuse (sharded/mesh variants keep the per-model path:
+        their states live as mesh-sharded arrays with their own update
+        programs) and the windowed models agree on cadence/chunking."""
+        whh_windows, subs, batch_sizes = set(), set(), set()
+        for m in models.values():
+            if type(m) is WindowAggregator:
+                batch_sizes.add(m.config.batch_size)
+            elif type(m) is WindowedHeavyHitter and type(m.model) in (
+                    HeavyHitterModel, DenseTopKModel):
+                whh_windows.add(m.window_seconds)
+                batch_sizes.add(m.config.batch_size)
+            elif type(m) is DDoSDetector:
+                subs.add(m.config.sub_window_seconds)
+                batch_sizes.add(m.config.batch_size)
+            else:
+                return False
+        n_ddos = sum(type(m) is DDoSDetector for m in models.values())
+        return (len(whh_windows) <= 1 and len(subs) <= 1 and n_ddos <= 1
+                and len(batch_sizes) == 1)
+
+    def __init__(self, models: dict[str, Any]):
+        if not self.supported(models):
+            raise ValueError("model set not fusable (see supported())")
+        self._waggs: list[tuple[str, WindowAggregator]] = []
+        self._hh: list[tuple[str, WindowedHeavyHitter]] = []
+        self._dense: list[tuple[str, WindowedHeavyHitter]] = []
+        self._ddos: list[tuple[str, DDoSDetector]] = []
+        self._whh: list[WindowedHeavyHitter] = []  # hh + dense wrappers
+        for name, m in models.items():
+            if type(m) is WindowAggregator:
+                self._waggs.append((name, m))
+            elif type(m) is DDoSDetector:
+                self._ddos.append((name, m))
+            elif type(m.model) is HeavyHitterModel:
+                self._hh.append((name, m))
+                self._whh.append(m)
+            else:
+                self._dense.append((name, m))
+                self._whh.append(m)
+        first = next(iter(models.values()))
+        self._bs = first.config.batch_size
+        self._window_seconds = (self._whh[0].window_seconds
+                                if self._whh else None)
+        self._sub_seconds = (self._ddos[0][1].config.sub_window_seconds
+                             if self._ddos else None)
+        self._hh_specs = tuple(
+            (_hh_plan(w.config), w.config) for _, w in self._hh)
+        # Master sort keys only the longest prefix any A-plan model needs
+        # (a lone src-address model keys 4 lanes, not 11).
+        a_width = max((plan[1] for plan, _ in self._hh_specs
+                       if plan[0] == "A"), default=0)
+        cols, width = [], 0
+        for c in MASTER_KEY:
+            if width >= a_width:
+                break
+            cols.append(c)
+            width += lane_width(c)
+        self._master_cols = tuple(cols)
+        self._cols = self._column_union()
+        # The compiled step is cached on the static spec, NOT per instance:
+        # every bench sample / supervisor restart builds a fresh pipeline,
+        # and a per-instance jit would recompile the whole fused graph
+        # each time (the unfused models' jits are module-cached too).
+        self._step = _cached_step(
+            self._hh_specs,
+            tuple(w.config for _, w in self._dense),
+            tuple(d.config for _, d in self._ddos),
+            tuple(m.config for _, m in self._waggs),
+            self._master_cols,
+        )
+
+    # ---- device step ------------------------------------------------------
+
+    def _column_union(self) -> tuple[str, ...]:
+        cols: list[str] = []
+
+        def add(*names):
+            for n in names:
+                if n not in cols:
+                    cols.append(n)
+
+        for _, m in self._waggs:
+            add("time_received", *m.config.key_cols, *m.config.value_cols)
+        add(*self._master_cols)
+        for _, w in self._hh:
+            add(*w.config.key_cols, *w.config.value_cols)
+        for _, w in self._dense:
+            add(w.config.key_col, *w.config.value_cols)
+        for _, d in self._ddos:
+            add("dst_addr", d.config.value_col)
+        return tuple(cols)
+
+    # ---- host lifecycle ---------------------------------------------------
+
+    def update(self, batch: FlowBatch) -> None:
+        n = len(batch)
+        if n == 0:
+            return
+        t = batch.columns["time_received"].astype(np.int64)
+        slots = ((t // self._window_seconds) * self._window_seconds
+                 if self._whh else np.zeros(n, np.int64))
+        subs = ((t // self._sub_seconds) * self._sub_seconds
+                if self._ddos else np.zeros(n, np.int64))
+        pairs = np.stack([slots, subs], axis=1)
+        uniq_pairs, inverse = np.unique(pairs, axis=0, return_inverse=True)
+        inverse = inverse.reshape(-1)  # numpy 2.0 shape quirk under axis=
+        for gi, (slot, sub) in enumerate(uniq_pairs):
+            if len(uniq_pairs) == 1:
+                part = batch
+            else:
+                idx = np.flatnonzero(inverse == gi)
+                part = FlowBatch(
+                    {k: v[idx] for k, v in batch.columns.items()},
+                    batch.partition,
+                )
+            do_hh = self._advance_hh(int(slot), len(part))
+            do_dd = self._advance_ddos(int(sub), len(part))
+            self._run_chunks(part, do_hh, do_dd)
+        wm = int(t.max())
+        for _, m in self._waggs:
+            if wm > m.watermark:
+                m.watermark = wm
+
+    def _advance_hh(self, slot: int, n_rows: int) -> bool:
+        """Lockstep WindowedHeavyHitter lifecycle (same transitions as its
+        own update(): first slot adopts, newer slot closes + rolls, older
+        slot drops late rows). Returns False when the group is late."""
+        if not self._whh:
+            return False
+        cur = self._whh[0].current_slot
+        if cur is None:
+            for w in self._whh:
+                w.current_slot = slot
+            return True
+        if slot > cur:
+            for w in self._whh:
+                w._close()
+                w.current_slot = slot
+            return True
+        if slot < cur:
+            for w in self._whh:
+                w.late_flows_dropped += n_rows
+            return False
+        return True
+
+    def _advance_ddos(self, sub: int, n_rows: int) -> bool:
+        """Lockstep DDoSDetector sub-window lifecycle (close scores the
+        OLD sub-window before current_sub advances, as in its update())."""
+        if not self._ddos:
+            return False
+        cur = self._ddos[0][1].current_sub
+        if cur is None:
+            for _, d in self._ddos:
+                d.current_sub = sub
+            return True
+        if sub > cur:
+            for _, d in self._ddos:
+                d.close_sub_window()
+                d.current_sub = sub
+            return True
+        if sub < cur:
+            for _, d in self._ddos:
+                d.late_flows_dropped += n_rows
+            return False
+        return True
+
+    def _run_chunks(self, part: FlowBatch, do_hh: bool, do_dd: bool) -> None:
+        bs = self._bs
+        for start in range(0, len(part), bs):
+            padded, mask = part.slice(start, start + bs).pad_to(bs)
+            cols = {
+                k: jnp.asarray(v)
+                for k, v in padded.device_columns(self._cols).items()
+            }
+            valid = jnp.asarray(mask)
+            zeros = (jnp.zeros_like(valid)
+                     if not (do_hh and do_dd) else None)
+            states = (
+                tuple(w.model.state for _, w in self._hh),
+                tuple(w.model.totals for _, w in self._dense),
+                tuple(d.state for _, d in self._ddos),
+            )
+            new_states, wagg_parts = self._step(
+                states, cols, valid,
+                valid if do_hh else zeros,
+                valid if do_dd else zeros,
+            )
+            new_hh, new_dense, new_ddos = new_states
+            for (_, w), st in zip(self._hh, new_hh):
+                w.model.state = st
+            for (_, w), tot in zip(self._dense, new_dense):
+                w.model.totals = tot
+            for (_, d), st in zip(self._ddos, new_ddos):
+                d.state = st
+            for (_, m), out in zip(self._waggs, wagg_parts):
+                m.add_partial(out)

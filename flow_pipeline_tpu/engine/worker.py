@@ -37,6 +37,11 @@ class WorkerConfig:
     # PrefetchConsumer holding this many decoded batches ready, so host
     # fetch+decode for batch i+1 overlaps the device step for batch i.
     prefetch: int = 2
+    # Fuse the per-model device updates into one jitted step per batch
+    # with shared pre-aggregation (engine.fused) when the model set
+    # supports it; falls back to the serial per-model path otherwise
+    # (e.g. mesh-sharded models). Outputs are equivalence-tested.
+    fused: bool = True
     # Full-fidelity raw archiving (the reference's flows_raw path,
     # ref: compose/clickhouse/create.sh:36-62): every consumed batch is
     # handed to sinks exposing archive_raw(batch). Off by default — the
@@ -65,6 +70,14 @@ class StreamWorker:
         self.models = models
         self.sinks = list(sinks)
         self.config = config
+        self.fused = None
+        if config.fused and models:
+            from .fused import FusedPipeline
+
+            if FusedPipeline.supported(models):
+                self.fused = FusedPipeline(models)
+            else:
+                log.info("model set not fusable; using per-model updates")
         self.batches_seen = 0
         self.flows_seen = 0
         # offsets covered by state (committable after next snapshot/flush)
@@ -125,8 +138,12 @@ class StreamWorker:
             # below), not snapshot_every batches' worth of raw rows.
             self._emitted_since_snapshot |= archived
         with self.stages.stage("processing"):
+            if self.fused is not None:
+                self.fused.update(batch)
+            else:
+                for model in self.models.values():
+                    model.update(batch)
             for name, model in self.models.items():
-                model.update(batch)
                 dropped = getattr(model, "late_flows_dropped", None)
                 if dropped:
                     self.m_late.set(dropped, model=name)

@@ -74,6 +74,26 @@ def ddos_init(config: DDoSConfig, spec: QuantileSketchSpec) -> DDoSState:
     )
 
 
+def _accumulate_grouped(state: DDoSState, uniq, dsums, row_valid,
+                        config: DDoSConfig):
+    """Scatter pre-aggregated per-dst sums into the current sub-window.
+    ``uniq`` [N,4] uint32 unique dst rows, ``dsums`` [N] float32 per-dst
+    value sums, ``row_valid`` [N] bool. Shared by ddos_accumulate and the
+    fused pipeline (engine.fused), which reuses the dst-keyed groupby the
+    top-dst-IP model already computed."""
+    buckets = ewma_ops.bucket_of(uniq, config.n_buckets)
+    rates = ewma_ops.rate_accumulate(state.rates, buckets, dsums, row_valid)
+    # Invalid rows go to index n_buckets: out of range HIGH, which
+    # mode="drop" discards (a negative index would wrap before the check).
+    safe_buckets = jnp.where(row_valid, buckets, config.n_buckets)
+    masked = jnp.where(row_valid, dsums, -1.0)
+    wmax = state.wmax.at[safe_buckets].max(masked, mode="drop")
+    is_witness = row_valid & (masked >= wmax[buckets])
+    witness_buckets = jnp.where(is_witness, buckets, config.n_buckets)
+    addrs = state.addrs.at[witness_buckets].set(uniq, mode="drop")
+    return state._replace(rates=rates, addrs=addrs, wmax=wmax)
+
+
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("state",))
 def ddos_accumulate(state: DDoSState, cols: dict, valid, *, config: DDoSConfig):
     """Scatter one batch into the current sub-window.
@@ -90,19 +110,7 @@ def ddos_accumulate(state: DDoSState, cols: dict, valid, *, config: DDoSConfig):
     # uint32 reinterpretation keeps saturated counters (>2^31) positive
     vals = cols[config.value_col].astype(jnp.uint32).astype(jnp.float32)
     uniq, sums, counts = sort_groupby_float(dst, vals[:, None], valid)
-    row_valid = counts > 0
-    dsums = sums[:, 0]
-    buckets = ewma_ops.bucket_of(uniq, config.n_buckets)
-    rates = ewma_ops.rate_accumulate(state.rates, buckets, dsums, row_valid)
-    # Invalid rows go to index n_buckets: out of range HIGH, which
-    # mode="drop" discards (a negative index would wrap before the check).
-    safe_buckets = jnp.where(row_valid, buckets, config.n_buckets)
-    masked = jnp.where(row_valid, dsums, -1.0)
-    wmax = state.wmax.at[safe_buckets].max(masked, mode="drop")
-    is_witness = row_valid & (masked >= wmax[buckets])
-    witness_buckets = jnp.where(is_witness, buckets, config.n_buckets)
-    addrs = state.addrs.at[witness_buckets].set(uniq, mode="drop")
-    return state._replace(rates=rates, addrs=addrs, wmax=wmax)
+    return _accumulate_grouped(state, uniq, sums[:, 0], counts > 0, config)
 
 
 @partial(jax.jit, static_argnames=("config", "spec"), donate_argnames=("state",))

@@ -124,6 +124,24 @@ def _cms_add(config: HeavyHitterConfig):
             else cms_ops.cms_add)
 
 
+def _apply_grouped(state: HHState, uniq, sums, row_valid,
+                   config: HeavyHitterConfig) -> HHState:
+    """CMS + table merge over pre-aggregated groups (the post-sort half of
+    the step). ``uniq`` [N, key_width] uint32 unique key rows, ``sums``
+    [N, P+1] float32 per-group value sums with the count plane LAST,
+    ``row_valid`` [N] bool. Shared by hh_update and the fused pipeline
+    (engine.fused), which computes the groupby once per key family."""
+    new_cms = _cms_add(config)(state.cms, uniq, sums, row_valid)
+    if config.table_prefilter and uniq.shape[0] > config.capacity:
+        metric = jnp.where(row_valid, sums[:, 0], -jnp.inf)
+        _, sel = jax.lax.top_k(metric, config.capacity)
+        uniq, sums, row_valid = uniq[sel], sums[sel], row_valid[sel]
+    tk, tv = topk_ops.topk_merge(
+        state.table_keys, state.table_vals, uniq, sums, row_valid
+    )
+    return HHState(cms=new_cms, table_keys=tk, table_vals=tv)
+
+
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("state",))
 def hh_update(state: HHState, cols: dict, valid, *, config: HeavyHitterConfig) -> HHState:
     """One batch step, fully on device."""
@@ -140,16 +158,7 @@ def hh_update(state: HHState, cols: dict, valid, *, config: HeavyHitterConfig) -
         axis=1,
     )
     uniq, sums, counts = sort_groupby_float(keys, values, valid)
-    row_valid = counts > 0
-    new_cms = _cms_add(config)(state.cms, uniq, sums, row_valid)
-    if config.table_prefilter and uniq.shape[0] > config.capacity:
-        metric = jnp.where(row_valid, sums[:, 0], -jnp.inf)
-        _, sel = jax.lax.top_k(metric, config.capacity)
-        uniq, sums, row_valid = uniq[sel], sums[sel], row_valid[sel]
-    tk, tv = topk_ops.topk_merge(
-        state.table_keys, state.table_vals, uniq, sums, row_valid
-    )
-    return HHState(cms=new_cms, table_keys=tk, table_vals=tv)
+    return _apply_grouped(state, uniq, sums, counts > 0, config)
 
 
 @partial(jax.jit, static_argnames=("config",))

@@ -122,10 +122,15 @@ class WindowAggregator:
                 ["time_received", *self.config.key_cols, *self.config.value_cols]
             ).items()
         }
-        keys, sums, counts, n = self._update(cols, jnp.asarray(mask))
-        self._pending_partials.append((keys, sums, counts, n))
-        # bound the deferral: a flush-free caller (huge update() loops) must
-        # not pin unbounded padded buffers on device
+        self.add_partial(self._update(cols, jnp.asarray(mask)))
+
+    def add_partial(self, partial) -> None:
+        """Queue one device partial (keys, sums, counts, n) for the next
+        drain. Single entry point for both the per-model path and the
+        fused pipeline, so the deferral bound lives in one place: a
+        flush-free caller (huge update() loops) must not pin unbounded
+        padded buffers on device."""
+        self._pending_partials.append(partial)
         if len(self._pending_partials) >= 32:
             self._drain()
 

@@ -1,0 +1,222 @@
+"""Fused-pipeline equivalence (engine.fused vs the per-model path).
+
+The fused step shares one master sort across every prefix-keyed model and
+one dst-keyed sort between the top-dst sketch and the DDoS accumulate; it
+must be OUTPUT-IDENTICAL to the serial per-model path — same flows_5m
+rows, same top-K tables, same DDoS alerts, same late-row drops. Window
+lifecycles are driven host-side exactly like the unfused wrappers, so the
+comparison covers slot rolls and late data too.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from flow_pipeline_tpu.engine import (
+    FusedPipeline,
+    StreamWorker,
+    WindowedHeavyHitter,
+    WorkerConfig,
+)
+from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+from flow_pipeline_tpu.models import (
+    DDoSConfig,
+    DDoSDetector,
+    DenseTopConfig,
+    DenseTopKModel,
+    HeavyHitterConfig,
+    WindowAggConfig,
+    WindowAggregator,
+)
+from flow_pipeline_tpu.ops.segment import (
+    presorted_groupby_float,
+    sort_groupby_float,
+    sort_rows_float,
+)
+from flow_pipeline_tpu.transport import Consumer, InProcessBus
+
+WINDOW = 300
+BS = 512
+
+
+def make_models(sub_seconds: int, n_keys: int):
+    """The cli's default model family at test scale (cli._build_models)."""
+    def hh_cfg(key_cols):
+        return HeavyHitterConfig(key_cols=key_cols, batch_size=BS,
+                                 width=1 << 10, capacity=128)
+
+    return {
+        "flows_5m": WindowAggregator(WindowAggConfig(batch_size=BS)),
+        "top_talkers": WindowedHeavyHitter(
+            hh_cfg(("src_addr", "dst_addr", "src_port", "dst_port",
+                    "proto")), k=50),
+        "top_src_ips": WindowedHeavyHitter(hh_cfg(("src_addr",)), k=50),
+        "top_dst_ips": WindowedHeavyHitter(hh_cfg(("dst_addr",)), k=50),
+        "top_src_ports": WindowedHeavyHitter(
+            DenseTopConfig(key_col="src_port", batch_size=BS), k=50,
+            model_cls=DenseTopKModel),
+        "ddos_alerts": DDoSDetector(DDoSConfig(
+            n_buckets=1 << 10, sub_window_seconds=sub_seconds,
+            warmup_windows=0, batch_size=BS)),
+    }
+
+
+def make_stream(n_keys: int = 100):
+    """8 batches crossing 3 window slots, with late rows in batch 5."""
+    gen = FlowGenerator(ZipfProfile(n_keys=n_keys, alpha=1.2), seed=7)
+    t0 = 6000  # slot-aligned (6000 % 300 == 0)
+    batches = []
+    for i in range(8):
+        b = gen.batch(BS)
+        times = t0 + i * 90 + (np.arange(BS) % 30)
+        if i == 5:
+            times[:25] = t0  # two slots behind current by then: late
+        b.columns["time_received"] = times.astype(np.uint64)
+        batches.append(b)
+    return batches
+
+
+def drive_fused(models, batches):
+    pipe = FusedPipeline(models)
+    for b in batches:
+        pipe.update(b)
+    return models
+
+
+def drive_serial(models, batches):
+    for b in batches:
+        for m in models.values():
+            m.update(b)
+    return models
+
+
+def canon_rows(rows: dict) -> list[tuple]:
+    """Columnar rows dict -> sorted list of per-row tuples."""
+    names = sorted(rows)
+    cols = [np.asarray(rows[n]).reshape(len(rows[names[0]]), -1)
+            for n in names]
+    return sorted(tuple(x for c in cols for x in c[i]) for i in
+                  range(len(cols[0])))
+
+
+def assert_same_windows(a: list[dict], b: list[dict], keys=None):
+    assert len(a) == len(b)
+    for wa, wb in zip(a, b):
+        names = keys or sorted(set(wa) | set(wb))
+        for name in names:
+            np.testing.assert_array_equal(
+                np.asarray(wa[name]), np.asarray(wb[name]),
+                err_msg=f"window column {name!r} diverged")
+
+
+def test_prefix_groupby_matches_direct(rng):
+    """Grouping presorted rows by a key PREFIX == sorting by that prefix
+    directly (integer-valued floats: order-independent sums)."""
+    keys = rng.integers(0, 5, size=(64, 3)).astype(np.uint32)
+    vals = rng.integers(0, 100, size=(64, 2)).astype(np.float32)
+    valid = rng.random(64) < 0.8
+    sk, sv, sc = sort_rows_float(jnp.asarray(keys), jnp.asarray(vals),
+                                 jnp.asarray(valid))
+    for width in (1, 2, 3):
+        got = presorted_groupby_float(sk, sv, sc, width)
+        want = sort_groupby_float(jnp.asarray(keys[:, :width]),
+                                  jnp.asarray(vals), jnp.asarray(valid))
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+class TestFusedEquivalence:
+    def test_aligned_cadence_bit_exact(self):
+        """DDoS cadence == window: identical chunking everywhere, so every
+        output must match bit-for-bit (CMS estimates included)."""
+        batches = make_stream()
+        fused = drive_fused(make_models(WINDOW, 100), batches)
+        serial = drive_serial(make_models(WINDOW, 100), batches)
+
+        assert canon_rows(fused["flows_5m"].flush(True)) == \
+            canon_rows(serial["flows_5m"].flush(True))
+        for name in ("top_talkers", "top_src_ips", "top_dst_ips",
+                     "top_src_ports"):
+            assert_same_windows(fused[name].flush(True),
+                                serial[name].flush(True))
+            assert fused[name].late_flows_dropped == \
+                serial[name].late_flows_dropped
+        fa, sa = fused["ddos_alerts"], serial["ddos_alerts"]
+        assert fa.late_flows_dropped == sa.late_flows_dropped
+        assert len(fa.alerts) == len(sa.alerts)
+        for x, y in zip(fa.alerts, sa.alerts):
+            assert x.keys() == y.keys()
+            for k in x:
+                np.testing.assert_array_equal(np.asarray(x[k]),
+                                              np.asarray(y[k]))
+
+    def test_finer_ddos_cadence(self):
+        """DDoS sub-windows finer than the sketch window: the fused path
+        chunks hh updates at sub boundaries, so CMS *estimates* may take a
+        different (equally valid) path — but exact outputs (flows_5m,
+        dense ports, ddos, table sums with no eviction) must still match."""
+        batches = make_stream(n_keys=100)  # 100 < capacity 128: no eviction
+        fused = drive_fused(make_models(30, 100), batches)
+        serial = drive_serial(make_models(30, 100), batches)
+
+        assert canon_rows(fused["flows_5m"].flush(True)) == \
+            canon_rows(serial["flows_5m"].flush(True))
+        assert_same_windows(fused["top_src_ports"].flush(True),
+                            serial["top_src_ports"].flush(True))
+        for name in ("top_talkers", "top_src_ips", "top_dst_ips"):
+            exact = ["timeslot", "bytes", "packets", "count", "valid",
+                     *fused[name].config.key_cols]
+            assert_same_windows(fused[name].flush(True),
+                                serial[name].flush(True), keys=exact)
+        fa, sa = fused["ddos_alerts"], serial["ddos_alerts"]
+        assert len(fa.alerts) == len(sa.alerts)
+        for x, y in zip(fa.alerts, sa.alerts):
+            for k in x:
+                np.testing.assert_array_equal(np.asarray(x[k]),
+                                              np.asarray(y[k]))
+
+    def test_unsupported_model_set_falls_back(self):
+        class Opaque:
+            def update(self, batch):
+                pass
+
+        assert not FusedPipeline.supported({"x": Opaque()})
+        worker = StreamWorker(None, {"x": Opaque()},
+                              config=WorkerConfig(fused=True))
+        assert worker.fused is None
+
+
+def test_worker_fused_vs_serial_sink_rows():
+    """Integration: the same stream through two workers (fused on/off)
+    lands identical flows_5m rows in the sink."""
+    class CollectSink:
+        def __init__(self):
+            self.rows: dict[str, list] = {}
+
+        def write(self, table, rows):
+            self.rows.setdefault(table, []).append(rows)
+
+    out = {}
+    for fused in (True, False):
+        from flow_pipeline_tpu.schema import wire
+
+        bus = InProcessBus()
+        bus.create_topic("flows", 1)
+        for b in make_stream():
+            for frame in wire.iter_raw_frames(b.to_wire()):
+                bus.produce("flows", frame)
+        sink = CollectSink()
+        worker = StreamWorker(
+            Consumer(bus, fixedlen=True),
+            make_models(WINDOW, 100),
+            [sink],
+            WorkerConfig(poll_max=BS, snapshot_every=0, fused=fused),
+        )
+        assert (worker.fused is not None) == fused
+        worker.run(stop_when_idle=True)
+        rows = [canon_rows(r) for r in sink.rows.get("flows_5m", [])]
+        out[fused] = sorted(sum(rows, []))
+    assert out[True] == out[False]
