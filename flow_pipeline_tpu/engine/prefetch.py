@@ -67,7 +67,13 @@ class PrefetchConsumer:
         """Next prefetched batch, or None when the UNDERLYING consumer is
         idle. Blocks briefly while a fetch is in flight — returning None
         mid-fetch would make stop_when_idle callers quit a non-empty
-        stream just because the thread hadn't finished its first poll."""
+        stream just because the thread hadn't finished its first poll.
+
+        Contract drift from the wrapped consumer: ``max_messages`` applies
+        to FUTURE feed rounds only — up to ``depth`` batches already
+        fetched at the previous size are returned as-is. The worker passes
+        a constant poll_max, so this is benign there; callers that vary
+        the size mid-stream must tolerate a few stale-sized batches."""
         self.poll_max = max_messages  # picked up by the next feed round
         if self._thread is None:
             self._start()

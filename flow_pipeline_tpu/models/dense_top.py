@@ -91,11 +91,20 @@ def dense_update(totals, cols, valid, *, config: DenseTopConfig):
 
 @partial(jax.jit, static_argnames=("config", "k"))
 def dense_top(totals, *, config: DenseTopConfig, k: int):
-    """Rank by plane 0; returns (keys [k], planes [k, P+1, 2], valid [k])."""
+    """Rank by plane 0; returns (keys [k], planes [k, P+1, 2], valid [k]).
+
+    Validity comes from the COUNT plane, not the ranking value: a key
+    observed only through zero-byte flows (count > 0, bytes == 0) is a
+    real row and must not be silently excluded from the top-K output. The
+    ranking carries a count-presence tie-break bit so such keys also
+    outrank never-seen cells (at magnitudes where the bit exceeds float32
+    granularity the tie-break is moot — byte totals dominate)."""
+    seen = (totals[:, -1, 0] + totals[:, -1, 1]) > 0  # count planes >= 0
     rank = (totals[:, 0, 1].astype(jnp.float32) * 65536.0
-            + totals[:, 0, 0].astype(jnp.float32))
-    vals, idx = jax.lax.top_k(rank, k)
-    return idx, totals[idx], vals > 0
+            + totals[:, 0, 0].astype(jnp.float32)) * 2.0 \
+        + seen.astype(jnp.float32)
+    _, idx = jax.lax.top_k(rank, k)
+    return idx, totals[idx], seen[idx]
 
 
 def _planes_to_uint64(planes: np.ndarray) -> np.ndarray:

@@ -35,6 +35,7 @@ def raw_records(batch) -> list[dict]:
     n = len(batch)
     src = np.asarray(c["src_addr"], dtype=np.uint32)
     dst = np.asarray(c["dst_addr"], dtype=np.uint32)
+    smp = np.asarray(c["sampler_address"], dtype=np.uint32)
     out = []
     for i in range(n):
         out.append({
@@ -42,6 +43,7 @@ def raw_records(batch) -> list[dict]:
             "TimeFlowStart": int(c["time_flow_start"][i]),
             "SequenceNum": int(c["sequence_num"][i]),
             "SamplingRate": int(c["sampling_rate"][i]),
+            "SamplerAddress": str(ipaddress.IPv6Address(words_to_addr(smp[i]))),
             "SrcAddr": str(ipaddress.IPv6Address(words_to_addr(src[i]))),
             "DstAddr": str(ipaddress.IPv6Address(words_to_addr(dst[i]))),
             "SrcAS": int(c["src_as"][i]),
@@ -126,30 +128,39 @@ class ClickHouseSink:
         body = "\n".join(json.dumps(r, default=str) for r in records).encode()
         self._post(f"INSERT INTO {table} FORMAT JSONEachRow", body)
 
+    # address columns every archive row ships; each must EXIST (an absent
+    # column 400s JSONEachRow as unknown) and be type IPv6 (older DDLs
+    # used FixedString(16); SamplerAddress is newer than both)
+    _RAW_ADDR_COLS = ("SrcAddr", "DstAddr", "SamplerAddress")
+
     def check_raw_schema(self) -> None:
         """Fail fast with remediation if flows_raw predates the IPv6
-        address columns: CREATE IF NOT EXISTS silently keeps an old
-        FixedString(16) schema, and the first archive insert would then
-        400 and crash-loop the processor with no hint why."""
+        address columns or the SamplerAddress column: CREATE IF NOT
+        EXISTS silently keeps an old schema, and the first archive insert
+        would then 400 and crash-loop the processor with no hint why."""
+        cols = ", ".join(f"'{c}'" for c in self._RAW_ADDR_COLS)
         try:
             out = self._post(
                 "SELECT name, type FROM system.columns "
                 "WHERE database = currentDatabase() AND table = 'flows_raw' "
-                "AND name IN ('SrcAddr', 'DstAddr') FORMAT JSONEachRow"
+                f"AND name IN ({cols}) FORMAT JSONEachRow"
             )
         except (urllib.error.URLError, OSError):
             return  # server unreachable: the insert path will surface it
-        bad = [
-            r["name"]
+        types = {
+            r["name"]: r["type"]
             for r in (json.loads(l) for l in out.decode().splitlines() if l)
-            if r["type"] != "IPv6"
-        ]
+        }
+        # a column that is entirely absent returns no row: presence must
+        # be asserted explicitly, not just the type of what came back
+        bad = [c for c in self._RAW_ADDR_COLS if types.get(c) != "IPv6"]
         if bad:
             raise RuntimeError(
-                f"flows_raw columns {bad} are not type IPv6 (a table created "
-                "by an older DDL?); migrate with e.g. ALTER TABLE flows_raw "
-                "MODIFY COLUMN SrcAddr IPv6, MODIFY COLUMN DstAddr IPv6 "
-                "(or DROP the table) before enabling -archive.raw"
+                f"flows_raw columns {bad} are missing or not type IPv6 (a "
+                "table created by an older DDL?); migrate with e.g. ALTER "
+                "TABLE flows_raw ADD COLUMN IF NOT EXISTS SamplerAddress "
+                "IPv6, MODIFY COLUMN SrcAddr IPv6, MODIFY COLUMN DstAddr "
+                "IPv6 (or DROP the table) before enabling -archive.raw"
             )
 
     def archive_raw(self, batch) -> int:

@@ -111,10 +111,11 @@ CREATE TABLE IF NOT EXISTS ddos_alerts (
 """
 
 # Full-fidelity raw archive (ref: compose/clickhouse/create.sh:36-62).
-# Two deliberate divergences: SrcAddr/DstAddr are the IPv6 domain type
-# (16 bytes on disk, like the reference's FixedString(16)) because rows
-# arrive over JSONEachRow, where raw bytes cannot be round-tripped but
-# IPv6 text can — IPv6NumToString-style queries keep working; and Date is
+# Two deliberate divergences: SrcAddr/DstAddr/SamplerAddress are the IPv6
+# domain type (16 bytes on disk, like the reference's FixedString(16))
+# because rows arrive over JSONEachRow, where raw bytes cannot be
+# round-tripped but IPv6 text can — IPv6NumToString-style queries keep
+# working; and Date is
 # MATERIALIZED server-side from TimeReceived instead of being shipped per
 # row (the reference derives it in its flows_raw_view MV the same way).
 CLICKHOUSE_FLOWS_RAW = """
@@ -124,6 +125,7 @@ CREATE TABLE IF NOT EXISTS flows_raw (
     TimeFlowStart UInt64,
     SequenceNum UInt32,
     SamplingRate UInt64,
+    SamplerAddress IPv6,
     SrcAddr IPv6,
     DstAddr IPv6,
     SrcAS UInt32,

@@ -17,6 +17,22 @@ def traffic(n=6000, seed=21):
 
 
 class TestDenseTopK:
+    def test_zero_byte_flows_stay_valid(self):
+        """A port seen ONLY via zero-byte flows (count > 0) must appear as
+        a valid row: validity derives from the count plane, not from the
+        bytes-based ranking value."""
+        b = FlowBatch.empty(4)
+        b.columns["src_port"][:] = [7, 7, 9, 9]
+        b.columns["bytes"][:] = [0, 0, 500, 100]
+        b.columns["packets"][:] = [1, 1, 1, 1]
+        model = DenseTopKModel(DenseTopConfig(batch_size=4))
+        model.update(b)
+        top = model.top(3)
+        rows = {int(p): (int(c), bool(v)) for p, c, v in
+                zip(top["src_port"], top["count"], top["valid"])}
+        assert rows[9] == (2, True)
+        assert rows[7] == (2, True)  # zero bytes, but two real flows
+
     def test_exact_vs_oracle(self):
         batch = traffic()
         m = DenseTopKModel(DenseTopConfig(key_col="src_port",
