@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.segment import sort_groupby
+from ..utils.shards import local_device_blocks
 from ..schema.batch import FlowBatch, lane_width
 from .oracle import SECONDS_PER_SLOT
 
@@ -138,10 +139,15 @@ class WindowAggregator:
         pending, self._pending_partials = self._pending_partials, []
         for keys, sums, counts, n in pending:
             if keys.ndim == 3:  # stacked per-chip partials (sharded variant)
-                ns = np.asarray(n)
-                keys_np = np.asarray(keys)
-                sums_np = np.asarray(sums)
-                counts_np = np.asarray(counts)
+                # Multi-host: each process can only read ITS devices'
+                # shards, and only needs to — the per-chip partials are
+                # independent, and each host folds its own share into its
+                # window store (partial rows merge downstream by key, the
+                # consumer-group contract; see parallel.multihost).
+                ns = local_device_blocks(n)
+                keys_np = local_device_blocks(keys)
+                sums_np = local_device_blocks(sums)
+                counts_np = local_device_blocks(counts)
                 for d in range(keys_np.shape[0]):
                     self._merge_partials(keys_np[d], sums_np[d],
                                          counts_np[d], int(ns[d]))

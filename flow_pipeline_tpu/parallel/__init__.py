@@ -26,7 +26,7 @@ from .sharded import (
     sharded_hh_update,
     sharded_hh_merge,
 )
-from .multihost import init_distributed, LocalShardFeeder
+from .multihost import init_distributed, LocalShardFeeder, MultihostPipeline
 
 __all__ = [
     "make_mesh",
@@ -39,4 +39,5 @@ __all__ = [
     "sharded_hh_merge",
     "init_distributed",
     "LocalShardFeeder",
+    "MultihostPipeline",
 ]

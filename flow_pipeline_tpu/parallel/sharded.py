@@ -18,6 +18,7 @@ from __future__ import annotations
 import functools
 from dataclasses import dataclass
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -146,6 +147,25 @@ class ShardedHeavyHitter:
     def merged_state(self) -> hh.HHState:
         return self._merge(self.state)
 
+    def local_state(self) -> dict[str, np.ndarray]:
+        """This process's device shards of the stacked state, as numpy —
+        the multi-host checkpoint unit (np.asarray on the full sharded
+        state would fail: no process addresses every shard)."""
+        from ..utils.shards import local_device_blocks
+
+        return {f: local_device_blocks(getattr(self.state, f))
+                for f in hh.HHState._fields}
+
+    def load_local_state(self, local: dict[str, np.ndarray]) -> None:
+        """Rebuild the global sharded state from per-process local shards
+        (each process passes what ITS local_state() returned)."""
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        self.state = hh.HHState(**{
+            f: jax.make_array_from_process_local_data(
+                sharding, np.asarray(local[f]))
+            for f in hh.HHState._fields
+        })
+
     def top(self, k: int | None = None) -> dict[str, np.ndarray]:
         merged = self.merged_state()
         single = hh.HeavyHitterModel.__new__(hh.HeavyHitterModel)
@@ -230,9 +250,17 @@ class ShardedWindowAggregator(WindowAggregator):
         )
         cols, valid = shard_batch_columns(self.mesh, cols, mask)
         # stacked partials stay on device until a flush drains them
-        self._pending_partials.append(self._sharded(cols, valid))
-        if len(self._pending_partials) >= 32:  # bound device-memory pinning
-            self._drain()
+        self.add_partial(self._sharded(cols, valid))
+
+    def update_device_columns(self, cols, valid,
+                              watermark: Optional[int] = None) -> None:
+        """Update from already-placed global arrays of exactly global_batch
+        rows (multi-host feed path; see ShardedHeavyHitter). The caller
+        supplies the batch watermark — the host only sees its own rows, so
+        max(time_received) must come from the feed layer."""
+        self.add_partial(self._sharded(cols, valid))
+        if watermark is not None and watermark > self.watermark:
+            self.watermark = watermark
 
 
 # ---------------------------------------------------------------------------

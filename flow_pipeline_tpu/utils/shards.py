@@ -1,0 +1,20 @@
+"""Multi-host array addressability helpers (dependency-free leaf module:
+both the models and parallel layers use these without importing each
+other)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def local_device_blocks(arr) -> np.ndarray:
+    """Device-axis blocks of ``arr`` this PROCESS can address, stacked in
+    device order. Fully-addressable arrays (single host) come back whole;
+    multi-host arrays sharded on axis 0 yield only the local shards —
+    np.asarray on the full array would fail, since no process addresses
+    every shard."""
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    shards = sorted(arr.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)

@@ -107,6 +107,203 @@ WORKER_SCRIPT = textwrap.dedent("""
 """)
 
 
+E2E_SCRIPT = textwrap.dedent("""
+    import json, os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    sys.path.insert(0, {repo!r})
+    from flow_pipeline_tpu.utils.platform import force_cpu
+    force_cpu()
+    import jax
+    import numpy as np
+    from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+    from flow_pipeline_tpu.models import HeavyHitterConfig, WindowAggConfig
+    from flow_pipeline_tpu.parallel import make_mesh
+    from flow_pipeline_tpu.parallel.multihost import (
+        MultihostPipeline, init_distributed)
+
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    phase = sys.argv[3]; ckpt = sys.argv[4]; outdir = sys.argv[5]
+    init_distributed(f"127.0.0.1:{{port}}", 2, pid)
+    mesh = make_mesh()  # 4 devices = 2 local x 2 processes
+    PER_CHIP, N_BATCHES = 128, 8
+    GLOBAL, HALF = PER_CHIP * 4, PER_CHIP * 2
+
+    pipe = MultihostPipeline(
+        mesh,
+        WindowAggConfig(batch_size=PER_CHIP),
+        {{"top_pairs": HeavyHitterConfig(
+            key_cols=("src_addr", "dst_addr"), batch_size=PER_CHIP,
+            width=1 << 10, capacity=64)}},
+        k=20,
+    )
+    start = 0
+    if phase == "resume":
+        start = pipe.restore(os.path.join(ckpt, str(pid)))
+        assert start == 5, start  # batch 5 was processed but unsnapshotted
+
+    # both processes derive the identical global stream (seeded); each
+    # consumes its own contiguous half — the consumer-group partition split
+    gen = FlowGenerator(ZipfProfile(n_keys=30, alpha=1.4), seed=5, t0=9000)
+    batches = [gen.batch(GLOBAL) for _ in range(N_BATCHES)]
+    COLS = ("time_received", "src_as", "dst_as", "etype", "bytes",
+            "packets", "src_addr", "dst_addr")
+    mine = slice(pid * HALF, (pid + 1) * HALF)
+    for i in range(start, N_BATCHES):
+        cols = batches[i].device_columns(COLS)
+        local = {{k: np.ascontiguousarray(np.asarray(v)[mine])
+                 for k, v in cols.items()}}
+        wm = int(batches[i].columns["time_received"].max())
+        pipe.update(local, np.ones(HALF, bool), wm)
+        if phase == "first":
+            if i == 4:
+                pipe.snapshot(os.path.join(ckpt, str(pid)))
+                # barrier: both snapshots must be durable before either
+                # process may crash (the hot path has NO collectives, so
+                # the processes are otherwise free-running)
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices("snapshot-durable")
+                print("SNAPSHOT_DONE", pid, flush=True)
+            if i == 5:  # crash mid-window, AFTER an unsnapshotted batch
+                print("KILLED", pid, flush=True)
+                os._exit(0)
+
+    rows = pipe.flush(force=True)
+    f5 = rows["flows_5m"]
+    with open(os.path.join(outdir, f"flows5m_{{pid}}.json"), "w") as f:
+        json.dump({{k: np.asarray(v).tolist() for k, v in f5.items()}}, f)
+    if pid == 0:  # replicated merged top-K: identical on both, write once
+        top = rows["top_pairs"]
+        with open(os.path.join(outdir, "top.json"), "w") as f:
+            json.dump({{k: np.asarray(v).tolist() for k, v in top.items()}},
+                      f)
+    print("MULTIHOST_E2E_OK", pid, flush=True)
+""")
+
+
+def _run_pair(script, phase, ckpt, outdir, port, expect_crash=False):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = ""
+    env["PYTHONNOUSERSITE"] = "1"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(port), phase,
+             str(ckpt), str(outdir)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"{phase} worker timed out")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        if expect_crash:
+            # one process os._exit()s first and the other may be torn
+            # down by coordinator loss — nonzero exits are the scenario;
+            # what matters is that both passed the snapshot barrier
+            assert f"SNAPSHOT_DONE {pid}" in out, \
+                f"{phase} worker {pid} never snapshotted:\n{out}"
+        else:
+            assert p.returncode == 0, f"{phase} worker {pid} failed:\n{out}"
+    return outs
+
+
+class TestTwoProcessWorkerE2E:
+    """VERDICT r2 #4: the FULL loop across 2 jax.distributed processes —
+    per-host feed, sharded exact + sketch models, cross-process window
+    merge, host-partial emission, and a kill-and-resume mid-window with an
+    unsnapshotted batch that must replay exactly once."""
+
+    def test_kill_resume_oracle_exact(self, tmp_path):
+        script = tmp_path / "worker_e2e.py"
+        script.write_text(E2E_SCRIPT.format(repo=os.path.abspath(REPO)))
+        ckpt = tmp_path / "ckpt"
+        outdir = tmp_path / "out"
+        ckpt.mkdir()
+        outdir.mkdir()
+
+        outs = _run_pair(script, "first", ckpt, outdir, _free_port(),
+                         expect_crash=True)
+        assert any(f"KILLED {pid}" in out
+                   for pid, out in enumerate(outs))
+        assert (ckpt / "0").is_dir() and (ckpt / "1").is_dir()
+        assert not list(outdir.iterdir())  # crashed before any emission
+
+        outs = _run_pair(script, "resume", ckpt, outdir, _free_port())
+        for pid, out in enumerate(outs):
+            assert f"MULTIHOST_E2E_OK {pid}" in out
+
+        import json
+
+        from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+        from flow_pipeline_tpu.models.oracle import exact_groupby
+        from flow_pipeline_tpu.schema.batch import FlowBatch
+
+        gen = FlowGenerator(ZipfProfile(n_keys=30, alpha=1.4), seed=5,
+                            t0=9000)
+        full = FlowBatch.concat([gen.batch(512) for _ in range(8)])
+
+        # flows_5m: host-partial rows from BOTH processes, merged by key,
+        # must equal the exact oracle over the whole stream — no row lost
+        # to the crash, none double-counted by the replay
+        merged: dict[tuple, np.ndarray] = {}
+        for pid in (0, 1):
+            rows = json.loads((outdir / f"flows5m_{pid}.json").read_text())
+            for i in range(len(rows["timeslot"])):
+                key = (rows["timeslot"][i], rows["src_as"][i],
+                       rows["dst_as"][i], rows["etype"][i])
+                acc = merged.setdefault(key, np.zeros(3, np.uint64))
+                acc += np.array([rows["bytes"][i], rows["packets"][i],
+                                 rows["count"][i]], np.uint64)
+        oracle = exact_groupby(full, ["src_as", "dst_as", "etype"],
+                               timeslot=True)
+        want = {
+            (int(oracle["timeslot"][i]), int(oracle["src_as"][i]),
+             int(oracle["dst_as"][i]), int(oracle["etype"][i])):
+            (int(oracle["bytes"][i]), int(oracle["packets"][i]),
+             int(oracle["count"][i]))
+            for i in range(len(oracle["timeslot"]))
+        }
+        got = {k: tuple(int(x) for x in v) for k, v in merged.items()}
+        assert got == want
+        assert sum(v[2] for v in got.values()) == len(full)
+
+        # top-K: the replicated cross-process merge must carry exact
+        # per-key table sums (capacity 64 > 30 keys: nothing evicted)
+        top = json.loads((outdir / "top.json").read_text())
+        got_top = {}
+        for i in range(len(top["valid"])):
+            if not top["valid"][i]:
+                continue
+            key = (tuple(top["src_addr"][i]), tuple(top["dst_addr"][i]))
+            got_top[key] = (int(top["bytes"][i]), int(top["packets"][i]),
+                            int(top["count"][i]))
+        pairs = exact_groupby(full, ["src_addr", "dst_addr"])
+        src = np.asarray(pairs["src_addr"]).reshape(len(pairs["bytes"]), -1)
+        dst = np.asarray(pairs["dst_addr"]).reshape(len(pairs["bytes"]), -1)
+        want_top = {
+            (tuple(int(x) for x in src[i]), tuple(int(x) for x in dst[i])):
+            (int(pairs["bytes"][i]), int(pairs["packets"][i]),
+             int(pairs["count"][i]))
+            for i in range(len(pairs["bytes"]))
+        }
+        # the emitted top-20 rows must each match the oracle exactly, and
+        # the oracle's 20 heaviest pairs must all be present
+        for key, vals in got_top.items():
+            assert want_top[key] == vals
+        heaviest = sorted(want_top, key=lambda k: -want_top[k][0])[:20]
+        assert set(heaviest) == set(got_top)
+
+
 class TestTwoProcessDistributed:
     def test_bootstrap_feed_and_collective(self, tmp_path):
         port = _free_port()
