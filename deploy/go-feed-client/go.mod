@@ -1,0 +1,5 @@
+module flowtpu/feedclient
+
+go 1.22
+
+require google.golang.org/grpc v1.65.0

@@ -1,0 +1,239 @@
+// Feed client: the Go side of the processor seam.
+//
+// The reference architecture reserves a processor slot between its Go
+// collector ecosystem and the database (ref: README.md:44-47); this
+// framework fills that slot with a TPU worker fed over gRPC
+// (flow_pipeline_tpu/transport/feed.py). This program is the seam's Go
+// end: it speaks the documented raw-bytes contract —
+//
+//	method:   /flowtpu.Feed/Publish (unary)
+//	request:  concatenated length-prefixed FlowMessage frames
+//	          (varint length + protobuf body, the -proto.fixedlen format)
+//	response: 8-byte big-endian count of frames accepted
+//
+// Frames come from either stdin (-stdin: forward a pre-framed stream a
+// GoFlow-style producer already emits) or a built-in generator that
+// hand-encodes FlowMessage protobufs (field numbers from
+// schema/flow.proto — the wire contract shared with the reference's
+// pb-ext/flow.proto). No protoc codegen is needed on either side.
+//
+// Exercised in CI (services-integration job) against the Python
+// FeedServer end-to-end: generate -> Publish -> worker -> sink.
+package main
+
+import (
+	"context"
+	"encoding/binary"
+	"flag"
+	"fmt"
+	"io"
+	"log"
+	"os"
+	"time"
+
+	"google.golang.org/grpc"
+	"google.golang.org/grpc/credentials/insecure"
+)
+
+const publishMethod = "/flowtpu.Feed/Publish"
+
+// rawCodec passes request/response bytes through untouched — the feed
+// contract is already-encoded frames, so no message marshalling exists.
+type rawCodec struct{}
+
+func (rawCodec) Marshal(v interface{}) ([]byte, error) {
+	b, ok := v.([]byte)
+	if !ok {
+		return nil, fmt.Errorf("rawCodec: expected []byte, got %T", v)
+	}
+	return b, nil
+}
+
+func (rawCodec) Unmarshal(data []byte, v interface{}) error {
+	p, ok := v.(*[]byte)
+	if !ok {
+		return fmt.Errorf("rawCodec: expected *[]byte, got %T", v)
+	}
+	*p = data
+	return nil
+}
+
+func (rawCodec) Name() string { return "raw" }
+
+// --- minimal protobuf writer (only what FlowMessage needs) ---------------
+
+func putUvarint(b []byte, v uint64) []byte {
+	return binary.AppendUvarint(b, v)
+}
+
+func varintField(b []byte, field int, v uint64) []byte {
+	if v == 0 {
+		return b // proto3: zero values are omitted
+	}
+	b = putUvarint(b, uint64(field)<<3|0) // wire type 0
+	return putUvarint(b, v)
+}
+
+func bytesField(b []byte, field int, v []byte) []byte {
+	if len(v) == 0 {
+		return b
+	}
+	b = putUvarint(b, uint64(field)<<3|2) // wire type 2
+	b = putUvarint(b, uint64(len(v)))
+	return append(b, v...)
+}
+
+// Field numbers are the wire contract (schema/flow.proto; matches the
+// reference's pb-ext/flow.proto). Do not renumber.
+type flowMessage struct {
+	typ          uint64 // 1
+	timeReceived uint64 // 2
+	samplingRate uint64 // 3
+	sequenceNum  uint64 // 4
+	srcAddr      []byte // 6 (16 bytes)
+	dstAddr      []byte // 7
+	bytes_       uint64 // 9
+	packets      uint64 // 10
+	srcAS        uint64 // 14
+	dstAS        uint64 // 15
+	proto        uint64 // 20
+	srcPort      uint64 // 21
+	dstPort      uint64 // 22
+	etype        uint64 // 30
+	timeFlowSt   uint64 // 38
+}
+
+func (m *flowMessage) encode() []byte {
+	b := make([]byte, 0, 96)
+	b = varintField(b, 1, m.typ)
+	b = varintField(b, 2, m.timeReceived)
+	b = varintField(b, 3, m.samplingRate)
+	b = varintField(b, 4, m.sequenceNum)
+	b = bytesField(b, 6, m.srcAddr)
+	b = bytesField(b, 7, m.dstAddr)
+	b = varintField(b, 9, m.bytes_)
+	b = varintField(b, 10, m.packets)
+	b = varintField(b, 14, m.srcAS)
+	b = varintField(b, 15, m.dstAS)
+	b = varintField(b, 20, m.proto)
+	b = varintField(b, 21, m.srcPort)
+	b = varintField(b, 22, m.dstPort)
+	b = varintField(b, 30, m.etype)
+	b = varintField(b, 38, m.timeFlowSt)
+	return b
+}
+
+func frame(body []byte) []byte {
+	out := make([]byte, 0, len(body)+2)
+	out = putUvarint(out, uint64(len(body)))
+	return append(out, body...)
+}
+
+// mockFlows mirrors the reference mocker's shape (AS 65000/65001, IPv6
+// documentation prefix, EType 0x86dd — ref: mocker/mocker.go) so the
+// downstream tables carry recognizable values the CI can assert on.
+func mockFlows(n, seqBase int, now uint64) []byte {
+	out := make([]byte, 0, n*64)
+	addr := func(last byte) []byte {
+		a := make([]byte, 16)
+		a[0], a[1] = 0x20, 0x01 // 2001:db8::/112 mock range
+		a[2], a[3] = 0x0d, 0xb8
+		a[15] = last
+		return a
+	}
+	for i := 0; i < n; i++ {
+		m := flowMessage{
+			typ:          1, // SFLOW_5
+			timeReceived: now,
+			samplingRate: 1,
+			sequenceNum:  uint64(seqBase + i),
+			srcAddr:      addr(byte(i % 250)),
+			dstAddr:      addr(byte((i + 1) % 250)),
+			bytes_:       uint64(100 + i%1400),
+			packets:      uint64(1 + i%10),
+			srcAS:        uint64(65000 + i%2),
+			dstAS:        uint64(65000 + (i+1)%2),
+			proto:        6,
+			srcPort:      uint64(1024 + i%1000),
+			dstPort:      443,
+			etype:        0x86dd,
+			timeFlowSt:   now,
+		}
+		out = append(out, frame(m.encode())...)
+	}
+	return out
+}
+
+func main() {
+	addr := flag.String("addr", "127.0.0.1:8081", "FeedServer host:port")
+	count := flag.Int("count", 10000, "synthetic flows to publish")
+	batch := flag.Int("batch", 2000, "frames per Publish call")
+	stdin := flag.Bool("stdin", false,
+		"forward a pre-framed stream from stdin instead of generating")
+	flag.Parse()
+	if *batch <= 0 {
+		log.Fatalf("-batch must be positive, got %d", *batch)
+	}
+
+	conn, err := grpc.NewClient(
+		*addr,
+		grpc.WithTransportCredentials(insecure.NewCredentials()),
+		grpc.WithDefaultCallOptions(grpc.ForceCodec(rawCodec{})),
+	)
+	if err != nil {
+		log.Fatalf("dial %s: %v", *addr, err)
+	}
+	defer conn.Close()
+
+	publish := func(blob []byte) uint64 {
+		var resp []byte
+		ctx, cancel := context.WithTimeout(context.Background(),
+			30*time.Second)
+		defer cancel()
+		if err := conn.Invoke(ctx, publishMethod, blob, &resp); err != nil {
+			log.Fatalf("publish: %v", err)
+		}
+		if len(resp) != 8 {
+			log.Fatalf("publish: want 8-byte count, got %d bytes", len(resp))
+		}
+		return binary.BigEndian.Uint64(resp)
+	}
+
+	var accepted uint64
+	if *stdin {
+		blob, err := io.ReadAll(os.Stdin)
+		if err != nil {
+			log.Fatalf("stdin: %v", err)
+		}
+		// chunk at frame boundaries: a unary Publish must stay under
+		// gRPC's default 4 MiB receive limit on the server side, and a
+		// split mid-frame would be rejected as a malformed stream
+		const chunkBudget = 2 << 20
+		start, pos := 0, 0
+		for pos < len(blob) {
+			frameLen, n := binary.Uvarint(blob[pos:])
+			if n <= 0 || pos+n+int(frameLen) > len(blob) {
+				log.Fatalf("stdin: malformed frame at byte %d", pos)
+			}
+			next := pos + n + int(frameLen)
+			if next-start > chunkBudget && start < pos {
+				accepted += publish(blob[start:pos])
+				start = pos
+			}
+			pos = next
+		}
+		if start < len(blob) {
+			accepted += publish(blob[start:])
+		}
+	} else {
+		now := uint64(time.Now().Unix())
+		for sent := 0; sent < *count; sent += *batch {
+			n := *batch
+			if *count-sent < n {
+				n = *count - sent
+			}
+			accepted += publish(mockFlows(n, sent, now))
+		}
+	}
+	fmt.Printf("accepted=%d\n", accepted)
+}

@@ -60,7 +60,9 @@ log = get_logger("fused")
 # order rides the single master sort; extending the tuple here (and in
 # _hh_plan) is all it takes to admit more families.
 MASTER_KEY = ("src_addr", "dst_addr", "src_port", "dst_port", "proto")
-_SENTINEL = jnp.uint32(0xFFFFFFFF)
+# numpy (not jnp): a module-level jnp constant would initialize the JAX
+# backend at import time — importing the engine must never claim a chip
+_SENTINEL = np.uint32(0xFFFFFFFF)
 
 
 def _hh_plan(cfg) -> tuple:

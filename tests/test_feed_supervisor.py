@@ -92,6 +92,85 @@ class TestFeed:
             server.stop()
 
 
+def _go_varint(out: bytearray, v: int) -> None:
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _go_mock_frames(n: int, seq_base: int, now: int) -> bytes:
+    """Byte-for-byte replica of deploy/go-feed-client's mockFlows(): same
+    field order, same proto3 zero-omission, same varint length framing.
+    Keeping this in lockstep with main.go makes the Go client's exact
+    byte stream a tested input even though the dev image has no Go
+    toolchain (CI builds and runs the real binary)."""
+    def addr(last: int) -> bytes:
+        a = bytearray(16)
+        a[0:4] = bytes((0x20, 0x01, 0x0D, 0xB8))
+        a[15] = last
+        return bytes(a)
+
+    out = bytearray()
+    for i in range(n):
+        body = bytearray()
+        for field, val in ((1, 1), (2, now), (3, 1), (4, seq_base + i)):
+            if val:
+                _go_varint(body, field << 3 | 0)
+                _go_varint(body, val)
+        for field, val in ((6, addr(i % 250)), (7, addr((i + 1) % 250))):
+            _go_varint(body, field << 3 | 2)
+            _go_varint(body, len(val))
+            body += val
+        for field, val in ((9, 100 + i % 1400), (10, 1 + i % 10),
+                           (14, 65000 + i % 2), (15, 65000 + (i + 1) % 2),
+                           (20, 6), (21, 1024 + i % 1000), (22, 443),
+                           (30, 0x86DD), (38, now)):
+            if val:
+                _go_varint(body, field << 3 | 0)
+                _go_varint(body, val)
+        _go_varint(out, len(body))
+        out += body
+    return bytes(out)
+
+
+class TestGoClientByteContract:
+    """The exact byte stream deploy/go-feed-client emits, pushed through
+    the live FeedServer and decoded by the normal consumer path."""
+
+    def test_go_encoded_frames_roundtrip(self):
+        bus = InProcessBus()
+        server = feed.FeedServer(bus, address="127.0.0.1:0").start()
+        client = feed.FeedClient(f"127.0.0.1:{server.port}")
+        try:
+            blob = _go_mock_frames(500, seq_base=100, now=1_700_000_000)
+            assert client.publish_frames(blob) == 500
+        finally:
+            client.close()
+            server.stop()
+        from flow_pipeline_tpu.schema.batch import FlowBatch
+
+        consumer = Consumer(bus, fixedlen=True)
+        parts = []
+        while (b := consumer.poll(1000)) is not None:
+            parts.append(b)  # one partition per poll
+        got = FlowBatch.concat(parts)
+        assert len(got) == 500
+        c = got.columns
+        np.testing.assert_array_equal(
+            np.sort(c["sequence_num"]), np.arange(100, 600, dtype=np.uint32))
+        assert set(c["src_as"].tolist()) == {65000, 65001}
+        assert set(c["etype"].tolist()) == {0x86DD}
+        assert set(c["dst_port"].tolist()) == {443}
+        assert c["bytes"].min() >= 100 and c["packets"].max() <= 10
+        # the 2001:db8:: mock prefix survives the 4-word address packing
+        assert (c["src_addr"][:, 0] == 0x20010DB8).all()
+
+
 class TestSupervisor:
     def test_restarts_until_success(self):
         attempts = []

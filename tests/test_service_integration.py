@@ -37,6 +37,11 @@ CH = os.environ.get("FLOWTPU_CLICKHOUSE")
 needs_kafka = pytest.mark.skipif(not KAFKA, reason="FLOWTPU_KAFKA not set")
 needs_pg = pytest.mark.skipif(not PG, reason="FLOWTPU_POSTGRES not set")
 needs_ch = pytest.mark.skipif(not CH, reason="FLOWTPU_CLICKHOUSE not set")
+# path to the BUILT go feed client binary (deploy/go-feed-client); CI's
+# services job builds it with setup-go — there is no Go toolchain in the
+# dev image, so the Go side of the seam is proven in CI
+GO_FEED = os.environ.get("FLOWTPU_GO_FEED")
+needs_go = pytest.mark.skipif(not GO_FEED, reason="FLOWTPU_GO_FEED not set")
 
 
 def gen_batch(n, seed=7):
@@ -299,3 +304,66 @@ class TestClickHouseSink:
         worker.run(stop_when_idle=True)
         after = int(self.query("SELECT count() FROM flows_raw"))
         assert after - before == 1500
+
+
+@needs_go
+class TestGoFeedClient:
+    """The Go side of the processor seam (ref: README.md:44-47 reserves
+    the processor slot): the built deploy/go-feed-client binary publishes
+    hand-encoded FlowMessage frames over the raw-codec gRPC contract, and
+    the normal FeedServer -> bus -> worker -> sink loop must account for
+    every flow with the mocker-shaped values intact."""
+
+    def test_go_publish_through_worker_to_sink(self, tmp_path):
+        import sqlite3
+        import subprocess
+
+        from flow_pipeline_tpu.engine import StreamWorker, WorkerConfig
+        from flow_pipeline_tpu.models import HeavyHitterConfig
+        from flow_pipeline_tpu.engine.windowed import WindowedHeavyHitter
+        from flow_pipeline_tpu.sink import SQLiteSink
+        from flow_pipeline_tpu.transport import Consumer, InProcessBus
+        from flow_pipeline_tpu.transport.feed import FeedServer, available
+
+        if not available():
+            pytest.skip("grpcio not importable")
+        bus = InProcessBus()
+        server = FeedServer(bus, address="127.0.0.1:0").start()
+        try:
+            n = 20000
+            out = subprocess.run(
+                [GO_FEED, "-addr", f"127.0.0.1:{server.port}",
+                 "-count", str(n), "-batch", "4096"],
+                capture_output=True, text=True, timeout=120,
+            )
+            assert out.returncode == 0, out.stderr
+            assert f"accepted={n}" in out.stdout
+        finally:
+            server.stop()
+
+        db = str(tmp_path / "go.db")
+        worker = StreamWorker(
+            Consumer(bus, fixedlen=True),
+            {"flows_5m": WindowAggregator(WindowAggConfig(batch_size=4096)),
+             "top_talkers": WindowedHeavyHitter(HeavyHitterConfig(
+                 key_cols=("src_addr", "dst_addr", "src_port", "dst_port",
+                           "proto"), batch_size=4096, width=1 << 12,
+                 capacity=256), k=50)},
+            [SQLiteSink(db)],
+            WorkerConfig(poll_max=4096, snapshot_every=0),
+        )
+        worker.run(stop_when_idle=True)
+        assert worker.flows_seen == n
+
+        con = sqlite3.connect(db)
+        total = con.execute("SELECT SUM(count) FROM flows_5m").fetchone()[0]
+        assert total == n  # every Go-published flow accounted exactly once
+        ases = {r[0] for r in con.execute(
+            "SELECT DISTINCT src_as FROM flows_5m")}
+        assert ases == {65000, 65001}  # mocker-parity values survived
+        etypes = {r[0] for r in con.execute(
+            "SELECT DISTINCT etype FROM flows_5m")}
+        assert etypes == {0x86DD}
+        talkers = con.execute(
+            "SELECT COUNT(*) FROM top_talkers").fetchone()[0]
+        assert talkers > 0
