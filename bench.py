@@ -517,6 +517,84 @@ def bench_sharded(n_devices: int = 8) -> None:
         "n_devices": n_devices,
         "platform": _PLATFORM,
     }))
+    _bench_sharded_exact_merge(mesh, n_devices, PER_CHIP)
+
+
+def _bench_sharded_exact_merge(mesh, n_devices: int, per_chip: int) -> None:
+    """Exact-aggregator host-merge cost on the mesh (VERDICT r2 #6): the
+    sharded window-agg defers stacked per-chip partials and folds them
+    into host dicts every DRAIN_PENDING_MAX chunks — this prints the
+    device step rate, the host fold cost per chunk, the fold's share of
+    total step time, and the per-chunk fold cost at threshold 1 vs the
+    default (is deferral buying anything?)."""
+    import numpy as np
+
+    import jax
+
+    from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+    from flow_pipeline_tpu.models.window_agg import (
+        DRAIN_PENDING_MAX,
+        WindowAggConfig,
+    )
+    from flow_pipeline_tpu.parallel import shard_batch_columns
+    from flow_pipeline_tpu.parallel.sharded import ShardedWindowAggregator
+
+    cfg = WindowAggConfig(batch_size=per_chip)
+    gen = FlowGenerator(ZipfProfile(n_keys=100_000, alpha=1.1), seed=2)
+    global_batch = per_chip * n_devices
+    staged = []
+    for _ in range(4):
+        b = gen.batch(global_batch)
+        cols = b.device_columns(
+            ["time_received", *cfg.key_cols, *cfg.value_cols])
+        staged.append(shard_batch_columns(
+            mesh, {k: np.asarray(v) for k, v in cols.items()},
+            np.ones(global_batch, bool)))
+
+    def run(threshold: int, chunks: int):
+        """Returns (update_s, drain_s) for `chunks` chunks at the given
+        drain threshold. Partials are queued manually (bypassing
+        add_partial's own auto-drain) so the threshold under test is the
+        only drain policy in effect."""
+        agg = ShardedWindowAggregator(cfg, mesh)
+        part = agg._sharded(*staged[0])  # warm/compile
+        jax.block_until_ready(part[0])
+        agg._pending_partials.append(part)
+        agg._drain()
+        t_update = t_drain = 0.0
+        for i in range(chunks):
+            t0 = time.perf_counter()
+            part = agg._sharded(*staged[i % len(staged)])
+            jax.block_until_ready(part[0])
+            t_update += time.perf_counter() - t0
+            agg._pending_partials.append(part)
+            if len(agg._pending_partials) >= threshold:
+                t0 = time.perf_counter()
+                agg._drain()
+                t_drain += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        agg._drain()
+        t_drain += time.perf_counter() - t0
+        return t_update, t_drain
+
+    run(DRAIN_PENDING_MAX, 8)  # warm every path incl. the host fold
+    chunks = 2 * DRAIN_PENDING_MAX
+    upd, drain = run(DRAIN_PENDING_MAX, chunks)
+    upd1, drain1 = run(1, chunks)
+    rate = chunks * global_batch / (upd + drain)
+    print(json.dumps({
+        "metric": f"sharded exact-agg (flows_5m) on {n_devices}-device mesh",
+        "unit": "flows/sec",
+        "value": round(rate, 1),
+        "host_merge_us_per_chunk": round(drain / chunks * 1e6, 1),
+        "host_merge_share_pct": round(100 * drain / (upd + drain), 1),
+        "drain_threshold": DRAIN_PENDING_MAX,
+        "merge_us_per_chunk_at_threshold_1": round(drain1 / chunks * 1e6, 1),
+        "rate_at_threshold_1": round(
+            chunks * global_batch / (upd1 + drain1), 1),
+        "n_devices": n_devices,
+        "platform": _PLATFORM,
+    }))
 
 
 if __name__ == "__main__":

@@ -212,7 +212,10 @@ func main() {
 		start, pos := 0, 0
 		for pos < len(blob) {
 			frameLen, n := binary.Uvarint(blob[pos:])
-			if n <= 0 || pos+n+int(frameLen) > len(blob) {
+			// compare in uint64 BEFORE any int conversion: a hostile
+			// varint length >= 2^63 would wrap negative and slip past
+			// an int-domain bounds check into a slice panic
+			if n <= 0 || frameLen > uint64(len(blob)-pos-n) {
 				log.Fatalf("stdin: malformed frame at byte %d", pos)
 			}
 			next := pos + n + int(frameLen)

