@@ -32,7 +32,7 @@ import numpy as np
 
 from ..ops import cms as cms_ops
 from ..ops import topk as topk_ops
-from ..ops.segment import sort_groupby_float
+from ..ops.segment import hash_groupby_float
 from ..schema.batch import FlowBatch, lane_width
 
 
@@ -157,7 +157,12 @@ def hh_update(state: HHState, cols: dict, valid, *, config: HeavyHitterConfig) -
         + [jnp.ones(keys.shape[0], jnp.float32)],
         axis=1,
     )
-    uniq, sums, counts = sort_groupby_float(keys, values, valid)
+    # Hash-grouped pre-agg: sorting the 64-bit key hash (2 lanes) instead
+    # of the raw 4-11 key lanes cuts the dominant sort cost 2-4x; two
+    # distinct tuples colliding in the full hash (~n^2/2^65 per batch)
+    # merge into one candidate — the same bounded failure mode the CMS
+    # planes already have by design (ops.segment.hash_groupby_float).
+    uniq, sums, counts = hash_groupby_float(keys, values, valid)
     return _apply_grouped(state, uniq, sums, counts > 0, config)
 
 
