@@ -7,14 +7,16 @@ ClickHouse rollup chain makes ONE pass over the raw rows per ingest and
 fans the materialized views out from it (ref: compose/clickhouse/
 create.sh:92-110). This module is the TPU-first equivalent:
 
-- ONE lexicographic master sort on (src_addr, dst_addr, src_port,
-  dst_port, proto) serves every model whose key is a PREFIX of that
-  ordering (5-tuple top-talkers, src-pair, src-address): rows sorted by
-  the full key are already grouped by each prefix, so those models need
-  only the cheap boundary-detect + segment-sum half of the groupby
-  (ops.segment.presorted_groupby_float).
-- ONE dst-keyed sort serves BOTH the top-dst-IP sketch and the DDoS
-  per-dst accumulate (they want the same per-dst sums).
+- Each heavy-hitter key family gets a HASH-grouped pre-agg
+  (ops.segment.hash_groupby_float): the sort runs over the 64-bit key
+  hash (2 lanes) instead of the raw 4-11 key lanes, which beats the
+  previous shared 10-lane master sort even though families no longer
+  share a sort — lax.sort cost scales with operand count, and three
+  2-lane sorts are cheaper than one 10-lane sort plus a 4-lane dst
+  sort.
+- The dst-keyed hash sort is still shared between the top-dst-IP
+  sketch and the DDoS per-dst accumulate (they want the same per-dst
+  groups under different row masks).
 - The flows_5m exact groupby, the dense port scatters, and all sketch
   table merges run in the SAME jitted step, so the worker makes one
   device dispatch per chunk and every column crosses the host boundary
@@ -36,7 +38,6 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 from ..models import heavy_hitter as hh
 from ..models.ddos import DDoSDetector, _accumulate_grouped
@@ -45,21 +46,16 @@ from ..models.heavy_hitter import HeavyHitterModel
 from ..models.window_agg import WindowAggregator
 from ..models.window_agg import _cached_update as _cached_wagg_update
 from ..obs import get_logger
-from ..schema.batch import FlowBatch, lane_width
+from ..schema.batch import FlowBatch
 from ..ops.segment import (
-    presorted_groupby_float,
+    hash_groupby_float,
+    hash_sort,
     presorted_segments,
-    sort_groupby_float,
-    sort_rows_float,
 )
 from .windowed import WindowedHeavyHitter
 
 log = get_logger("fused")
 
-# The master sort ordering. Any hh key that is a prefix of this column
-# order rides the single master sort; extending the tuple here (and in
-# _hh_plan) is all it takes to admit more families.
-MASTER_KEY = ("src_addr", "dst_addr", "src_port", "dst_port", "proto")
 # numpy (not jnp): a module-level jnp constant would initialize the JAX
 # backend at import time — importing the engine must never claim a chip
 _SENTINEL = np.uint32(0xFFFFFFFF)
@@ -67,20 +63,17 @@ _SENTINEL = np.uint32(0xFFFFFFFF)
 
 def _hh_plan(cfg) -> tuple:
     """How a heavy-hitter config's pre-agg is computed inside the fused
-    step: ("A", lane_width) = prefix of the master sort; ("B",) = the
-    shared dst-keyed sort; ("own",) = its own sort_groupby_float (still
-    inside the fused dispatch, just not shared)."""
-    if tuple(cfg.value_cols) != ("bytes", "packets"):
-        return ("own",)
-    if cfg.key_cols == MASTER_KEY[: len(cfg.key_cols)]:
-        return ("A", sum(lane_width(c) for c in cfg.key_cols))
-    if cfg.key_cols == ("dst_addr",):
+    step: ("B",) = the shared dst-keyed hash sort (dual-masked with the
+    DDoS accumulate); ("own",) = its own hash_groupby_float (still inside
+    the fused dispatch, just not shared)."""
+    if tuple(cfg.value_cols) == ("bytes", "packets") and \
+            cfg.key_cols == ("dst_addr",):
         return ("B",)
     return ("own",)
 
 
 @functools.lru_cache(maxsize=None)
-def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs, master_cols):
+def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs):
     """Build + jit the fused device step for one static model spec.
 
     Module-level cache: pipelines are rebuilt freely (bench samples,
@@ -91,18 +84,9 @@ def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs, master_cols):
     """
     wagg_fns = tuple(_cached_wagg_update(c.window_seconds, c.key_cols,
                                          c.value_cols) for c in wagg_cfgs)
-    need_a = any(plan[0] == "A" for plan, _ in hh_specs)
     hh_b = any(plan[0] == "B" for plan, _ in hh_specs)
     need_b = hh_b or bool(ddos_cfgs)
-    hh_vals = ("bytes", "packets")  # the A/B shared payload planes
-    # Ports are 16-bit: packing (src_port << 16) | dst_port into ONE sort
-    # lane drops the master sort from 11 to 10 key lanes (sort cost scales
-    # with lane count; lexicographic order is preserved since both fields
-    # are 16-bit). Only when every A consumer's width avoids splitting the
-    # packed lane (4 = src, 8 = src+dst, 11 = full 5-tuple).
-    a_widths = sorted({plan[1] for plan, _ in hh_specs if plan[0] == "A"})
-    pack_ports = (len(master_cols) == 5
-                  and all(w in (4, 8, 11) for w in a_widths))
+    hh_vals = ("bytes", "packets")  # the dst-shared payload planes
 
     def to_f32(col):
         # int32 bit-patterns of uint32 counters: reinterpret unsigned
@@ -112,58 +96,19 @@ def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs, master_cols):
     def step(states, cols, valid, valid_hh, valid_dd):
         hh_states, dense_tots, ddos_states = states
 
-        if need_a:
-            if pack_ports:
-                packed = ((cols["src_port"].astype(jnp.uint32)
-                           << jnp.uint32(16))
-                          | (cols["dst_port"].astype(jnp.uint32)
-                             & jnp.uint32(0xFFFF)))
-                lanes = jnp.concatenate(
-                    [cols["src_addr"].astype(jnp.uint32),
-                     cols["dst_addr"].astype(jnp.uint32),
-                     packed[:, None],
-                     cols["proto"].astype(jnp.uint32)[:, None]], axis=1)
-            else:
-                lanes = hh._key_lanes(cols, master_cols)
-            vals = jnp.stack([to_f32(cols[c]) for c in hh_vals], axis=1)
-            sk_a, sv_a, sc_a = sort_rows_float(lanes, vals, valid_hh)
-            groupby_cache: dict[int, tuple] = {}
-
-            def groupby_a(width):
-                if width not in groupby_cache:
-                    if pack_ports and width > 8:
-                        u, s, c = presorted_groupby_float(
-                            sk_a, sv_a, sc_a, 10)
-                        unpacked = jnp.concatenate(
-                            [u[:, :8],
-                             (u[:, 8] >> jnp.uint32(16))[:, None],
-                             (u[:, 8] & jnp.uint32(0xFFFF))[:, None],
-                             u[:, 9:]], axis=1)
-                        # restore the all-1s sentinel on padding rows the
-                        # unpack split into 0xFFFF halves (ops.topk drops
-                        # the sentinel tuple by comparing whole lanes)
-                        u = jnp.where((c > 0)[:, None], unpacked, _SENTINEL)
-                        groupby_cache[width] = (u, s, c)
-                    else:
-                        groupby_cache[width] = presorted_groupby_float(
-                            sk_a, sv_a, sc_a, width)
-                return groupby_cache[width]
-
         if need_b:
+            # One dst-keyed hash sort serves the top-dst-IP sketch AND the
+            # DDoS per-dst accumulate under their own row masks: masks
+            # apply to the GATHERED rows, so the dual-mask planes cost
+            # gathers, not extra sort lanes (ops.segment.hash_sort).
             dst = cols["dst_addr"].astype(jnp.uint32)
             vb = valid_hh if hh_b else jnp.zeros_like(valid_hh)
             vd = (valid_dd if ddos_cfgs
                   else jnp.zeros_like(valid_hh))
             va = vb | vd
-            ku = jnp.where(va[:, None], dst, _SENTINEL)
-            n = ku.shape[0]
-            # iota payload + post-sort gathers (see ops.segment): per-
-            # consumer masks apply to the GATHERED rows, so the dual-mask
-            # planes cost gathers, not extra sort lanes
-            so = lax.sort([ku[:, i] for i in range(4)]
-                          + [lax.iota(jnp.int32, n)], num_keys=4)
-            perm = so[4]
-            sk_b = jnp.stack(so[:4], axis=1)
+            n = dst.shape[0]
+            sh_b, perm = hash_sort(dst, va)
+            sk_b = jnp.where(va[:, None], dst, _SENTINEL)[perm]
             vbp, vdp = vb[perm], vd[perm]
             planes, cnts = [], []
             if hh_b:
@@ -176,10 +121,13 @@ def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs, master_cols):
                 cnts.append(vdp.astype(jnp.int32))
             sv_b = jnp.stack(planes, axis=1)
             sc_b = jnp.stack(cnts, axis=1)  # [N, nc]
-            seg = presorted_segments(sk_b)
+            seg = presorted_segments(sh_b)
             sums_b = jax.ops.segment_sum(sv_b, seg, num_segments=n)
             cnt_b = jax.ops.segment_sum(sc_b, seg, num_segments=n)
-            uniq_b = jax.ops.segment_max(sk_b, seg, num_segments=n)
+            # min, not max: rows masked for NEITHER consumer keep their
+            # sentinel keys and may share a hash segment with real rows
+            # only on a ~2^-64 hash collision — min lets the real key win
+            uniq_b = jax.ops.segment_min(sk_b, seg, num_segments=n)
 
             def consume_b(plane_ix, cnt_ix, nplanes):
                 counts = cnt_b[:, cnt_ix]
@@ -191,15 +139,13 @@ def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs, master_cols):
 
         new_hh = []
         for (plan, cfg), st in zip(hh_specs, hh_states):
-            if plan[0] == "A":
-                uniq, sums, counts = groupby_a(plan[1])
-            elif plan[0] == "B":
+            if plan[0] == "B":
                 uniq, sums, counts = consume_b(0, 0, 2)
             else:
                 lanes = hh._key_lanes(cols, cfg.key_cols)
                 vals = jnp.stack(
                     [to_f32(cols[c]) for c in cfg.value_cols], axis=1)
-                uniq, sums, counts = sort_groupby_float(
+                uniq, sums, counts = hash_groupby_float(
                     lanes, vals, valid_hh)
             sums3 = jnp.concatenate(
                 [sums, counts.astype(jnp.float32)[:, None]], axis=1)
@@ -278,17 +224,6 @@ class FusedPipeline:
                              if self._ddos else None)
         self._hh_specs = tuple(
             (_hh_plan(w.config), w.config) for _, w in self._hh)
-        # Master sort keys only the longest prefix any A-plan model needs
-        # (a lone src-address model keys 4 lanes, not 11).
-        a_width = max((plan[1] for plan, _ in self._hh_specs
-                       if plan[0] == "A"), default=0)
-        cols, width = [], 0
-        for c in MASTER_KEY:
-            if width >= a_width:
-                break
-            cols.append(c)
-            width += lane_width(c)
-        self._master_cols = tuple(cols)
         self._cols = self._column_union()
         # The compiled step is cached on the static spec, NOT per instance:
         # every bench sample / supervisor restart builds a fresh pipeline,
@@ -299,7 +234,6 @@ class FusedPipeline:
             tuple(w.config for _, w in self._dense),
             tuple(d.config for _, d in self._ddos),
             tuple(m.config for _, m in self._waggs),
-            self._master_cols,
         )
 
     # ---- device step ------------------------------------------------------
@@ -314,7 +248,6 @@ class FusedPipeline:
 
         for _, m in self._waggs:
             add("time_received", *m.config.key_cols, *m.config.value_cols)
-        add(*self._master_cols)
         for _, w in self._hh:
             add(*w.config.key_cols, *w.config.value_cols)
         for _, w in self._dense:
@@ -401,10 +334,8 @@ class FusedPipeline:
         bs = self._bs
         for start in range(0, len(part), bs):
             padded, mask = part.slice(start, start + bs).pad_to(bs)
-            cols = {
-                k: jnp.asarray(v)
-                for k, v in padded.device_columns(self._cols).items()
-            }
+            host_cols = padded.device_columns(self._cols)
+            cols = {k: jnp.asarray(v) for k, v in host_cols.items()}
             valid = jnp.asarray(mask)
             zeros = (jnp.zeros_like(valid)
                      if not (do_hh and do_dd) else None)
@@ -426,4 +357,10 @@ class FusedPipeline:
             for (_, d), st in zip(self._ddos, new_ddos):
                 d.state = st
             for (_, m), out in zip(self._waggs, wagg_parts):
-                m.add_partial(out)
+                # exact fallback for the ~2^-64 hash-collision case: the
+                # chunk re-runs its own lexicographic groupby at drain
+                # time (flows_5m stays bit-exact). Closes over the HOST
+                # columns so pending fallbacks don't pin device buffers
+                # (see WindowAggregator._exact_fallback).
+                m.add_partial(out, fallback=m._exact_fallback(
+                    host_cols, mask))

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.segment import sort_groupby
+from ..ops.segment import hash_groupby, sort_groupby
 from ..utils.shards import local_device_blocks
 from ..schema.batch import FlowBatch, lane_width
 from .oracle import SECONDS_PER_SLOT
@@ -46,40 +46,71 @@ class WindowAggConfig:
 
 
 def _build_update(config: WindowAggConfig):
-    """One jitted device step: columns -> (keys, sums, counts, n_groups).
-    Cached on exactly the fields the program depends on — batch_size only
-    shapes the inputs (jit re-specializes per shape anyway) and
-    allowed_lateness is host-side, so neither may fragment the cache."""
+    """One jitted device step: columns -> (keys, sums, counts, n_groups[,
+    collided]). Cached on exactly the fields the program depends on —
+    batch_size only shapes the inputs (jit re-specializes per shape
+    anyway) and allowed_lateness is host-side, so neither may fragment
+    the cache."""
     return _cached_update(config.window_seconds, config.key_cols,
                           config.value_cols)
 
 
+def _window_keys_values(window, key_cols, value_cols, cols):
+    """(timeslot, *keys) lanes + 16-bit value planes for one chunk.
+    (Invalid-row masking happens downstream in hash_groupby/sort_groupby.)
+
+    Exactness: each uint32 value column rides as two 16-bit planes so
+    per-batch int32 segment sums cannot overflow (batch_size <= 32768
+    guarantees plane sums < 2^31); the host recombines lo + (hi << 16)
+    in uint64."""
+    ts = cols["time_received"].astype(jnp.uint32)
+    timeslot = ts - ts % window
+    lanes = [timeslot]
+    for name in key_cols:
+        arr = cols[name].astype(jnp.uint32)
+        if arr.ndim == 1:
+            lanes.append(arr)
+        else:
+            lanes.extend(arr[:, i] for i in range(arr.shape[1]))
+    keys = jnp.stack(lanes, axis=1)
+    planes = []
+    for name in value_cols:
+        v = cols[name].astype(jnp.uint32)
+        planes.append((v & jnp.uint32(0xFFFF)).astype(jnp.int32))
+        planes.append((v >> jnp.uint32(16)).astype(jnp.int32))
+    values = jnp.stack(planes, axis=1)
+    return keys, values
+
+
 @functools.lru_cache(maxsize=None)
 def _cached_update(window_seconds: int, key_cols: tuple, value_cols: tuple):
+    """Hash-grouped fast path: (keys, sums, counts, n_groups, collided).
+
+    The collided flag is a device scalar; callers keep it lazy until
+    drain time and re-run the chunk through _cached_update_exact when it
+    fires (~n^2/2^65 per chunk — never observed in practice, but the
+    flows_5m contract is BIT-exactness vs the reference rollup, so the
+    fallback keeps the guarantee unconditional)."""
     window = jnp.uint32(window_seconds)
 
     @jax.jit
     def update(cols: dict, valid):
-        ts = cols["time_received"].astype(jnp.uint32)
-        timeslot = ts - ts % window
-        lanes = [timeslot]
-        for name in key_cols:
-            arr = cols[name].astype(jnp.uint32)
-            if arr.ndim == 1:
-                lanes.append(arr)
-            else:
-                lanes.extend(arr[:, i] for i in range(arr.shape[1]))
-        keys = jnp.stack(lanes, axis=1)
-        # Exactness: each uint32 value column rides as two 16-bit planes so
-        # per-batch int32 segment sums cannot overflow (batch_size <= 32768
-        # guarantees plane sums < 2^31); the host recombines lo + (hi << 16)
-        # in uint64.
-        planes = []
-        for name in value_cols:
-            v = cols[name].astype(jnp.uint32)
-            planes.append((v & jnp.uint32(0xFFFF)).astype(jnp.int32))
-            planes.append((v >> jnp.uint32(16)).astype(jnp.int32))
-        values = jnp.stack(planes, axis=1)
+        keys, values = _window_keys_values(window, key_cols, value_cols, cols)
+        return hash_groupby(keys, values, valid)
+
+    return update
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_update_exact(window_seconds: int, key_cols: tuple,
+                         value_cols: tuple):
+    """Lexicographic path: the collision fallback (and the shard-mapped
+    variant's building block — parallel.sharded)."""
+    window = jnp.uint32(window_seconds)
+
+    @jax.jit
+    def update(cols: dict, valid):
+        keys, values = _window_keys_values(window, key_cols, value_cols, cols)
         return sort_groupby(keys, values, valid)
 
     return update
@@ -94,7 +125,11 @@ def _cached_update(window_seconds: int, key_cols: tuple, value_cols: tuple):
 # ~8-9% of step time at this threshold (7.7ms/chunk) and ~4ms/chunk at
 # threshold 1 — per-chunk fold cost is roughly flat-to-better at small
 # thresholds, so 32 is sized to memory + async slack alone: 32 x 8192
-# rows x ~10 int32 lanes ≈ 10 MB/chip worst case.
+# rows x ~10 int32 lanes ≈ 10 MB/chip worst case for the partials.
+# Collision-fallback closures add to that budget: the single-chip paths
+# deliberately stash HOST numpy columns (no HBM cost; ~10-20 MB host),
+# while the sharded paths retain their global device column refs — about
+# another ~1x the partial footprint per chip until drain.
 DRAIN_PENDING_MAX = 32
 
 
@@ -130,21 +165,40 @@ class WindowAggregator:
 
     def _update_chunk(self, batch: FlowBatch) -> None:
         padded, mask = batch.pad_to(self.config.batch_size)
-        cols = {
-            name: jnp.asarray(arr)
-            for name, arr in padded.device_columns(
-                ["time_received", *self.config.key_cols, *self.config.value_cols]
-            ).items()
-        }
-        self.add_partial(self._update(cols, jnp.asarray(mask)))
+        host_cols = padded.device_columns(
+            ["time_received", *self.config.key_cols, *self.config.value_cols]
+        )
+        cols = {name: jnp.asarray(arr) for name, arr in host_cols.items()}
+        valid = jnp.asarray(mask)
+        self.add_partial(self._update(cols, valid),
+                         fallback=self._exact_fallback(host_cols, mask))
 
-    def add_partial(self, partial) -> None:
-        """Queue one device partial (keys, sums, counts, n) for the next
-        drain. Single entry point for both the per-model path and the
-        fused pipeline, so the deferral bound lives in one place: a
-        flush-free caller (huge update() loops) must not pin unbounded
-        padded buffers on device."""
-        self._pending_partials.append(partial)
+    def _exact_fallback(self, host_cols: dict, mask):
+        """Deferred exact recompute for one chunk. Closes over the HOST
+        numpy columns (not the device arrays) so pending fallbacks cost
+        host memory, not HBM — the device budget DRAIN_PENDING_MAX is
+        sized for counts only the small partials."""
+        exact = _cached_update_exact(self.config.window_seconds,
+                                     self.config.key_cols,
+                                     self.config.value_cols)
+
+        def run():
+            cols = {k: jnp.asarray(v) for k, v in host_cols.items()}
+            return exact(cols, jnp.asarray(mask))
+
+        return run
+
+    def add_partial(self, partial, fallback=None) -> None:
+        """Queue one device partial — (keys, sums, counts, n) exact, or
+        (keys, sums, counts, n, collided) hash-grouped — for the next
+        drain. ``fallback`` is a zero-arg callable producing the EXACT
+        partial for the same chunk; it runs at drain time iff the
+        chunk's (lazy, device-resident) collision flag fires, keeping
+        flows_5m bit-exact without syncing per chunk. Single entry point
+        for both the per-model path and the fused pipeline, so the
+        deferral bound lives in one place: a flush-free caller (huge
+        update() loops) must not pin unbounded padded buffers on device."""
+        self._pending_partials.append((partial, fallback))
         if len(self._pending_partials) >= DRAIN_PENDING_MAX:
             self._drain()
 
@@ -153,7 +207,23 @@ class WindowAggregator:
         if not pending:
             return
         all_keys, all_sums, all_counts = [], [], []
-        for keys, sums, counts, n in pending:
+        for partial, fallback in pending:
+            if len(partial) == 5:
+                keys, sums, counts, n, collided = partial
+                # stacked (sharded) flags may live on non-addressable
+                # devices under multi-host — read only the local shards
+                coll_np = (local_device_blocks(collided)
+                           if keys.ndim == 3 else np.asarray(collided))
+                if bool(np.any(coll_np)):
+                    # a 64-bit grouping-hash collision (~2^-64/chunk):
+                    # recompute this chunk lexicographically
+                    if fallback is None:
+                        raise RuntimeError(
+                            "hash-grouped partial collided and no exact "
+                            "fallback was provided")
+                    keys, sums, counts, n = fallback()[:4]
+            else:
+                keys, sums, counts, n = partial
             if keys.ndim == 3:  # stacked per-chip partials (sharded variant)
                 # Multi-host: each process can only read ITS devices'
                 # shards, and only needs to — the per-chip partials are

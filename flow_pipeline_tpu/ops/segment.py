@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -124,7 +125,10 @@ def presorted_groupby_float(sorted_keys, sorted_vals, sorted_cnt, width=None):
     return uniq, sums, counts
 
 
-_SENTINEL = jnp.uint32(0xFFFFFFFF)
+# numpy, NOT jnp: a module-level jnp constant would initialize the JAX
+# backend at import time (breaking jax.distributed.initialize ordering
+# in multi-host workers — engine modules import this one transitively)
+_SENTINEL = np.uint32(0xFFFFFFFF)
 
 # Two decorrelated odd multipliers (golden-ratio / murmur-style constants)
 # for the paired 32-bit mixes that form the 64-bit grouping hash.

@@ -34,6 +34,7 @@ from ..models.window_agg import (
     WindowAggConfig,
     WindowAggregator,
     _cached_update,
+    _cached_update_exact,
 )
 from ..ops import topk as topk_ops
 from ..schema.batch import FlowBatch
@@ -188,10 +189,40 @@ class ShardedHeavyHitter:
 
 @functools.lru_cache(maxsize=None)
 def _sharded_window_update(mesh, window_seconds, key_cols, value_cols):
-    """Jitted per-chip window-agg step, cached on (mesh, program fields)
-    so fresh aggregators (supervisor restarts, benches) reuse the
-    compiled executable instead of re-tracing per instance."""
+    """Jitted per-chip window-agg step (hash-grouped fast path), cached
+    on (mesh, program fields) so fresh aggregators (supervisor restarts,
+    benches) reuse the compiled executable instead of re-tracing per
+    instance. Returns stacked per-chip (keys, sums, counts, n, collided);
+    the drain re-runs a chunk through the exact variant below when any
+    chip's collision flag fires."""
     base = _cached_update(window_seconds, key_cols, value_cols)
+
+    def per_chip(cols, valid):
+        keys, sums, counts, n, collided = base.__wrapped__(cols, valid)
+        # Globalize the collision flag (any-chip OR via pmax): every host
+        # must observe the SAME verdict, because the exact fallback is a
+        # global shard_map launch that all processes of a multi-controller
+        # mesh have to enter together — a host acting on only its local
+        # chips' flags would launch it alone and deadlock.
+        collided = jax.lax.pmax(collided.astype(jnp.int32), DATA_AXIS) > 0
+        return keys[None], sums[None], counts[None], n[None], collided[None]
+
+    return jax.jit(
+        shard_map(
+            per_chip,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                       P(DATA_AXIS), P(DATA_AXIS)),
+            check_vma=False,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_window_update_exact(mesh, window_seconds, key_cols, value_cols):
+    """Lexicographic per-chip window-agg step — the collision fallback."""
+    base = _cached_update_exact(window_seconds, key_cols, value_cols)
 
     def per_chip(cols, valid):
         keys, sums, counts, n = base.__wrapped__(cols, valid)
@@ -228,6 +259,10 @@ class ShardedWindowAggregator(WindowAggregator):
             self.mesh, config.window_seconds, config.key_cols,
             config.value_cols,
         )
+        self._sharded_exact = _sharded_window_update_exact(
+            self.mesh, config.window_seconds, config.key_cols,
+            config.value_cols,
+        )
 
     @property
     def global_batch(self) -> int:
@@ -250,7 +285,8 @@ class ShardedWindowAggregator(WindowAggregator):
         )
         cols, valid = shard_batch_columns(self.mesh, cols, mask)
         # stacked partials stay on device until a flush drains them
-        self.add_partial(self._sharded(cols, valid))
+        self.add_partial(self._sharded(cols, valid),
+                         fallback=lambda: self._sharded_exact(cols, valid))
 
     def update_device_columns(self, cols, valid,
                               watermark: Optional[int] = None) -> None:
@@ -258,7 +294,8 @@ class ShardedWindowAggregator(WindowAggregator):
         rows (multi-host feed path; see ShardedHeavyHitter). The caller
         supplies the batch watermark — the host only sees its own rows, so
         max(time_received) must come from the feed layer."""
-        self.add_partial(self._sharded(cols, valid))
+        self.add_partial(self._sharded(cols, valid),
+                         fallback=lambda: self._sharded_exact(cols, valid))
         if watermark is not None and watermark > self.watermark:
             self.watermark = watermark
 

@@ -102,3 +102,60 @@ class TestWindowAggParity:
         agg.update(FlowBatch.empty(0))
         out = agg.flush(force=True)
         assert len(out["timeslot"]) == 0
+
+
+class TestHashCollisionFallback:
+    """The hash-grouped fast path must keep flows_5m bit-exact even when
+    the 64-bit grouping hash collides: the drain re-runs the chunk
+    through the lexicographic path."""
+
+    def test_forced_collision_uses_exact_fallback(self, monkeypatch):
+        import jax.numpy as jnp
+
+        from flow_pipeline_tpu.models import window_agg as wa
+        from flow_pipeline_tpu.ops import segment
+
+        # A degenerate hash that maps EVERY row to one value guarantees a
+        # collision whenever two distinct keys coexist. Unique cache keys
+        # (window_seconds=77) keep the stubbed trace out of the shared
+        # lru_cache entries other tests use.
+        def degenerate(keys):
+            n = keys.shape[0]
+            one = jnp.ones(n, jnp.uint32)
+            return one, one
+
+        monkeypatch.setattr(segment, "hash_lanes", degenerate)
+        config = WindowAggConfig(window_seconds=77, batch_size=64)
+        gen = FlowGenerator(MockerProfile(), seed=5)
+        batch = gen.batch(180)
+        agg = WindowAggregator(config)
+        agg.update(batch)
+        agg._drain()
+
+        # independent exact reference: same config, un-stubbed hash
+        monkeypatch.undo()
+        wa._cached_update.cache_clear()
+        wa._cached_update_exact.cache_clear()
+        ref = WindowAggregator(config)
+        ref.update(batch)
+        ref._drain()
+        assert agg.windows.keys() == ref.windows.keys()
+        for slot in ref.windows:
+            assert agg.windows[slot].keys() == ref.windows[slot].keys()
+            for k in ref.windows[slot]:
+                np.testing.assert_array_equal(
+                    agg.windows[slot][k], ref.windows[slot][k])
+
+    def test_fallback_required_when_missing(self):
+        import jax.numpy as jnp
+        import pytest
+
+        from flow_pipeline_tpu.models.window_agg import WindowAggregator
+
+        agg = WindowAggregator(WindowAggConfig(batch_size=64))
+        fake = (jnp.zeros((4, 4), jnp.uint32), jnp.zeros((4, 4), jnp.int32),
+                jnp.zeros(4, jnp.int32), jnp.asarray(0),
+                jnp.asarray(True))  # collided, no fallback
+        agg.add_partial(fake, fallback=None)
+        with pytest.raises(RuntimeError, match="no exact"):
+            agg._drain()
