@@ -311,12 +311,11 @@ def bench_e2e() -> None:
     from flow_pipeline_tpu.utils.flags import FlagSet
 
     fs = _processor_flags(_gen_flags(_common_flags(FlagSet("bench"))))
-    # 8192 (the cli default) measured fastest for the fused step on CPU:
-    # the sort is O(n log^2 n), so beyond ~8k rows per-batch cost grows
-    # faster than the amortization gain (4k:102k, 8k:129k, 16k:118k,
-    # 32k:113k flows/s on the round-3 box)
-    vals = fs.parse(["-produce.profile", "zipf",
-                     "-processor.batch", "8192"])
+    # Uses the cli default batch (32768): with the hash-grouped pre-agg
+    # the sort no longer dominates, so bigger batches keep amortizing the
+    # per-dispatch cost (round-3 box, 1 core: 8k:179k, 16k:226k,
+    # 24k:242k, 32k:256k flows/s)
+    vals = fs.parse(["-produce.profile", "zipf"])
 
     def run_stream(n):
         bus = InProcessBus()
@@ -324,8 +323,7 @@ def bench_e2e() -> None:
         gen = _make_generator(vals)
         produced = 0
         while produced < n:
-            for frame in _batch_frames(gen.batch(16384)):
-                bus.produce("flows", frame)
+            bus.produce_many("flows", _batch_frames(gen.batch(16384)))
             produced += 16384
         worker = StreamWorker(
             Consumer(bus, fixedlen=True),

@@ -194,7 +194,7 @@ def _build_models(vals):
 
 def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.string("processor.backend", "tpu", "tpu | cpu (jax platform hint)")
-    fs.integer("processor.batch", 8192, "Device batch rows (per chip)")
+    fs.integer("processor.batch", 32768, "Device batch rows (per chip)")
     fs.integer("processor.mesh", 0, "Shard models over this many devices "
                                     "(0 = single chip)")
     fs.boolean("processor.fused", True, "One fused device step per batch "
@@ -326,8 +326,7 @@ def _load_frames_bus(path: str, topic: str, partitions: int = 2):
     bus.create_topic(topic, partitions)
     with open(path, "rb") as f:
         data = f.read()
-    for frame in wire.iter_raw_frames(data):
-        bus.produce(topic, frame)
+    bus.produce_many(topic, wire.iter_raw_frames(data))
     return bus
 
 
@@ -513,8 +512,7 @@ def pipeline_main(argv=None) -> int:
     produced = 0
     while produced < vals["produce.count"]:
         n = min(8192, vals["produce.count"] - produced)
-        for frame in _batch_frames(gen.batch(n)):
-            bus.produce(vals["kafka.topic"], frame)
+        bus.produce_many(vals["kafka.topic"], _batch_frames(gen.batch(n)))
         produced += n
     log.info("produced %d flows in %.2fs", produced, time.perf_counter() - t0)
 

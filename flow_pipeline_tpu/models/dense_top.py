@@ -15,11 +15,14 @@ past 2^24 — a single busy port can blow through that inside one window —
 so each value rides as two 16-bit planes in int32 with an explicit carry
 propagation per batch:
 
-    batch partial: scatter-add of (v & 0xFFFF, v >> 16) — bounded by
-        batch_size * 2^16 < 2^31, so int32-exact per batch;
-    fold: lo := (lo + p_lo) & 0xFFFF, hi := hi + p_hi + carry — hi
-        counts 2^16 units, so totals stay exact to 2^47 per cell
-        (~140 TB per port per window).
+    batch partial: scatter-add of (v & 0xFFFF, v >> 16) over <= 2^15-row
+        sub-chunks — bounded by 2^15 * (2^16 - 1) = 0x7FFF8000 < 2^31,
+        int32-exact;
+    fold (two-stage carry): the partial's lo plane normalizes to 16 bits
+        first, then adds the carried-in totals lo — hi counts 2^16
+        units, so totals stay exact to 2^47 per cell (~140 TB per port
+        per window). Any caller batch size is exact; sub-chunking is
+        internal static slicing.
 
 Ranking uses float32(hi)*65536 + lo (relative error ~6e-8, only capable
 of swapping keys whose totals differ by less than that); the REPORTED
@@ -53,40 +56,52 @@ class DenseTopConfig:
     value_cols: tuple[str, ...] = ("bytes", "packets")  # plane 0 ranks
     batch_size: int = 8192
 
-    def __post_init__(self):
-        # 32767 * 0xFFFF + 0xFFFF (normalized lo) = 0xFFFF * 2^15 < 2^31:
-        # the per-batch partial plus the carried-in lo plane stays
-        # int32-exact even if every row hits one cell with a max value
-        if self.batch_size > 32767:
-            raise ValueError(
-                "batch_size must be <= 32767 (int32 exactness of the "
-                "16-bit per-batch partials + carry)"
-            )
+
+# Largest sub-batch whose scatter partial stays int32-exact when every
+# row lands on one cell with a saturated 16-bit plane: 2^15 * 0xFFFF =
+# 0x7FFF8000 < 2^31. Bigger caller batches are split into static
+# sub-chunks inside the jit — a power of two so the common TPU-friendly
+# batch sizes divide evenly (no ragged trailing scatter).
+_DENSE_SUB_MAX = 32768
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("totals",))
 def dense_update(totals, cols, valid, *, config: DenseTopConfig):
     """totals: [domain, P+1, 2] int32 — (lo, hi) 16-bit planes per value
-    column plus the count plane, lo normalized to [0, 2^16)."""
-    key = cols[config.key_col].astype(jnp.int32)
+    column plus the count plane, lo normalized to [0, 2^16).
+
+    Exact for ANY batch size: the scatter runs over <= 2^15-row
+    sub-chunks (static unrolled slices), and the fold normalizes the
+    partial's lo plane BEFORE adding the carried-in totals lo — two-stage
+    carry — so neither addition can leave int32."""
+    key_full = cols[config.key_col].astype(jnp.int32)
     # invalid rows -> index `domain`, out of range HIGH, dropped by the
     # "drop" mode (a negative index would wrap before the check)
-    key = jnp.where(valid, key, config.domain)
+    key_full = jnp.where(valid, key_full, config.domain)
     lanes = [cols[name].astype(jnp.uint32) for name in config.value_cols]
-    lanes.append(jnp.ones(key.shape[0], jnp.uint32))  # count
+    lanes.append(jnp.ones(key_full.shape[0], jnp.uint32))  # count
     lo = jnp.stack([(v & jnp.uint32(0xFFFF)).astype(jnp.int32)
                     for v in lanes], axis=1)
     hi = jnp.stack([(v >> jnp.uint32(16)).astype(jnp.int32)
                     for v in lanes], axis=1)
-    planes = jnp.stack([lo, hi], axis=2)  # [N, P+1, 2]
-    planes = jnp.where(valid[:, None, None], planes, 0)
-    partial_ = jnp.zeros_like(totals).at[key].add(planes, mode="drop")
-    # fold with carry: int32-exact because each side is < 2^31
-    lo_sum = totals[:, :, 0] + partial_[:, :, 0]
-    new_lo = lo_sum & jnp.int32(0xFFFF)
-    carry = lo_sum >> jnp.int32(16)
-    new_hi = totals[:, :, 1] + partial_[:, :, 1] + carry
-    return jnp.stack([new_lo, new_hi], axis=2)
+    planes_full = jnp.stack([lo, hi], axis=2)  # [N, P+1, 2]
+    planes_full = jnp.where(valid[:, None, None], planes_full, 0)
+    n = key_full.shape[0]
+    for start in range(0, n, _DENSE_SUB_MAX):
+        key = key_full[start:start + _DENSE_SUB_MAX]
+        planes = planes_full[start:start + _DENSE_SUB_MAX]
+        partial_ = jnp.zeros_like(totals).at[key].add(planes, mode="drop")
+        # two-stage carry: normalize the partial's lo plane first (it can
+        # be up to 2^15 * 0xFFFF), then add the carried-in lo (< 2^16) —
+        # both sums fit int32 with room to spare
+        p_lo = partial_[:, :, 0] & jnp.int32(0xFFFF)
+        p_carry = partial_[:, :, 0] >> jnp.int32(16)
+        lo_sum = totals[:, :, 0] + p_lo
+        new_lo = lo_sum & jnp.int32(0xFFFF)
+        carry = lo_sum >> jnp.int32(16)
+        new_hi = totals[:, :, 1] + partial_[:, :, 1] + p_carry + carry
+        totals = jnp.stack([new_lo, new_hi], axis=2)
+    return totals
 
 
 @partial(jax.jit, static_argnames=("config", "k"))

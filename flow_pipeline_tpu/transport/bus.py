@@ -8,7 +8,6 @@ group. Thread-safe: producers and consumers may run on different threads
 
 from __future__ import annotations
 
-import itertools
 import threading
 from dataclasses import dataclass
 from typing import Iterable, Optional
@@ -29,7 +28,7 @@ class InProcessBus:
         self._lock = threading.RLock()
         self._topics: dict[str, list[list[bytes]]] = {}
         self._commits: dict[tuple[str, str, int], int] = {}  # (group, topic, p) -> next offset
-        self._rr = itertools.count()
+        self._rr = 0  # keyless-produce round-robin cursor (lock-guarded)
 
     def create_topic(self, topic: str, partitions: int = 2) -> None:
         """Idempotent; the reference's default is 2 partitions
@@ -49,7 +48,11 @@ class InProcessBus:
             if topic not in self._topics:
                 self.create_topic(topic)
             parts = self._topics[topic]
-            p = next(self._rr) % len(parts) if partition is None else partition
+            if partition is None:
+                p = self._rr % len(parts)
+                self._rr += 1
+            else:
+                p = partition
             log = parts[p]
             off = len(log)
             log.append(value)
@@ -57,11 +60,23 @@ class InProcessBus:
 
     def produce_many(self, topic: str, values: Iterable[bytes],
                      partition: Optional[int] = None) -> int:
-        n = 0
-        for v in values:
-            self.produce(topic, v, partition)
-            n += 1
-        return n
+        """Bulk append under ONE lock acquisition. With no explicit
+        partition the values round-robin across partitions in order,
+        continuing the same counter single-message produce uses."""
+        values = list(values)
+        with self._lock:
+            if topic not in self._topics:
+                self.create_topic(topic)
+            parts = self._topics[topic]
+            if partition is not None:
+                parts[partition].extend(values)
+            else:
+                np_ = len(parts)
+                start = self._rr
+                for i in range(np_):
+                    parts[(start + i) % np_].extend(values[i::np_])
+                self._rr += len(values)
+        return len(values)
 
     def fetch(self, topic: str, partition: int, offset: int,
               max_messages: int = 1024) -> list[BusMessage]:
@@ -71,6 +86,24 @@ class InProcessBus:
             return [
                 BusMessage(topic, partition, o, log[o]) for o in range(offset, end)
             ]
+
+    def fetch_span(self, topic: str, partition: int, offset: int,
+                   max_messages: int = 1024):
+        """Bulk fetch as ONE concatenated byte string.
+
+        Returns (data, first_offset, last_offset) or None when caught up.
+        This is the zero-object-overhead path for length-prefixed streams:
+        the bulk decoder (native.decode_stream / FlowBatch.from_wire)
+        wants exactly the concatenation, so materializing one BusMessage
+        per flow — the dominant consume-side cost at high rates — is pure
+        waste. Per-message consumers keep using fetch()."""
+        with self._lock:
+            log = self._topics[topic][partition]
+            end = min(len(log), offset + max_messages)
+            if end <= offset:
+                return None
+            data = b"".join(log[offset:end])
+        return data, offset, end - 1
 
     def end_offset(self, topic: str, partition: int) -> int:
         with self._lock:

@@ -86,8 +86,7 @@ class FeedServer:
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           f"malformed frame stream: {e}")
-        for frame in frames:
-            self.bus.produce(self.topic, frame)
+        self.bus.produce_many(self.topic, frames)
         self.m_frames.inc(len(frames))
         self.m_bytes.inc(len(request))
         return struct.pack(">Q", len(frames))

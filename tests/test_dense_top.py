@@ -181,3 +181,33 @@ class TestDenseTopK:
         inner = dense_backed.models["top_src_ports"].model
         assert not hasattr(inner, "state")  # no stray sketch attribute
         assert int(np.asarray(inner.totals).sum()) == 0  # untouched
+
+
+class TestLargeBatchExactness:
+    def test_batch_32768_and_subchunked_65536_exact(self):
+        """The two-stage carry admits 2^15-row scatters and internal
+        sub-chunking admits any caller batch; both must stay exact under
+        the adversarial worst case (every row on one cell, saturated
+        16-bit lo plane)."""
+        import jax.numpy as jnp
+
+        from flow_pipeline_tpu.models.dense_top import (
+            _planes_to_uint64,
+            dense_update,
+        )
+
+        for n in (32768, 65536):
+            cfg = DenseTopConfig(key_col="src_port", batch_size=n)
+            totals = jnp.zeros((cfg.domain, 3, 2), jnp.int32)
+            cols = {
+                "src_port": jnp.full(n, 443, jnp.int32),
+                "bytes": jnp.full(n, 0xFFFF, jnp.int32),  # saturated lo
+                "packets": jnp.full(n, 1, jnp.int32),
+            }
+            valid = jnp.ones(n, bool)
+            for _ in range(3):  # accumulate across batches too
+                totals = dense_update(totals, cols, valid, config=cfg)
+            vals = _planes_to_uint64(np.asarray(totals[443]))
+            assert int(vals[0]) == 3 * n * 0xFFFF   # bytes
+            assert int(vals[1]) == 3 * n            # packets
+            assert int(vals[2]) == 3 * n            # count
