@@ -38,6 +38,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..models import heavy_hitter as hh
 from ..models.ddos import DDoSDetector, _accumulate_grouped
@@ -46,9 +47,11 @@ from ..models.heavy_hitter import HeavyHitterModel
 from ..models.window_agg import WindowAggregator
 from ..models.window_agg import _cached_update as _cached_wagg_update
 from ..obs import get_logger
-from ..schema.batch import FlowBatch
+from ..schema.batch import FlowBatch, lane_width
 from ..ops.segment import (
+    _hash_grouped,
     hash_groupby_float,
+    hash_lanes,
     hash_sort,
     presorted_segments,
 )
@@ -88,6 +91,32 @@ def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs):
     need_b = hh_b or bool(ddos_cfgs)
     hh_vals = ("bytes", "packets")  # the dst-shared payload planes
 
+    # Nested-family chains: an "own" family whose key tuple is a PREFIX
+    # of another's (src-address under the 5-tuple top-talkers) rides the
+    # same sort — lanes are [h64(prefix), h64(full)], so rows group by
+    # the prefix at sort-lane width 2 and by the full key at width 4.
+    # Two extra lanes on one sort beat a whole second 2-lane sort.
+    own_ix = [i for i, (plan, cfg) in enumerate(hh_specs)
+              if plan[0] == "own" and tuple(cfg.value_cols) == hh_vals]
+    own_ix.sort(key=lambda i: -len(hh_specs[i][1].key_cols))
+    chains, absorbed = [], set()
+    for i in own_ix:
+        if i in absorbed:
+            continue
+        members = [i]
+        pk = hh_specs[i][1].key_cols
+        for j in own_ix:
+            if j in absorbed or j == i:
+                continue
+            ck = hh_specs[j][1].key_cols
+            if len(ck) < len(pk) and pk[:len(ck)] == ck:
+                members.append(j)
+                absorbed.add(j)
+        if len(members) > 1:
+            members.sort(key=lambda m: len(hh_specs[m][1].key_cols))
+            chains.append(tuple(members))
+            absorbed.add(i)
+
     def to_f32(col):
         # int32 bit-patterns of uint32 counters: reinterpret unsigned
         # before the float cast so saturated values stay positive
@@ -95,6 +124,33 @@ def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs):
 
     def step(states, cols, valid, valid_hh, valid_dd):
         hh_states, dense_tots, ddos_states = states
+
+        chain_results: dict[int, tuple] = {}
+        for members in chains:
+            parent_cfg = hh_specs[members[-1]][1]
+            full_lanes = hh._key_lanes(cols, parent_cfg.key_cols)
+            n = full_lanes.shape[0]
+            sort_lanes = []
+            for m in members:
+                h1, h2 = hash_lanes(hh._key_lanes(
+                    cols, hh_specs[m][1].key_cols))
+                sort_lanes.append(jnp.where(valid_hh, h1, _SENTINEL))
+                sort_lanes.append(jnp.where(valid_hh, h2, _SENTINEL))
+            out = lax.sort(sort_lanes + [lax.iota(jnp.int32, n)],
+                           num_keys=2 * len(members))
+            perm = out[-1]
+            sh = jnp.stack(out[:-1], axis=1)
+            sk = jnp.where(valid_hh[:, None], full_lanes.astype(jnp.uint32),
+                           _SENTINEL)[perm]
+            sv = jnp.stack([to_f32(cols[c]) for c in hh_vals], axis=1)
+            sv = jnp.where(valid_hh[:, None], sv, 0.0)[perm]
+            sc = valid_hh[perm].astype(jnp.int32)
+            for level, m in enumerate(members):
+                width = sum(
+                    lane_width(c) for c in hh_specs[m][1].key_cols)
+                uniq, sums, counts, _ = _hash_grouped(
+                    sh[:, :2 * (level + 1)], sk[:, :width], sv, sc, False)
+                chain_results[m] = (uniq, sums, counts)
 
         if need_b:
             # One dst-keyed hash sort serves the top-dst-IP sketch AND the
@@ -138,9 +194,11 @@ def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs):
                 return u, s, counts
 
         new_hh = []
-        for (plan, cfg), st in zip(hh_specs, hh_states):
+        for i, ((plan, cfg), st) in enumerate(zip(hh_specs, hh_states)):
             if plan[0] == "B":
                 uniq, sums, counts = consume_b(0, 0, 2)
+            elif i in chain_results:
+                uniq, sums, counts = chain_results[i]
             else:
                 lanes = hh._key_lanes(cols, cfg.key_cols)
                 vals = jnp.stack(
@@ -267,20 +325,35 @@ class FusedPipeline:
                  if self._whh else np.zeros(n, np.int64))
         subs = ((t // self._sub_seconds) * self._sub_seconds
                 if self._ddos else np.zeros(n, np.int64))
-        pairs = np.stack([slots, subs], axis=1)
-        uniq_pairs, inverse = np.unique(pairs, axis=0, return_inverse=True)
-        inverse = inverse.reshape(-1)  # numpy 2.0 shape quirk under axis=
-        for gi, (slot, sub) in enumerate(uniq_pairs):
-            if len(uniq_pairs) == 1:
+        # One (slot, sub) pair per batch is the overwhelmingly common case
+        # (sub-windows are tens of seconds, batches are milliseconds of
+        # traffic) — detect it with scalar min/max passes instead of a
+        # row-tuple unique (np.unique(axis=0) void-sorts the whole batch,
+        # ~19ms per 32k rows; this path is ~0.1ms). Boundary batches take
+        # the tuple unique, which orders correctly for ANY int64 pair —
+        # scalar-encoding tricks can wrap on corrupt extreme timestamps
+        # and would process real rows under an adopted garbage slot.
+        if slots.min() == slots.max() and subs.min() == subs.max():
+            groups = [(int(slots[0]), int(subs[0]), None)]
+        else:
+            pairs = np.stack([slots, subs], axis=1)
+            uniq_pairs, inverse = np.unique(pairs, axis=0,
+                                            return_inverse=True)
+            inverse = inverse.reshape(-1)  # numpy 2.0 quirk under axis=
+            groups = [
+                (int(slot), int(sub), np.flatnonzero(inverse == gi))
+                for gi, (slot, sub) in enumerate(uniq_pairs)
+            ]
+        for slot, sub, idx in groups:
+            if idx is None:
                 part = batch
             else:
-                idx = np.flatnonzero(inverse == gi)
                 part = FlowBatch(
                     {k: v[idx] for k, v in batch.columns.items()},
                     batch.partition,
                 )
-            do_hh = self._advance_hh(int(slot), len(part))
-            do_dd = self._advance_ddos(int(sub), len(part))
+            do_hh = self._advance_hh(slot, len(part))
+            do_dd = self._advance_ddos(sub, len(part))
             self._run_chunks(part, do_hh, do_dd)
         wm = int(t.max())
         for _, m in self._waggs:
