@@ -36,6 +36,7 @@ import time
 
 _PLATFORM = None
 _DEGRADE_REASON = None  # why the probe fell back to CPU (None if it didn't)
+_NATIVE = False  # whether the C++ bulk codec was active for e2e/decode
 
 # Load average above which a sample window is considered contended on this
 # box: the timed loop is single-threaded, so anything past "one busy core +
@@ -108,9 +109,11 @@ def _roofline_fields(lowerable, steps_per_sec: float, *args, **kwargs) -> dict:
 
     Lowers ``lowerable`` for the given args, reads the compiler's
     flops / bytes-accessed estimates, and converts the measured rate into
-    achieved TFLOP/s + GB/s and utilization percentages against the
-    chip's nominal peaks. Best-effort: returns {} if the backend can't
-    produce a cost analysis."""
+    achieved TFLOP/s + GB/s. On a recognized TPU the fields additionally
+    carry MFU / HBM-utilization percentages against the chip's nominal
+    peaks; on CPU the absolute per-step costs still land in the artifact
+    (they size the program the chip will run). Best-effort: returns {}
+    if the backend can't produce a cost analysis."""
     import jax
 
     try:
@@ -127,7 +130,7 @@ def _roofline_fields(lowerable, steps_per_sec: float, *args, **kwargs) -> dict:
         "flops_per_step": round(flops),
         "bytes_per_step": round(bytes_acc),
         "achieved_tflops": round(flops * steps_per_sec / 1e12, 4),
-        "achieved_hbm_gbps": round(bytes_acc * steps_per_sec / 1e9, 2),
+        "achieved_membw_gbps": round(bytes_acc * steps_per_sec / 1e9, 2),
     }
     kind = jax.devices()[0].device_kind.lower()
     for sub, (peak_f, peak_b) in _CHIP_PEAKS.items():
@@ -139,6 +142,32 @@ def _roofline_fields(lowerable, steps_per_sec: float, *args, **kwargs) -> dict:
                               f"/ {peak_b/1e9:.0f}GB/s"
             break
     return out
+
+
+def _ensure_native() -> bool:
+    """Build the native decode library if it is missing (fresh boxes).
+
+    The e2e/decode artifacts are meaningless without knowing whether the
+    10x-faster C++ bulk codec was active — round 3 started on a box where
+    it simply had not been built and the first e2e measurement came out
+    5x low. Best-effort: a failed build leaves the pure-Python path and
+    the artifact says so."""
+    from flow_pipeline_tpu import native
+
+    if native.available():
+        return True
+    import subprocess
+
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "native")],
+            check=True, capture_output=True, timeout=120,
+        )
+    except Exception:
+        return False
+    native.reload()
+    return native.available()
 
 
 def _resolve_platform(probe_timeout: float = 90.0) -> str:
@@ -200,11 +229,10 @@ def main() -> None:
         "vs_baseline": round(stats["value"] / baseline, 3),
         "platform": platform,
     }
-    if platform != "cpu":
-        result.update(_roofline_fields(
-            hh.hh_update, stats["value"] / BATCH,
-            state, staged[0], valid, config=config,
-        ))
+    result.update(_roofline_fields(
+        hh.hh_update, stats["value"] / BATCH,
+        state, staged[0], valid, config=config,
+    ))
     if _DEGRADE_REASON:
         # the probe DEGRADED to CPU: record why, so the artifact says
         # "chip was unreachable", not just "platform: cpu"
@@ -217,8 +245,9 @@ def bench_decode() -> None:
     from flow_pipeline_tpu import native
     from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
 
-    if not native.available():
-        print(json.dumps({"error": "libflowdecode.so not built (make native)"}))
+    if not _ensure_native():
+        print(json.dumps({"error": "libflowdecode.so not built and "
+                                   "auto-build failed (make native)"}))
         return
     batch = FlowGenerator(ZipfProfile(), seed=1).batch(65536)
     data = native.encode_stream(batch)
@@ -302,6 +331,9 @@ def bench_e2e() -> None:
     a pipeline rate, so this is measured as flows/sec like the kernel
     bench — produce time is excluded (production happens upstream of the
     processor in the reference architecture too)."""
+    global _NATIVE
+    _NATIVE = _ensure_native()  # the Python fallback decoder is ~10x slower
+
     from flow_pipeline_tpu.cli import (
         _batch_frames, _build_models, _make_generator, _processor_flags,
         _common_flags, _gen_flags,
@@ -345,6 +377,7 @@ def bench_e2e() -> None:
         "unit": "flows/sec",
         **stats,
         "vs_baseline": round(stats["value"] / 100_000.0, 3),
+        "native_decode": _NATIVE,
         "platform": _PLATFORM,
     }))
 

@@ -64,6 +64,14 @@ def available() -> bool:
     return _load() is not None
 
 
+def reload() -> bool:
+    """Re-attempt loading (e.g. after a caller built the library); returns
+    availability. Used by bench.py's fresh-box auto-build."""
+    global _TRIED
+    _TRIED = False
+    return available()
+
+
 # Column order shared with native/flowdecode.cc — scalar uint32 columns in
 # schema order, then the three [N,4] address columns.
 def _column_order():
