@@ -199,6 +199,9 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
                                     "(0 = single chip)")
     fs.boolean("processor.fused", True, "One fused device step per batch "
                                         "with shared pre-aggregation")
+    fs.string("processor.hostassist", "auto",
+              "Host-grouped pre-aggregation: auto (CPU backend only) "
+              "| on | off")
     fs.boolean("model.flows5m", True, "Exact 5m rollup model")
     fs.boolean("model.talkers", True, "5-tuple top-K talkers model")
     fs.boolean("model.ips", True, "Top src/dst IP models")
@@ -379,6 +382,7 @@ def processor_main(argv=None) -> int:
                 archive_raw=vals["archive.raw"],
                 prefetch=vals["feed.prefetch"],
                 fused=vals["processor.fused"],
+                host_assist=vals["processor.hostassist"],
             ),
         )
         if vals["query.addr"]:

@@ -1,0 +1,330 @@
+"""Host-grouped fused step: the CPU-backend twin of engine.fused.
+
+Same model surface, same window lifecycle (it IS a FusedPipeline
+subclass — update()'s slot/sub splitting and lifecycle advancement are
+inherited untouched), different pre-aggregation substrate: batches are
+grouped on the HOST with numpy (ops.hostgroup — ~20x cheaper than
+XLA:CPU's single-threaded lax.sort on one core) and only the compact
+group tables cross into the XLA step, which keeps what XLA is still
+best at even on CPU: the CMS scatter updates, top-K table merges and
+dense port scatters, in ONE dispatch per chunk.
+
+Additional wins over the device-sorted path on CPU:
+
+- flows_5m bypasses the device entirely: the host groupby is already
+  exact in uint64, so rows fold straight into the window store
+  (WindowAggregator.add_host_rows) — no 16-bit planes, no partial
+  queue, no collision fallback machinery.
+- Sketch families cascade: the finest key family (the 5-tuple top
+  talkers) is grouped once from raw rows, and every family whose key
+  set is a subset (src-IP, dst-IP) regroups the ~8-12k GROUP rows
+  instead of 32k raw rows. The DDoS per-dst accumulate reads the dst
+  family's table for free.
+- Group tables are padded to a shared power-of-two bucket, so the XLA
+  step sees a handful of static shapes and its CMS/top-K cost scales
+  with actual batch cardinality, not the raw batch size.
+
+Model selection lives in StreamWorker: host_assist="auto" picks this
+pipeline iff the default backend is CPU ("on"/"off" force/forbid).
+The TPU path is engine.fused, unchanged — this module is why the same
+framework is honest on both: each backend gets the pre-aggregation its
+memory hierarchy wants.
+
+Equivalence vs the device-sorted pipeline (and transitively the
+unfused per-model path) is proven in tests/test_hostfused.py, late
+rows and window boundaries included.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from ..models import heavy_hitter as hh
+from ..models.ddos import _accumulate_grouped
+from ..models.dense_top import dense_update
+from ..obs import get_logger
+from ..obs.tracing import StageTimer
+from ..ops.hostgroup import group_by_key, select_lanes
+from ..schema.batch import FlowBatch, lane_width
+from .fused import FusedPipeline
+
+log = get_logger("hostfused")
+
+_U32_MAX = np.uint64(0xFFFFFFFF)
+
+
+def _u32_lane(col: np.ndarray) -> np.ndarray:
+    """One raw host column -> uint32 lane(s), saturating uint64 columns
+    exactly like FlowBatch.device_columns (so host and device grouping
+    see identical key/value words)."""
+    if col.dtype == np.uint64:
+        return np.minimum(col, _U32_MAX).astype(np.uint32)
+    return col.astype(np.uint32, copy=False)
+
+
+def _key_lanes_np(cols: dict, key_cols) -> np.ndarray:
+    parts = []
+    for name in key_cols:
+        a = _u32_lane(cols[name])
+        parts.append(a if a.ndim == 2 else a[:, None])
+    return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
+
+
+def _value_planes_np(cols: dict, value_cols) -> np.ndarray:
+    """[N, P] float32 value planes with the device path's u32 saturation."""
+    return np.stack([_u32_lane(cols[name]).astype(np.float32)
+                     for name in value_cols], axis=1)
+
+
+def _pow2_bucket(n: int, hi: int, lo: int = 1024) -> int:
+    """Smallest power-of-two >= n in [lo, hi]; hi must be >= any possible
+    n (callers pass the chunk size — a chunk of N rows cannot group into
+    more than N rows)."""
+    b = lo
+    while b < n and b < hi:
+        b <<= 1
+    return b
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_apply(hh_cfgs: tuple, dense_cfgs: tuple, ddos_cfgs: tuple):
+    """One jitted state-update step over pre-grouped inputs.
+
+    hh_in:   tuple of (uniq [B, W] u32, sums3 [B, P+1] f32, valid [B])
+    dense_in: (cols dict of [Nd] int32, valid [Nd]) or None
+    ddos_in: (uniq [B, 4] u32, sums [B] f32, valid [B]) or None
+
+    Module-cached on the static config spec exactly like
+    engine.fused._cached_step — rebuilt pipelines must share the
+    compiled program.
+    """
+
+    def apply(states, hh_in, dense_in, ddos_in):
+        hh_states, dense_tots, ddos_states = states
+        new_hh = tuple(
+            hh._apply_grouped(st, u, s, v, cfg)
+            for st, (u, s, v), cfg in zip(hh_states, hh_in, hh_cfgs)
+        )
+        new_dense = dense_tots
+        if dense_in is not None:
+            dcols, dvalid = dense_in
+            new_dense = tuple(
+                dense_update(t, dcols, dvalid, config=c)
+                for t, c in zip(dense_tots, dense_cfgs)
+            )
+        new_ddos = tuple(
+            _accumulate_grouped(st, ddos_in[0], ddos_in[1], ddos_in[2], cfg)
+            for st, cfg in zip(ddos_states, ddos_cfgs)
+        ) if ddos_in is not None else ddos_states
+        return new_hh, new_dense, new_ddos
+
+    return jax.jit(apply, donate_argnums=(0,))
+
+
+class HostGroupPipeline(FusedPipeline):
+    """FusedPipeline with host (numpy) pre-aggregation — CPU backend."""
+
+    @staticmethod
+    def eligible(mode: str = "auto") -> bool:
+        """Whether this pipeline should be picked over engine.fused.
+        "auto" -> only when the default backend is CPU (the whole premise
+        is that host memory IS device memory there)."""
+        if mode == "on":
+            return True
+        if mode == "off":
+            return False
+        if mode != "auto":
+            raise ValueError(
+                f"host_assist must be auto|on|off, got {mode!r}")
+        return jax.default_backend() == "cpu"
+
+    def __init__(self, models: dict):
+        super().__init__(models)
+        self.stages = StageTimer()
+        self._widths = {}
+        # Sketch-family plan: group the maximal key families from raw
+        # rows; regroup every strict-subset family (equal value planes)
+        # from its parent's ~10x smaller group table.
+        cfgs = [w.config for _, w in self._hh]
+        for c in cfgs:
+            for name in c.key_cols:
+                self._widths[name] = lane_width(name)
+        order = sorted(range(len(cfgs)),
+                       key=lambda i: -len(cfgs[i].key_cols))
+        self._fam_plan: list[tuple] = [()] * len(cfgs)
+        planned: list[int] = []
+        for i in order:
+            parent = None
+            for j in planned:
+                if (set(cfgs[i].key_cols) < set(cfgs[j].key_cols)
+                        and tuple(cfgs[i].value_cols)
+                        == tuple(cfgs[j].value_cols)):
+                    if parent is None or len(cfgs[j].key_cols) < len(
+                            cfgs[parent].key_cols):
+                        parent = j
+            if parent is None:
+                self._fam_plan[i] = ("own",)
+            else:
+                sel = select_lanes(cfgs[parent].key_cols, self._widths,
+                                   cfgs[i].key_cols)
+                self._fam_plan[i] = ("cascade", parent, tuple(sel))
+            planned.append(i)
+        # DDoS per-dst sums: ride a family whose keys include dst_addr
+        # and whose value planes carry the detector's value column.
+        self._ddos_plan = None
+        if self._ddos:
+            dcfg = self._ddos[0][1].config
+            for j, c in enumerate(cfgs):
+                if ("dst_addr" in c.key_cols
+                        and dcfg.value_col in c.value_cols):
+                    self._ddos_plan = (
+                        "cascade", j,
+                        tuple(select_lanes(c.key_cols, {
+                            **self._widths, "dst_addr": 4}, ("dst_addr",))),
+                        c.value_cols.index(dcfg.value_col),
+                    )
+                    break
+            if self._ddos_plan is None:
+                self._ddos_plan = ("own",)
+        self._apply = _cached_apply(
+            tuple(w.config for _, w in self._hh),
+            tuple(w.config for _, w in self._dense),
+            tuple(d.config for _, d in self._ddos),
+        )
+
+    # ---- per-chunk work ----------------------------------------------------
+
+    def _run_chunks(self, part: FlowBatch, do_hh: bool, do_dd: bool) -> None:
+        bs = self._bs
+        for start in range(0, len(part), bs):
+            chunk = part.slice(start, start + bs)
+            cols = chunk.columns
+            n = len(chunk)
+            with self.stages.stage("host_group"):
+                # flows_5m: exact uint64 groupby straight into the window
+                # store — no device partials on this path
+                for _, m in self._waggs:
+                    self._wagg_rows(m, cols, n)
+                fams = self._group_families(cols) \
+                    if (do_hh or do_dd) and (self._hh or self._ddos) else None
+            if not (do_hh or do_dd) or not (
+                    self._hh or self._dense or self._ddos):
+                continue
+            with self.stages.stage("device_apply"):
+                self._device_apply(chunk, cols, fams, do_hh, do_dd, n)
+
+    def _wagg_rows(self, m, cols: dict, n: int) -> None:
+        cfg = m.config
+        t = np.minimum(cols["time_received"], _U32_MAX).astype(np.uint32)
+        slot = t - t % np.uint32(cfg.window_seconds)
+        lanes = [slot[:, None]]
+        for name in cfg.key_cols:
+            a = _u32_lane(cols[name])
+            lanes.append(a if a.ndim == 2 else a[:, None])
+        lanes = np.concatenate(lanes, axis=1)
+        planes = [np.minimum(cols[name], _U32_MAX) for name in cfg.value_cols]
+        uniq, sums, counts = group_by_key(lanes, [np.stack(planes, axis=1)])
+        m.add_host_rows(uniq, sums[0], counts)
+
+    def _group_families(self, cols: dict) -> list[tuple]:
+        """Per-hh-family (uniq [G,W] u32, vsum [G,P] f64, cnt [G]) plus the
+        DDoS per-dst tuple appended last when planned."""
+        out: list = [None] * len(self._hh)
+        for i, (plan, (_, w)) in enumerate(
+                zip(self._fam_plan, self._hh)):
+            if plan[0] != "own":
+                continue
+            cfg = w.config
+            lanes = _key_lanes_np(cols, cfg.key_cols)
+            vals = _value_planes_np(cols, cfg.value_cols)
+            uniq, sums, counts = group_by_key(lanes, [vals], exact=False)
+            out[i] = (uniq, sums[0], counts)
+        for i, plan in enumerate(self._fam_plan):
+            if plan[0] != "cascade":
+                continue
+            _, parent, sel = plan
+            p_uniq, p_vsum, p_cnt = out[parent]
+            uniq, sums, _ = group_by_key(
+                p_uniq[:, list(sel)], [p_vsum, p_cnt], exact=False)
+            out[i] = (uniq, sums[0], sums[1].astype(np.int64))
+        if self._ddos_plan is not None:
+            dcfg = self._ddos[0][1].config
+            if self._ddos_plan[0] == "cascade":
+                _, parent, sel, plane = self._ddos_plan
+                p_uniq, p_vsum, p_cnt = out[parent]
+                uniq, sums, _ = group_by_key(
+                    p_uniq[:, list(sel)], [p_vsum[:, plane]], exact=False)
+                out.append((uniq, sums[0].astype(np.float32)))
+            else:
+                lanes = _key_lanes_np(cols, ("dst_addr",))
+                vals = _u32_lane(cols[dcfg.value_col]).astype(np.float32)
+                uniq, sums, _ = group_by_key(lanes, [vals], exact=False)
+                out.append((uniq, sums[0].astype(np.float32)))
+        return out
+
+    def _device_apply(self, chunk: FlowBatch, cols: dict, fams,
+                      do_hh: bool, do_dd: bool, n: int) -> None:
+        sizes = [1024]
+        if self._hh:
+            sizes += [f[0].shape[0] for f in fams[:len(self._hh)]]
+        if self._ddos_plan is not None:
+            sizes.append(fams[-1][0].shape[0])
+        B = _pow2_bucket(max(sizes), hi=max(self._bs, 1024))
+        hh_in = []
+        for i, (_, w) in enumerate(self._hh):
+            uniq, vsum, cnt = fams[i]
+            g = uniq.shape[0]
+            W = uniq.shape[1]
+            P = vsum.shape[1]
+            u = np.zeros((B, W), np.uint32)
+            s = np.zeros((B, P + 1), np.float32)
+            u[:g] = uniq
+            s[:g, :P] = vsum
+            s[:g, P] = cnt
+            v = np.zeros(B, bool)
+            v[:g] = do_hh
+            hh_in.append((u, s, v))
+        dense_in = None
+        if self._dense and do_hh:
+            need = set()
+            for _, w in self._dense:
+                need.add(w.config.key_col)
+                need.update(w.config.value_cols)
+            bs = self._bs
+            dcols = {}
+            for name in need:
+                src = _u32_lane(cols[name])
+                a = np.zeros(bs, np.uint32)
+                a[:n] = src
+                dcols[name] = a.view(np.int32)
+            dvalid = np.zeros(bs, bool)
+            dvalid[:n] = True
+            dense_in = (dcols, dvalid)
+        ddos_in = None
+        if self._ddos_plan is not None:
+            uniq, dsum = fams[-1]
+            g = uniq.shape[0]
+            u = np.zeros((B, 4), np.uint32)
+            s = np.zeros(B, np.float32)
+            u[:g] = uniq
+            s[:g] = dsum
+            v = np.zeros(B, bool)
+            v[:g] = do_dd
+            ddos_in = (u, s, v)
+        states = (
+            tuple(w.model.state for _, w in self._hh),
+            tuple(w.model.totals for _, w in self._dense),
+            tuple(d.state for _, d in self._ddos),
+        )
+        new_hh, new_dense, new_ddos = self._apply(
+            states, tuple(hh_in), dense_in, ddos_in)
+        for (_, w), st in zip(self._hh, new_hh):
+            w.model.state = st
+        if dense_in is not None:
+            for (_, w), tot in zip(self._dense, new_dense):
+                w.model.totals = tot
+        for (_, d), st in zip(self._ddos, new_ddos):
+            d.state = st

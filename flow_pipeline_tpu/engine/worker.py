@@ -42,6 +42,13 @@ class WorkerConfig:
     # supports it; falls back to the serial per-model path otherwise
     # (e.g. mesh-sharded models). Outputs are equivalence-tested.
     fused: bool = True
+    # Host-grouped pre-aggregation (engine.hostfused): "auto" uses it
+    # when the default backend is CPU (numpy's introsort beats XLA:CPU's
+    # lax.sort ~20x on one core, so grouping host-side and shipping only
+    # compact group tables to the XLA step is the idiomatic CPU layout);
+    # "on"/"off" force/forbid. On TPU "auto" keeps the device-sorted
+    # fused step.
+    host_assist: str = "auto"
     # Full-fidelity raw archiving (the reference's flows_raw path,
     # ref: compose/clickhouse/create.sh:36-62): every consumed batch is
     # handed to sinks exposing archive_raw(batch). Off by default — the
@@ -73,9 +80,13 @@ class StreamWorker:
         self.fused = None
         if config.fused and models:
             from .fused import FusedPipeline
+            from .hostfused import HostGroupPipeline
 
             if FusedPipeline.supported(models):
-                self.fused = FusedPipeline(models)
+                if HostGroupPipeline.eligible(config.host_assist):
+                    self.fused = HostGroupPipeline(models)
+                else:
+                    self.fused = FusedPipeline(models)
             else:
                 log.info("model set not fusable; using per-model updates")
         self.batches_seen = 0

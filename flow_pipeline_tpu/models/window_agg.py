@@ -152,6 +152,8 @@ class WindowAggregator:
         # async, so keeping results as device arrays until a flush needs
         # them lets the next chunk's sort overlap the previous transfer
         self._pending_partials: list = []
+        # host-grouped rows not yet folded (engine.hostfused's path)
+        self._pending_host: list = []
 
     def update(self, batch: FlowBatch) -> None:
         if len(batch) == 0:
@@ -203,6 +205,11 @@ class WindowAggregator:
             self._drain()
 
     def _drain(self) -> None:
+        if self._pending_host:
+            pending_h, self._pending_host = self._pending_host, []
+            self._fold_rows(
+                np.concatenate([k for k, _ in pending_h]),
+                np.concatenate([v for _, v in pending_h]))
         pending, self._pending_partials = self._pending_partials, []
         if not pending:
             return
@@ -251,13 +258,7 @@ class WindowAggregator:
 
     def _merge_partials(self, keys, plane_sums, counts) -> None:
         """Fold device partial aggregates (keys + 16-bit value planes +
-        counts) into the per-window host accumulators.
-
-        Vectorized: the whole drain's rows are combined with ONE
-        lexsort + boundary reduceat, and Python-level dict work happens
-        only per UNIQUE (slot, key) row — measured 6-10x cheaper than the
-        previous per-row dict loop at the 8-device drain size (the host
-        fold was 20% of sharded step time, VERDICT r2 #6)."""
+        counts) into the per-window host accumulators."""
         n = keys.shape[0]
         if n == 0:
             return
@@ -270,6 +271,41 @@ class WindowAggregator:
         for j in range(nvals):
             vals[:, j] = plane_sums[:, 2 * j] + (plane_sums[:, 2 * j + 1] << 16)
         vals[:, nvals] = counts
+        self._fold_rows(keys, vals)
+
+    def add_host_rows(self, keys, sums, counts) -> None:
+        """Queue host-grouped EXACT rows for the window store.
+
+        The CPU-backend pipeline (ops.hostgroup / engine.hostfused) groups
+        batches on the host in full uint64 — no 16-bit planes, no device
+        partial queue, no collision fallback — so its rows skip
+        add_partial entirely. ``keys`` [R, 1 + key lanes] uint32 with the
+        timeslot lane FIRST (same layout the device partials use),
+        ``sums`` [R, nvals] uint64, ``counts`` [R] integer.
+
+        Rows are buffered and folded at the next drain (flush, snapshot,
+        or every DRAIN_PENDING_MAX chunks): one lexsort over the whole
+        backlog beats per-chunk dict merges the same way the device
+        partial queue does, at a few MB of host memory."""
+        vals = np.concatenate(
+            [sums.astype(np.uint64),
+             counts.astype(np.uint64)[:, None]], axis=1)
+        self._pending_host.append((keys.astype(np.uint32), vals))
+        if len(self._pending_host) >= DRAIN_PENDING_MAX:
+            self._drain()
+
+    def _fold_rows(self, keys, vals) -> None:
+        """Merge (slot, key) rows + uint64 value/count columns into the
+        per-window dicts.
+
+        Vectorized: the whole drain's rows are combined with ONE
+        lexsort + boundary reduceat, and Python-level dict work happens
+        only per UNIQUE (slot, key) row — measured 6-10x cheaper than the
+        previous per-row dict loop at the 8-device drain size (the host
+        fold was 20% of sharded step time, VERDICT r2 #6)."""
+        n = keys.shape[0]
+        if n == 0:
+            return
         order = np.lexsort(keys.T[::-1])  # rows grouped by (slot, key)
         sk = keys[order]
         boundary = np.empty(n, dtype=bool)

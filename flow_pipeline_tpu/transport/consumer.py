@@ -10,9 +10,15 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ..obs.tracing import StageTimer
 from ..schema import wire
 from ..schema.batch import FlowBatch
 from .bus import InProcessBus
+
+# Per-stage feed-path timing (flow_summary_consume_*_time_us): the same
+# latency-summary family the reference charts for its collector stages.
+# Module-level — every consumer feeds the one process-wide registry.
+_STAGES = StageTimer()
 
 
 class Consumer:
@@ -52,21 +58,26 @@ class Consumer:
         dominant consume-side cost at high rates."""
         for p in self._rotation():
             if self.fixedlen:
-                span = self.bus.fetch_span(
-                    self.topic, p, self.positions[p], max_messages)
+                with _STAGES.stage("consume_fetch"):
+                    span = self.bus.fetch_span(
+                        self.topic, p, self.positions[p], max_messages)
                 if span is None:
                     continue
                 data, first, last = span
-                batch = FlowBatch.from_wire(data)
+                with _STAGES.stage("consume_decode"):
+                    batch = FlowBatch.from_wire(data)
                 batch.partition = p
                 batch.first_offset = first
                 batch.last_offset = last
                 self.positions[p] = last + 1
                 return batch
-            msgs = self.bus.fetch(self.topic, p, self.positions[p], max_messages)
+            with _STAGES.stage("consume_fetch"):
+                msgs = self.bus.fetch(self.topic, p, self.positions[p],
+                                      max_messages)
             if not msgs:
                 continue
-            batch = self._decode(msgs)
+            with _STAGES.stage("consume_decode"):
+                batch = self._decode(msgs)
             batch.partition = p
             batch.first_offset = msgs[0].offset
             batch.last_offset = msgs[-1].offset

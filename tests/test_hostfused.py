@@ -1,0 +1,203 @@
+"""Host-grouped pipeline equivalence (engine.hostfused vs engine.fused).
+
+The CPU-backend pipeline regroups batches on the host with numpy and
+ships compact group tables to a single jitted state-update step; it must
+be output-identical to the device-sorted fused pipeline (which is itself
+equivalence-tested against the serial per-model path in test_fused.py):
+same flows_5m rows bit-for-bit, same top-K tables, same DDoS alerts,
+same late-row drops — window boundaries and late data included.
+
+ops.hostgroup's groupby is additionally property-tested against a dict
+oracle, with hash collisions FORCED (constant hash) to exercise the
+lexicographic fallback and the exact=False merge semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.engine import StreamWorker, WorkerConfig
+from flow_pipeline_tpu.engine.fused import FusedPipeline
+from flow_pipeline_tpu.engine.hostfused import HostGroupPipeline
+from flow_pipeline_tpu.models import WindowAggConfig, WindowAggregator
+from flow_pipeline_tpu.ops import hostgroup
+from flow_pipeline_tpu.schema import wire
+from flow_pipeline_tpu.transport import Consumer, InProcessBus
+
+from test_fused import (
+    BS,
+    WINDOW,
+    assert_same_windows,
+    canon_rows,
+    make_models,
+    make_stream,
+)
+
+
+class TestGroupByKey:
+    def _oracle(self, lanes, planes):
+        acc: dict[tuple, list] = {}
+        for i, row in enumerate(map(tuple, lanes)):
+            slot = acc.setdefault(row, [0] + [np.zeros(p.shape[1:]
+                                   if p.ndim > 1 else ()) for p in planes])
+            slot[0] += 1
+            for j, p in enumerate(planes):
+                slot[j + 1] = slot[j + 1] + p[i].astype(np.float64)
+        return acc
+
+    def test_matches_dict_oracle(self, rng):
+        lanes = rng.integers(0, 7, size=(300, 3)).astype(np.uint32)
+        pf = rng.integers(0, 50, size=(300, 2)).astype(np.float32)
+        pu = rng.integers(0, 50, size=300).astype(np.uint64)
+        uniq, (sf, su), counts = hostgroup.group_by_key(lanes, [pf, pu])
+        want = self._oracle(lanes, [pf, pu])
+        assert len(uniq) == len(want)
+        for i, row in enumerate(map(tuple, uniq)):
+            cnt, wf, wu = want[row]
+            assert counts[i] == cnt
+            np.testing.assert_array_equal(sf[i], wf)
+            np.testing.assert_array_equal(su[i], wu)
+
+    def test_forced_collision_stays_exact(self, rng, monkeypatch):
+        """A constant hash makes EVERY distinct key a collision; the
+        verify pass must detect it and regroup lexicographically."""
+        monkeypatch.setattr(
+            hostgroup, "hash_u64",
+            lambda lanes: np.zeros(lanes.shape[0], np.uint64))
+        lanes = rng.integers(0, 5, size=(64, 2)).astype(np.uint32)
+        vals = rng.integers(0, 9, size=64).astype(np.uint64)
+        uniq, (s,), counts = hostgroup.group_by_key(lanes, [vals])
+        want = self._oracle(lanes, [vals])
+        assert len(uniq) == len(want)
+        for i, row in enumerate(map(tuple, uniq)):
+            assert s[i] == want[row][1]
+
+    def test_exact_false_merges_on_collision(self, rng, monkeypatch):
+        """exact=False skips the verify: a full-hash collision merges the
+        tuples into one group — the documented sketch-path trade."""
+        monkeypatch.setattr(
+            hostgroup, "hash_u64",
+            lambda lanes: np.zeros(lanes.shape[0], np.uint64))
+        lanes = rng.integers(0, 5, size=(64, 2)).astype(np.uint32)
+        vals = np.ones(64, np.float32)
+        uniq, (s,), counts = hostgroup.group_by_key(lanes, [vals],
+                                                    exact=False)
+        assert len(uniq) == 1
+        assert s[0] == 64.0
+
+    def test_empty_input(self):
+        uniq, (s,), counts = hostgroup.group_by_key(
+            np.zeros((0, 2), np.uint32), [np.zeros(0, np.float32)])
+        assert uniq.shape == (0, 2) and len(counts) == 0
+
+    def test_select_lanes(self):
+        widths = {"src_addr": 4, "dst_addr": 4, "src_port": 1, "proto": 1}
+        key_cols = ("src_addr", "dst_addr", "src_port", "proto")
+        assert hostgroup.select_lanes(key_cols, widths, ("dst_addr",)) == \
+            [4, 5, 6, 7]
+        assert hostgroup.select_lanes(key_cols, widths,
+                                      ("proto", "src_addr")) == \
+            [9, 0, 1, 2, 3]
+        with pytest.raises(KeyError):
+            hostgroup.select_lanes(key_cols, widths, ("dst_port",))
+
+
+def drive(pipeline_cls, models, batches):
+    pipe = pipeline_cls(models)
+    for b in batches:
+        pipe.update(b)
+    return models
+
+
+class TestHostFusedEquivalence:
+    def test_bit_exact_vs_fused(self):
+        """Aligned cadence, integer values below 2^24: the host f64
+        groupby sums cast to f32 without rounding, so every family —
+        flows_5m, sketch tables, CMS estimates, dense ports, DDoS
+        alerts, late-row drops — must match the device-sorted fused
+        pipeline bit-for-bit."""
+        batches = make_stream()
+        fused = drive(FusedPipeline, make_models(WINDOW, 100), batches)
+        host = drive(HostGroupPipeline, make_models(WINDOW, 100), batches)
+
+        assert canon_rows(fused["flows_5m"].flush(True)) == \
+            canon_rows(host["flows_5m"].flush(True))
+        for name in ("top_talkers", "top_src_ips", "top_dst_ips",
+                     "top_src_ports"):
+            assert_same_windows(fused[name].flush(True),
+                                host[name].flush(True))
+            assert fused[name].late_flows_dropped == \
+                host[name].late_flows_dropped
+        fa, ha = fused["ddos_alerts"], host["ddos_alerts"]
+        assert fa.late_flows_dropped == ha.late_flows_dropped
+        assert len(fa.alerts) == len(ha.alerts)
+        for x, y in zip(fa.alerts, ha.alerts):
+            assert x.keys() == y.keys()
+            for k in x:
+                np.testing.assert_array_equal(np.asarray(x[k]),
+                                              np.asarray(y[k]))
+
+    def test_cascade_plan_default_models(self):
+        """The default model family must plan src/dst IP regroups off the
+        5-tuple table and ride the DDoS accumulate on the dst family."""
+        pipe = HostGroupPipeline(make_models(WINDOW, 100))
+        plans = dict(zip([n for n, _ in pipe._hh], pipe._fam_plan))
+        assert plans["top_talkers"] == ("own",)
+        assert plans["top_src_ips"][0] == "cascade"
+        assert plans["top_dst_ips"][0] == "cascade"
+        assert pipe._ddos_plan is not None
+        assert pipe._ddos_plan[0] == "cascade"
+
+    def test_flows5m_pending_rows_cover_snapshot_drain(self):
+        """Host rows are deferred; a drain (snapshot/flush path) must fold
+        them — no rows may be lost between chunks and a checkpoint."""
+        agg = WindowAggregator(WindowAggConfig(batch_size=BS))
+        keys = np.array([[6000, 1, 2, 3], [6000, 1, 2, 3]], np.uint32)
+        sums = np.array([[10, 1], [5, 2]], np.uint64)
+        agg.add_host_rows(keys, sums, np.array([1, 1]))
+        assert agg._pending_host  # still queued
+        agg.watermark = 10_000
+        rows = agg.flush(force=True)
+        assert rows["bytes"].tolist() == [15]
+        assert rows["packets"].tolist() == [3]
+        assert rows["count"].tolist() == [2]
+
+    def test_eligible_modes(self):
+        assert HostGroupPipeline.eligible("on")
+        assert not HostGroupPipeline.eligible("off")
+        # tests force the CPU backend (conftest), so auto must pick it
+        assert HostGroupPipeline.eligible("auto")
+        with pytest.raises(ValueError):  # typos must not silently mean auto
+            HostGroupPipeline.eligible("true")
+
+
+def test_worker_host_assist_vs_device_sink_rows():
+    """Integration: the same stream through host_assist on/off workers
+    lands identical flows_5m rows in the sink."""
+    class CollectSink:
+        def __init__(self):
+            self.rows: dict[str, list] = {}
+
+        def write(self, table, rows):
+            self.rows.setdefault(table, []).append(rows)
+
+    out = {}
+    for assist in ("on", "off"):
+        bus = InProcessBus()
+        bus.create_topic("flows", 1)
+        for b in make_stream():
+            for frame in wire.iter_raw_frames(b.to_wire()):
+                bus.produce("flows", frame)
+        sink = CollectSink()
+        worker = StreamWorker(
+            Consumer(bus, fixedlen=True),
+            make_models(WINDOW, 100),
+            [sink],
+            WorkerConfig(poll_max=BS, snapshot_every=0, host_assist=assist),
+        )
+        assert isinstance(worker.fused, HostGroupPipeline) == (assist == "on")
+        worker.run(stop_when_idle=True)
+        rows = [canon_rows(r) for r in sink.rows.get("flows_5m", [])]
+        out[assist] = sorted(sum(rows, []))
+    assert out["on"] == out["off"]

@@ -3,7 +3,7 @@
 The single-chip tunnel's grant is scarce (observed: one successful grant,
 then re-acquisition hangs), so this script acquires the backend ONCE and
 runs the entire docs/TPU.md playbook in-process, emitting one JSON line
-per result to stdout (the watcher appends stdout to TPU_r03.jsonl):
+per result to stdout (the watcher appends stdout to TPU_r04.jsonl):
 
   1. flagship heavy-hitter bench + XLA cost-analysis roofline/MFU
   2. CMS shootout (XLA scatter vs Pallas dense-tile, lin + conservative)
@@ -16,9 +16,9 @@ per result to stdout (the watcher appends stdout to TPU_r03.jsonl):
 
 Each section is independently try/except'd: a mid-run tunnel death still
 leaves every earlier line on disk. Markers:
-  TPU_r03.init    -- written the moment backend init returns (watcher
+  TPU_r04.init    -- written the moment backend init returns (watcher
                      uses its absence at +300s to kill a hung attempt)
-  TPU_r03.done    -- written after the last section (watcher stops)
+  TPU_r04.done    -- written after the last section (watcher stops)
 """
 
 from __future__ import annotations
@@ -62,7 +62,7 @@ def main() -> None:
     import jax
 
     dev = jax.devices()[0]
-    with open(os.path.join(REPO, "TPU_r03.init"), "w") as f:
+    with open(os.path.join(REPO, "TPU_r04.init"), "w") as f:
         f.write(f"{time.time()}\n{dev}\n")
     emit({"section": "init", "status": "ok", "device": str(dev),
           "device_kind": dev.device_kind, "platform": dev.platform,
@@ -75,6 +75,9 @@ def main() -> None:
 
     @section("flagship")
     def run_flagship():
+        # e2e runs as its own section below; don't pay the full-model
+        # compile + stream twice on the scarce single-grant tunnel
+        bench._SKIP_E2E_IN_MAIN = True
         bench.main()
 
     @section("cms_shootout")
@@ -171,7 +174,7 @@ def main() -> None:
                  run_e2e, run_trace):
         step()
 
-    with open(os.path.join(REPO, "TPU_r03.done"), "w") as f:
+    with open(os.path.join(REPO, "TPU_r04.done"), "w") as f:
         f.write(f"{time.time()}\n")
     emit({"section": "capture", "status": "done"})
 

@@ -1,10 +1,10 @@
 #!/bin/bash
 # TPU grant watcher (VERDICT r2 item 1: "check it daily" -> check it
 # continuously). Launches tools/tpu_capture.py; if backend init hasn't
-# completed within INIT_WAIT seconds (no TPU_r03.init marker), kills the
+# completed within INIT_WAIT seconds (no TPU_r04.init marker), kills the
 # attempt and retries after a cooldown — a hung grant never wastes more
 # than INIT_WAIT + cooldown. A successful init gets RUN_WAIT to finish
-# the whole playbook. Stops on TPU_r03.done.
+# the whole playbook. Stops on TPU_r04.done.
 set -u
 cd /root/repo
 INIT_WAIT=${INIT_WAIT:-300}
@@ -13,16 +13,16 @@ COOLDOWN=${COOLDOWN:-420}
 ATTEMPTS=${ATTEMPTS:-60}
 
 for i in $(seq 1 "$ATTEMPTS"); do
-  [ -f TPU_r03.done ] && exit 0
-  rm -f TPU_r03.init
+  [ -f TPU_r04.done ] && exit 0
+  rm -f TPU_r04.init
   echo "=== attempt $i $(date -Is) ===" >> TPU_capture.log
-  python -u tools/tpu_capture.py >> TPU_r03.jsonl 2>> TPU_capture.log &
+  python -u tools/tpu_capture.py >> TPU_r04.jsonl 2>> TPU_capture.log &
   pid=$!
   waited=0
   while kill -0 "$pid" 2>/dev/null; do
     sleep 10
     waited=$((waited + 10))
-    if [ ! -f TPU_r03.init ] && [ "$waited" -ge "$INIT_WAIT" ]; then
+    if [ ! -f TPU_r04.init ] && [ "$waited" -ge "$INIT_WAIT" ]; then
       echo "attempt $i: init hung ${waited}s, killing" >> TPU_capture.log
       kill -9 "$pid" 2>/dev/null
       break
@@ -35,6 +35,6 @@ for i in $(seq 1 "$ATTEMPTS"); do
   done
   wait "$pid" 2>/dev/null
   echo "attempt $i done rc=$? waited=${waited}s" >> TPU_capture.log
-  [ -f TPU_r03.done ] && exit 0
+  [ -f TPU_r04.done ] && exit 0
   sleep "$COOLDOWN"
 done
