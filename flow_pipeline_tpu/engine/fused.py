@@ -85,7 +85,10 @@ def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs):
     unfused models' module-level jits are. All spec elements are frozen
     config dataclasses / string tuples, so the key is hashable.
     """
-    wagg_fns = tuple(_cached_wagg_update(c.window_seconds, c.key_cols,
+    from ..models.window_agg import group_cols as _wagg_group_cols
+
+    wagg_fns = tuple(_cached_wagg_update(c.window_seconds,
+                                         _wagg_group_cols(c),
                                          c.value_cols) for c in wagg_cfgs)
     hh_b = any(plan[0] == "B" for plan, _ in hh_specs)
     need_b = hh_b or bool(ddos_cfgs)
@@ -109,7 +112,9 @@ def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs):
             if j in absorbed or j == i:
                 continue
             ck = hh_specs[j][1].key_cols
-            if len(ck) < len(pk) and pk[:len(ck)] == ck:
+            if (len(ck) < len(pk) and pk[:len(ck)] == ck
+                    and hh_specs[j][1].scale_col
+                    == hh_specs[i][1].scale_col):
                 members.append(j)
                 absorbed.add(j)
         if len(members) > 1:
@@ -121,6 +126,13 @@ def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs):
         # int32 bit-patterns of uint32 counters: reinterpret unsigned
         # before the float cast so saturated values stay positive
         return col.astype(jnp.uint32).astype(jnp.float32)
+
+    def rate_of(cols, cfg):
+        # serving-side sampling factor (see HeavyHitterConfig.scale_col);
+        # rate 0 ("unknown") scales by 1
+        if not getattr(cfg, "scale_col", None):
+            return None
+        return jnp.maximum(to_f32(cols[cfg.scale_col]), 1.0)
 
     def step(states, cols, valid, valid_hh, valid_dd):
         hh_states, dense_tots, ddos_states = states
@@ -143,6 +155,9 @@ def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs):
             sk = jnp.where(valid_hh[:, None], full_lanes.astype(jnp.uint32),
                            _SENTINEL)[perm]
             sv = jnp.stack([to_f32(cols[c]) for c in hh_vals], axis=1)
+            r = rate_of(cols, parent_cfg)  # members share scale_col
+            if r is not None:
+                sv = sv * r[:, None]
             sv = jnp.where(valid_hh[:, None], sv, 0.0)[perm]
             sc = valid_hh[perm].astype(jnp.int32)
             for level, m in enumerate(members):
@@ -168,12 +183,21 @@ def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs):
             vbp, vdp = vb[perm], vd[perm]
             planes, cnts = [], []
             if hh_b:
+                b_cfg = next(cfg for plan, cfg in hh_specs
+                             if plan[0] == "B")
+                rb = rate_of(cols, b_cfg)
                 for c in hh_vals:
-                    planes.append(jnp.where(vbp, to_f32(cols[c])[perm], 0.0))
+                    p = to_f32(cols[c])
+                    if rb is not None:
+                        p = p * rb
+                    planes.append(jnp.where(vbp, p[perm], 0.0))
                 cnts.append(vbp.astype(jnp.int32))
             for dcfg in ddos_cfgs[:1]:  # detectors share cadence+col set
-                planes.append(
-                    jnp.where(vdp, to_f32(cols[dcfg.value_col])[perm], 0.0))
+                p = to_f32(cols[dcfg.value_col])
+                rd = rate_of(cols, dcfg)
+                if rd is not None:
+                    p = p * rd
+                planes.append(jnp.where(vdp, p[perm], 0.0))
                 cnts.append(vdp.astype(jnp.int32))
             sv_b = jnp.stack(planes, axis=1)
             sc_b = jnp.stack(cnts, axis=1)  # [N, nc]
@@ -203,6 +227,9 @@ def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs):
                 lanes = hh._key_lanes(cols, cfg.key_cols)
                 vals = jnp.stack(
                     [to_f32(cols[c]) for c in cfg.value_cols], axis=1)
+                r = rate_of(cols, cfg)
+                if r is not None:
+                    vals = vals * r[:, None]
                 uniq, sums, counts = hash_groupby_float(
                     lanes, vals, valid_hh)
             sums3 = jnp.concatenate(
@@ -304,14 +331,21 @@ class FusedPipeline:
                 if n not in cols:
                     cols.append(n)
 
+        def scale_of(cfg):
+            return (cfg.scale_col,) if getattr(cfg, "scale_col", None) \
+                else ()
+
         for _, m in self._waggs:
-            add("time_received", *m.config.key_cols, *m.config.value_cols)
+            add("time_received", *m.config.key_cols, *m.config.value_cols,
+                *scale_of(m.config))
         for _, w in self._hh:
-            add(*w.config.key_cols, *w.config.value_cols)
+            add(*w.config.key_cols, *w.config.value_cols,
+                *scale_of(w.config))
         for _, w in self._dense:
-            add(w.config.key_col, *w.config.value_cols)
+            add(w.config.key_col, *w.config.value_cols,
+                *scale_of(w.config))
         for _, d in self._ddos:
-            add("dst_addr", d.config.value_col)
+            add("dst_addr", d.config.value_col, *scale_of(d.config))
         return tuple(cols)
 
     # ---- host lifecycle ---------------------------------------------------

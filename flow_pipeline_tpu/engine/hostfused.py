@@ -73,10 +73,17 @@ def _key_lanes_np(cols: dict, key_cols) -> np.ndarray:
     return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
 
 
-def _value_planes_np(cols: dict, value_cols) -> np.ndarray:
-    """[N, P] float32 value planes with the device path's u32 saturation."""
-    return np.stack([_u32_lane(cols[name]).astype(np.float32)
-                     for name in value_cols], axis=1)
+def _value_planes_np(cols: dict, value_cols,
+                     scale_col: str | None = None) -> np.ndarray:
+    """[N, P] float32 value planes with the device path's u32 saturation,
+    multiplied by max(<scale_col>, 1) when sampling scaling is on (same
+    f32 factor the device step applies)."""
+    planes = np.stack([_u32_lane(cols[name]).astype(np.float32)
+                       for name in value_cols], axis=1)
+    if scale_col:
+        r = np.maximum(_u32_lane(cols[scale_col]).astype(np.float32), 1.0)
+        planes = planes * r[:, None]
+    return planes
 
 
 def _pow2_bucket(n: int, hi: int, lo: int = 1024) -> int:
@@ -161,7 +168,8 @@ class HostGroupPipeline(FusedPipeline):
             for j in planned:
                 if (set(cfgs[i].key_cols) < set(cfgs[j].key_cols)
                         and tuple(cfgs[i].value_cols)
-                        == tuple(cfgs[j].value_cols)):
+                        == tuple(cfgs[j].value_cols)
+                        and cfgs[i].scale_col == cfgs[j].scale_col):
                     if parent is None or len(cfgs[j].key_cols) < len(
                             cfgs[parent].key_cols):
                         parent = j
@@ -179,7 +187,8 @@ class HostGroupPipeline(FusedPipeline):
             dcfg = self._ddos[0][1].config
             for j, c in enumerate(cfgs):
                 if ("dst_addr" in c.key_cols
-                        and dcfg.value_col in c.value_cols):
+                        and dcfg.value_col in c.value_cols
+                        and c.scale_col == dcfg.scale_col):
                     self._ddos_plan = (
                         "cascade", j,
                         tuple(select_lanes(c.key_cols, {
@@ -224,6 +233,8 @@ class HostGroupPipeline(FusedPipeline):
         for name in cfg.key_cols:
             a = _u32_lane(cols[name])
             lanes.append(a if a.ndim == 2 else a[:, None])
+        if cfg.scale_col:  # rate lane LAST, matching group_cols(cfg)
+            lanes.append(_u32_lane(cols[cfg.scale_col])[:, None])
         lanes = np.concatenate(lanes, axis=1)
         planes = [np.minimum(cols[name], _U32_MAX) for name in cfg.value_cols]
         uniq, sums, counts = group_by_key(lanes, [np.stack(planes, axis=1)])
@@ -239,7 +250,7 @@ class HostGroupPipeline(FusedPipeline):
                 continue
             cfg = w.config
             lanes = _key_lanes_np(cols, cfg.key_cols)
-            vals = _value_planes_np(cols, cfg.value_cols)
+            vals = _value_planes_np(cols, cfg.value_cols, cfg.scale_col)
             uniq, sums, counts = group_by_key(lanes, [vals], exact=False)
             out[i] = (uniq, sums[0], counts)
         for i, plan in enumerate(self._fam_plan):
@@ -260,7 +271,8 @@ class HostGroupPipeline(FusedPipeline):
                 out.append((uniq, sums[0].astype(np.float32)))
             else:
                 lanes = _key_lanes_np(cols, ("dst_addr",))
-                vals = _u32_lane(cols[dcfg.value_col]).astype(np.float32)
+                vals = _value_planes_np(cols, (dcfg.value_col,),
+                                        dcfg.scale_col)[:, 0]
                 uniq, sums, _ = group_by_key(lanes, [vals], exact=False)
                 out.append((uniq, sums[0].astype(np.float32)))
         return out
@@ -293,6 +305,8 @@ class HostGroupPipeline(FusedPipeline):
             for _, w in self._dense:
                 need.add(w.config.key_col)
                 need.update(w.config.value_cols)
+                if w.config.scale_col:
+                    need.add(w.config.scale_col)
             bs = self._bs
             dcols = {}
             for name in need:

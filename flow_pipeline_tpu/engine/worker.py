@@ -340,10 +340,24 @@ class StreamWorker:
                             "%r; skipping", name)
                 continue
             if ms["kind"] == "window_agg":
-                model.windows = {
+                windows = {
                     int(slot): {k: v for k, v in store.items()}
                     for slot, store in ms["windows"].items()
                 }
+                want = model.store_key_lanes
+                bad = next((k for store in windows.values()
+                            for k in store if len(k) != want), None)
+                if bad is not None:
+                    # a checkpoint from a different grouping layout (e.g.
+                    # pre-sampling builds without the rate lane): restoring
+                    # it would mis-split key tuples at flush and emit
+                    # garbage keys — skip loudly; open windows start over
+                    log.warning(
+                        "checkpoint window keys have %d lanes, model "
+                        "%r expects %d; skipping its window state",
+                        len(bad), name, want)
+                else:
+                    model.windows = windows
                 model.watermark = ms["watermark"]
             elif ms["kind"] in ("windowed_hh", "windowed_dense"):
                 want = getattr(model.model, "snapshot_kind", None)

@@ -49,6 +49,19 @@ class DDoSConfig:
     batch_size: int = 8192
     value_col: str = "packets"
     rel_err: float = 0.01
+    # Serving-side sampling correction (see HeavyHitterConfig.scale_col):
+    # rates reflect the TRUE per-dst traffic the samples represent, so a
+    # 1:1000-sampled flood trips the same z-score gate an unsampled one
+    # would. float32 multiply; None disables.
+    scale_col: str | None = "sampling_rate"
+
+
+def ddos_input_cols(config: "DDoSConfig") -> list[str]:
+    """Columns the accumulate step reads."""
+    out = ["dst_addr", config.value_col]
+    if config.scale_col:
+        out.append(config.scale_col)
+    return out
 
 
 class DDoSState(NamedTuple):
@@ -109,6 +122,10 @@ def ddos_accumulate(state: DDoSState, cols: dict, valid, *, config: DDoSConfig):
     dst = cols["dst_addr"].astype(jnp.uint32)
     # uint32 reinterpretation keeps saturated counters (>2^31) positive
     vals = cols[config.value_col].astype(jnp.uint32).astype(jnp.float32)
+    if config.scale_col:
+        vals = vals * jnp.maximum(
+            cols[config.scale_col].astype(jnp.uint32).astype(jnp.float32),
+            1.0)
     uniq, sums, counts = sort_groupby_float(dst, vals[:, None], valid)
     return _accumulate_grouped(state, uniq, sums[:, 0], counts > 0, config)
 
@@ -183,7 +200,7 @@ class DDoSDetector:
         bs = self.config.batch_size
         for start in range(0, len(batch), bs):  # chunk arbitrary batch sizes
             padded, mask = batch.slice(start, start + bs).pad_to(bs)
-            cols = padded.device_columns(["dst_addr", self.config.value_col])
+            cols = padded.device_columns(ddos_input_cols(self.config))
             cols = {k: jnp.asarray(v) for k, v in cols.items()}
             self.state = ddos_accumulate(
                 self.state, cols, jnp.asarray(mask), config=self.config

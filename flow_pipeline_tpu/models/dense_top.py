@@ -55,6 +55,12 @@ class DenseTopConfig:
     domain: int = 1 << 16
     value_cols: tuple[str, ...] = ("bytes", "packets")  # plane 0 ranks
     batch_size: int = 8192
+    # Serving-side sampling correction (see HeavyHitterConfig.scale_col):
+    # each per-row value is multiplied by max(<scale_col>, 1) in uint32
+    # with saturation at 2^32-1 — exact whenever value*rate < 2^32
+    # (bytes < 1500 covers rates to ~2.8M; a saturated row clamps, the
+    # same contract device_columns applies to oversized raw counters).
+    scale_col: str | None = "sampling_rate"
 
 
 # Largest sub-batch whose scatter partial stays int32-exact when every
@@ -63,6 +69,14 @@ class DenseTopConfig:
 # sub-chunks inside the jit — a power of two so the common TPU-friendly
 # batch sizes divide evenly (no ragged trailing scatter).
 _DENSE_SUB_MAX = 32768
+
+
+def dense_input_cols(config: DenseTopConfig) -> list[str]:
+    """Columns the update step reads: key + values + the scale column."""
+    out = [config.key_col, *config.value_cols]
+    if config.scale_col:
+        out.append(config.scale_col)
+    return out
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("totals",))
@@ -79,6 +93,16 @@ def dense_update(totals, cols, valid, *, config: DenseTopConfig):
     # "drop" mode (a negative index would wrap before the check)
     key_full = jnp.where(valid, key_full, config.domain)
     lanes = [cols[name].astype(jnp.uint32) for name in config.value_cols]
+    if config.scale_col:
+        rate = jnp.maximum(cols[config.scale_col].astype(jnp.uint32),
+                           jnp.uint32(1))
+        # saturating u32 multiply: u32*u32 wraps in XLA, so detect
+        # overflow with a per-row division bound and clamp — exact
+        # whenever value*rate < 2^32
+        def _scale(v):
+            lim = jnp.uint32(0xFFFFFFFF) // jnp.maximum(v, jnp.uint32(1))
+            return jnp.where(rate > lim, jnp.uint32(0xFFFFFFFF), v * rate)
+        lanes = [_scale(v) for v in lanes]
     lanes.append(jnp.ones(key_full.shape[0], jnp.uint32))  # count
     lo = jnp.stack([(v & jnp.uint32(0xFFFF)).astype(jnp.int32)
                     for v in lanes], axis=1)
@@ -143,9 +167,7 @@ class DenseTopKModel:
         bs = self.config.batch_size
         for start in range(0, len(batch), bs):
             padded, mask = batch.slice(start, start + bs).pad_to(bs)
-            cols = padded.device_columns(
-                [self.config.key_col, *self.config.value_cols]
-            )
+            cols = padded.device_columns(dense_input_cols(self.config))
             cols = {k: jnp.asarray(v) for k, v in cols.items()}
             self.totals = dense_update(
                 self.totals, cols, jnp.asarray(mask), config=self.config

@@ -61,6 +61,14 @@ class HeavyHitterConfig:
     # — flatter than real flow traffic); disable for adversarially
     # uniform streams where no heavy key ranks within any single batch.
     table_prefilter: bool = True
+    # Serving-side sampling correction: multiply every value plane by
+    # max(<scale_col>, 1) per row, so ranked bytes/packets estimate the
+    # TRUE traffic the samples represent — the reference's dashboards
+    # apply the same factor at query time (ref: compose/grafana/
+    # dashboards/viz-ch.json sum(Bytes*SamplingRate)). float32 multiply:
+    # sketches are approximate by contract. None disables. With the
+    # mocker (rate 1) outputs are unchanged.
+    scale_col: str | None = "sampling_rate"
 
 
 class HHState(NamedTuple):
@@ -73,6 +81,14 @@ class HHState(NamedTuple):
 
 def key_width(config: HeavyHitterConfig) -> int:
     return sum(lane_width(name) for name in config.key_cols)
+
+
+def input_cols(config: HeavyHitterConfig) -> list[str]:
+    """Columns the update step reads: keys + values + the scale column."""
+    out = [*config.key_cols, *config.value_cols]
+    if config.scale_col:
+        out.append(config.scale_col)
+    return out
 
 
 def hh_init(config: HeavyHitterConfig) -> HHState:
@@ -149,12 +165,17 @@ def hh_update(state: HHState, cols: dict, valid, *, config: HeavyHitterConfig) -
     # Columns arrive as int32 bit-patterns of uint32 counters; reinterpret as
     # unsigned before the float cast so saturated values (>2^31) stay
     # positive — a negative addend would break the CMS upper-bound invariant.
+    planes = [
+        cols[name].astype(jnp.uint32).astype(jnp.float32)
+        for name in config.value_cols
+    ]
+    if config.scale_col:
+        rate = jnp.maximum(
+            cols[config.scale_col].astype(jnp.uint32).astype(jnp.float32),
+            1.0)
+        planes = [p * rate for p in planes]
     values = jnp.stack(
-        [
-            cols[name].astype(jnp.uint32).astype(jnp.float32)
-            for name in config.value_cols
-        ]
-        + [jnp.ones(keys.shape[0], jnp.float32)],
+        planes + [jnp.ones(keys.shape[0], jnp.float32)],
         axis=1,
     )
     # Hash-grouped pre-agg: sorting the 64-bit key hash (2 lanes) instead
@@ -185,9 +206,7 @@ class HeavyHitterModel:
         bs = self.config.batch_size
         for start in range(0, len(batch), bs):  # chunk arbitrary batch sizes
             padded, mask = batch.slice(start, start + bs).pad_to(bs)
-            cols = padded.device_columns(
-                [*self.config.key_cols, *self.config.value_cols]
-            )
+            cols = padded.device_columns(input_cols(self.config))
             cols = {k: jnp.asarray(v) for k, v in cols.items()}
             self.state = hh_update(
                 self.state, cols, jnp.asarray(mask), config=self.config

@@ -43,12 +43,19 @@ def exact_groupby(
     key_cols: list[str],
     value_cols: list[str] = ("bytes", "packets"),
     timeslot: bool = True,
+    scale_col: str | None = None,
 ) -> dict[str, np.ndarray]:
     """Exact groupby-sum over arbitrary key tuples.
 
     Returns a dict with one array per key column (addresses as [G,4]),
     optionally a leading ``timeslot`` key, summed ``value_cols`` (uint64),
     and ``count``. Rows are in lexicographic key order.
+
+    With ``scale_col`` the dict additionally carries exact uint64
+    ``<value>_scaled`` sums of value * max(rate, 1) — the reference's
+    query-time ``sum(Bytes*SamplingRate)`` semantics
+    (ref: compose/grafana/dashboards/viz-ch.json), ground truth for the
+    sampling-corrected serving path.
     """
     keys = _key_matrix(batch, key_cols, timeslot)
     # Row-wise unique via void view (contiguous rows as opaque keys)
@@ -69,12 +76,19 @@ def exact_groupby(
         cols = uniq_rows[:, col_idx : col_idx + w]
         out[name] = cols if w == 4 else cols[:, 0]
         col_idx += w
+    rate = None
+    if scale_col is not None:
+        rate = np.maximum(batch.columns[scale_col].astype(np.uint64), 1)
     for name in value_cols:
         # np.add.at, not float bincount: uint64-exact accumulation
         vals = batch.columns[name].astype(np.uint64)
         acc = np.zeros(g, dtype=np.uint64)
         np.add.at(acc, inverse, vals)
         out[name] = acc
+        if rate is not None:
+            sacc = np.zeros(g, dtype=np.uint64)
+            np.add.at(sacc, inverse, vals * rate)
+            out[f"{name}_scaled"] = sacc
     out["count"] = np.bincount(inverse, minlength=g).astype(np.uint64)
     return out
 

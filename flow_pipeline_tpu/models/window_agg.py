@@ -43,6 +43,27 @@ class WindowAggConfig:
     window_seconds: int = SECONDS_PER_SLOT
     allowed_lateness: int = 0
     batch_size: int = 8192  # static shape; shorter batches are padded
+    # Sampling-rate-correct serving: the reference's bps panels multiply
+    # by the exporter sampling rate at query time over raw rows
+    # (ref: compose/grafana/dashboards/viz.json:62 sum(bytes*sampling_
+    # rate*8), viz-ch.json sum(Bytes*SamplingRate)); a pre-aggregated
+    # serving table must bake that in or the information is gone. The
+    # rate rides as ONE extra grouping lane (cardinality = #distinct
+    # exporter rates, i.e. tiny) and flush() emits exact uint64
+    # ``<value>_scaled`` columns next to the raw sums — raw flows_5m
+    # parity is untouched. None disables (pre-r4 behavior). A rate of 0
+    # ("unknown/unsampled", what GoFlow emits without an options
+    # template) scales by 1, not 0: dropping all traffic from a panel
+    # because an exporter didn't announce its rate helps nobody.
+    scale_col: Optional[str] = "sampling_rate"
+
+
+def group_cols(config: WindowAggConfig) -> tuple[str, ...]:
+    """Grouping lanes for the device/host step: key columns plus the
+    sampling-rate lane when scaled serving is on."""
+    if config.scale_col:
+        return (*config.key_cols, config.scale_col)
+    return config.key_cols
 
 
 def _build_update(config: WindowAggConfig):
@@ -51,7 +72,7 @@ def _build_update(config: WindowAggConfig):
     batch_size only shapes the inputs (jit re-specializes per shape
     anyway) and allowed_lateness is host-side, so neither may fragment
     the cache."""
-    return _cached_update(config.window_seconds, config.key_cols,
+    return _cached_update(config.window_seconds, group_cols(config),
                           config.value_cols)
 
 
@@ -155,6 +176,15 @@ class WindowAggregator:
         # host-grouped rows not yet folded (engine.hostfused's path)
         self._pending_host: list = []
 
+    @property
+    def store_key_lanes(self) -> int:
+        """Width of the window-store key tuples (excludes the timeslot,
+        which is the dict key) — restore uses this to reject checkpoints
+        written under a different grouping layout (e.g. pre-sampling
+        builds without the rate lane)."""
+        return sum(lane_width(n) for n in self.config.key_cols) + (
+            1 if self.config.scale_col else 0)
+
     def update(self, batch: FlowBatch) -> None:
         if len(batch) == 0:
             return
@@ -168,7 +198,8 @@ class WindowAggregator:
     def _update_chunk(self, batch: FlowBatch) -> None:
         padded, mask = batch.pad_to(self.config.batch_size)
         host_cols = padded.device_columns(
-            ["time_received", *self.config.key_cols, *self.config.value_cols]
+            ["time_received", *group_cols(self.config),
+             *self.config.value_cols]
         )
         cols = {name: jnp.asarray(arr) for name, arr in host_cols.items()}
         valid = jnp.asarray(mask)
@@ -181,7 +212,7 @@ class WindowAggregator:
         host memory, not HBM — the device budget DRAIN_PENDING_MAX is
         sized for counts only the small partials."""
         exact = _cached_update_exact(self.config.window_seconds,
-                                     self.config.key_cols,
+                                     group_cols(self.config),
                                      self.config.value_cols)
 
         def run():
@@ -332,22 +363,50 @@ class WindowAggregator:
         )
 
     def flush(self, force: bool = False) -> dict[str, np.ndarray]:
-        """Pop finalized windows (all, if force) as columnar rows."""
+        """Pop finalized windows (all, if force) as columnar rows.
+
+        With ``scale_col`` set the window store is keyed by
+        (*key lanes, sampling_rate); flush folds the per-rate subgroups
+        back to the reference key shape and emits exact uint64
+        ``<value>_scaled`` columns (sum over rates of sum(value) * rate,
+        rate 0 treated as 1) alongside the raw sums — the serving-side
+        equivalent of the reference's query-time
+        ``sum(Bytes*SamplingRate)``."""
         self._drain()
         slots = sorted(self.windows) if force else self.closed_slots()
-        rows_ts, rows_key, rows_val = [], [], []
+        scaled = self.config.scale_col is not None
+        nvals = len(self.config.value_cols)
+        rows_ts, rows_key, rows_val, rows_scaled = [], [], [], []
         for slot in slots:
-            for key, acc in sorted(self.windows.pop(slot).items()):
+            store = self.windows.pop(slot)
+            if scaled:
+                merged: dict[tuple, list] = {}
+                for key, acc in store.items():
+                    base, rate = key[:-1], max(int(key[-1]), 1)
+                    s = acc[:nvals] * np.uint64(rate)
+                    ent = merged.get(base)
+                    if ent is None:
+                        merged[base] = [acc.copy(), s]
+                    else:
+                        ent[0] += acc
+                        ent[1] += s
+                items = ((k, v[0], v[1]) for k, v in sorted(merged.items()))
+            else:
+                items = ((k, v, None) for k, v in sorted(store.items()))
+            for key, acc, s in items:
                 rows_ts.append(slot)
                 rows_key.append(key)
                 rows_val.append(acc)
-        nvals = len(self.config.value_cols)
+                rows_scaled.append(s)
         if not rows_ts:
             empty = {"timeslot": np.zeros(0, np.uint64)}
             for name in self.config.value_cols + ("count",):
                 empty[name] = np.zeros(0, np.uint64)
             for name in self.config.key_cols:
                 empty[name] = np.zeros(0, np.uint64)
+            if scaled:
+                for name in self.config.value_cols:
+                    empty[f"{name}_scaled"] = np.zeros(0, np.uint64)
             return empty
         key_arr = np.asarray(rows_key, dtype=np.uint64)
         val_arr = np.asarray(rows_val, dtype=np.uint64)
@@ -363,4 +422,8 @@ class WindowAggregator:
         for j, name in enumerate(self.config.value_cols):
             out[name] = val_arr[:, j]
         out["count"] = val_arr[:, nvals]
+        if scaled:
+            scaled_arr = np.asarray(rows_scaled, dtype=np.uint64)
+            for j, name in enumerate(self.config.value_cols):
+                out[f"{name}_scaled"] = scaled_arr[:, j]
         return out

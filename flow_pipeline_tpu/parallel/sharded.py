@@ -35,6 +35,7 @@ from ..models.window_agg import (
     WindowAggregator,
     _cached_update,
     _cached_update_exact,
+    group_cols,
 )
 from ..ops import topk as topk_ops
 from ..schema.batch import FlowBatch
@@ -133,9 +134,7 @@ class ShardedHeavyHitter:
         gb = self.global_batch
         for start in range(0, len(batch), gb):
             padded, mask = batch.slice(start, start + gb).pad_to(gb)
-            cols = padded.device_columns(
-                [*self.config.key_cols, *self.config.value_cols]
-            )
+            cols = padded.device_columns(hh.input_cols(self.config))
             cols, valid = shard_batch_columns(self.mesh, cols, mask)
             self.state = self._update(self.state, cols, valid)
 
@@ -256,11 +255,11 @@ class ShardedWindowAggregator(WindowAggregator):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_dev = self.mesh.devices.size
         self._sharded = _sharded_window_update(
-            self.mesh, config.window_seconds, config.key_cols,
+            self.mesh, config.window_seconds, group_cols(config),
             config.value_cols,
         )
         self._sharded_exact = _sharded_window_update_exact(
-            self.mesh, config.window_seconds, config.key_cols,
+            self.mesh, config.window_seconds, group_cols(config),
             config.value_cols,
         )
 
@@ -281,7 +280,8 @@ class ShardedWindowAggregator(WindowAggregator):
     def _update_sharded_chunk(self, batch: FlowBatch) -> None:
         padded, mask = batch.pad_to(self.global_batch)
         cols = padded.device_columns(
-            ["time_received", *self.config.key_cols, *self.config.value_cols]
+            ["time_received", *group_cols(self.config),
+             *self.config.value_cols]
         )
         cols, valid = shard_batch_columns(self.mesh, cols, mask)
         # stacked partials stay on device until a flush drains them
@@ -392,7 +392,8 @@ class ShardedDDoSDetector(ddos_mod.DDoSDetector):
         gb = self.global_batch
         for start in range(0, len(batch), gb):
             padded, mask = batch.slice(start, start + gb).pad_to(gb)
-            cols = padded.device_columns(["dst_addr", self.config.value_col])
+            cols = padded.device_columns(
+                ddos_mod.ddos_input_cols(self.config))
             cols, valid = shard_batch_columns(self.mesh, cols, mask)
             self.state = self._acc(self.state, cols, valid)
 
@@ -455,8 +456,7 @@ class ShardedDenseTopK(dense_mod.DenseTopKModel):
         for start in range(0, len(batch), gb):
             padded, mask = batch.slice(start, start + gb).pad_to(gb)
             cols = padded.device_columns(
-                [self.config.key_col, *self.config.value_cols]
-            )
+                dense_mod.dense_input_cols(self.config))
             cols, valid = shard_batch_columns(self.mesh, cols, mask)
             self.totals = self._update(self.totals, cols, valid)
 

@@ -105,6 +105,8 @@ class ClickHouseSink:
         "bytes": "Bytes",
         "packets": "Packets",
         "count": "Count",
+        "bytes_scaled": "Bytes_scaled",
+        "packets_scaled": "Packets_scaled",
     }
 
     def write(self, table: str, rows) -> None:

@@ -28,15 +28,22 @@ CREATE TABLE IF NOT EXISTS flows (
 );
 """
 
+# bytes_scaled/packets_scaled: sampling-rate-corrected sums
+# (sum over rows of value * max(sampling_rate, 1)) — what the reference
+# computes at query time over raw rows (sum(bytes*sampling_rate), ref:
+# compose/grafana/dashboards/viz.json:62); pre-aggregated serving must
+# store it or the rate information is unrecoverable.
 POSTGRES_FLOWS_5M = """
 CREATE TABLE IF NOT EXISTS flows_5m (
-    timeslot  BIGINT,
-    src_as    BIGINT,
-    dst_as    BIGINT,
-    etype     INT,
-    bytes     BIGINT,
-    packets   BIGINT,
-    count     BIGINT
+    timeslot       BIGINT,
+    src_as         BIGINT,
+    dst_as         BIGINT,
+    etype          INT,
+    bytes          BIGINT,
+    packets        BIGINT,
+    count          BIGINT,
+    bytes_scaled   BIGINT,
+    packets_scaled BIGINT
 );
 """
 
@@ -226,7 +233,9 @@ CREATE TABLE IF NOT EXISTS flows_5m (
     EType UInt32,
     Bytes UInt64,
     Packets UInt64,
-    Count UInt64
+    Count UInt64,
+    Bytes_scaled UInt64,
+    Packets_scaled UInt64
 ) ENGINE = SummingMergeTree()
 ORDER BY (Date, Timeslot, SrcAS, DstAS, EType);
 """
@@ -235,7 +244,7 @@ ORDER BY (Date, Timeslot, SrcAS, DstAS, EType);
 # of truth; the sinks must not drift from each other or from the DDL above).
 TABLE_COLUMNS = {
     "flows_5m": ["timeslot", "src_as", "dst_as", "etype", "bytes", "packets",
-                 "count"],
+                 "count", "bytes_scaled", "packets_scaled"],
     "top_talkers": ["timeslot", "rank", "src_addr", "dst_addr", "src_port",
                     "dst_port", "proto", "bytes", "packets", "count"],
     "top_src_ips": ["timeslot", "rank", "src_addr", "bytes", "packets",
@@ -289,7 +298,8 @@ CREATE TABLE IF NOT EXISTS flows (
     "flows_5m": """
 CREATE TABLE IF NOT EXISTS flows_5m (
     timeslot INTEGER, src_as INTEGER, dst_as INTEGER, etype INTEGER,
-    bytes INTEGER, packets INTEGER, count INTEGER
+    bytes INTEGER, packets INTEGER, count INTEGER,
+    bytes_scaled INTEGER, packets_scaled INTEGER
 );
 """,
     "top_talkers": """

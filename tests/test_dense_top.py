@@ -197,7 +197,8 @@ class TestLargeBatchExactness:
         )
 
         for n in (32768, 65536):
-            cfg = DenseTopConfig(key_col="src_port", batch_size=n)
+            cfg = DenseTopConfig(key_col="src_port", batch_size=n,
+                                 scale_col=None)
             totals = jnp.zeros((cfg.domain, 3, 2), jnp.int32)
             cols = {
                 "src_port": jnp.full(n, 443, jnp.int32),

@@ -214,6 +214,7 @@ class TestDDoS:
         cols = {
             "dst_addr": jnp.zeros((8, 4), jnp.int32),
             "packets": jnp.ones(8, jnp.int32),
+            "sampling_rate": jnp.ones(8, jnp.int32),
         }
         state = ddos_accumulate(state, cols, jnp.zeros(8, bool), config=config)
         assert np.asarray(state.addrs)[15].tolist() == [7, 7, 7, 7]

@@ -63,7 +63,7 @@ class TestLocalShardFeederSingleProcess:
         # feed through the multihost placement path instead of device_put
         padded, mask = batch.pad_to(2048)
         cols = padded.device_columns(
-            ["src_addr", "dst_addr", "bytes", "packets"]
+            ["src_addr", "dst_addr", "bytes", "packets", "sampling_rate"]
         )
         fed, valid = feeder.feed_columns(
             {k: np.asarray(v) for k, v in cols.items()}, np.asarray(mask)
@@ -146,7 +146,7 @@ E2E_SCRIPT = textwrap.dedent("""
     gen = FlowGenerator(ZipfProfile(n_keys=30, alpha=1.4), seed=5, t0=9000)
     batches = [gen.batch(GLOBAL) for _ in range(N_BATCHES)]
     COLS = ("time_received", "src_as", "dst_as", "etype", "bytes",
-            "packets", "src_addr", "dst_addr")
+            "packets", "src_addr", "dst_addr", "sampling_rate")
     mine = slice(pid * HALF, (pid + 1) * HALF)
     for i in range(start, N_BATCHES):
         cols = batches[i].device_columns(COLS)
