@@ -44,6 +44,19 @@ _SKIP_E2E_IN_MAIN = False  # tpu_capture: e2e runs as its own section
 # scheduler noise" means another process is stealing the core mid-window.
 _BUSY_LOAD = 1.5
 
+# Workload sizes, module-level so the driver-seam guard test
+# (tests/test_driver_seam.py) can run every REAL staging path at tiny
+# shapes — the round-4 artifact died in staging code no test executed.
+HH_BATCH = 32768
+HH_STAGED = 8
+HH_STEPS = 48
+E2E_FLOWS = 400_000
+SWEEP_BATCHES_CPU = (16384,)
+SWEEP_STEPS = 24
+TRACE_BATCH = 16384
+SHARDED_PER_CHIP = 16384
+SHARDED_STEPS = 24
+
 
 def _host_conditions() -> dict:
     """Snapshot of the things that make a one-shot number untrustworthy."""
@@ -189,9 +202,7 @@ def main() -> None:
     from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
     from flow_pipeline_tpu.models import heavy_hitter as hh
 
-    BATCH = 32768
-    STAGED = 8
-    STEPS = 48
+    BATCH, STAGED, STEPS = HH_BATCH, HH_STAGED, HH_STEPS
 
     config = hh.HeavyHitterConfig(
         key_cols=("src_addr", "dst_addr"),
@@ -203,7 +214,7 @@ def main() -> None:
     staged = []
     for _ in range(STAGED):
         b = gen.batch(BATCH)
-        cols = b.device_columns([*config.key_cols, *config.value_cols])
+        cols = b.device_columns(hh.input_cols(config))
         cols = {k: jax.device_put(jnp.asarray(v)) for k, v in cols.items()}
         staged.append(cols)
     valid = jax.device_put(jnp.ones(BATCH, bool))
@@ -243,7 +254,7 @@ def main() -> None:
     if not _SKIP_E2E_IN_MAIN:
         global _NATIVE
         _NATIVE = _ensure_native()
-        e2e = _run_e2e(400_000, samples=3)
+        e2e = _run_e2e(E2E_FLOWS, samples=3)
         result["e2e_flows_per_sec"] = e2e["value"]
         result["e2e_stages"] = e2e["stages"]
         result["e2e_native_decode"] = _NATIVE
@@ -430,7 +441,7 @@ def bench_e2e() -> None:
     global _NATIVE
     _NATIVE = _ensure_native()  # the Python fallback decoder is ~10x slower
 
-    stats = _run_e2e(400_000, samples=5)
+    stats = _run_e2e(E2E_FLOWS, samples=5)
     print(json.dumps({
         "metric": "e2e pipeline throughput (decode + all models + flush)",
         "unit": "flows/sec",
@@ -452,7 +463,7 @@ def bench_sweep() -> None:
     from flow_pipeline_tpu.models import heavy_hitter as hh
 
     on_tpu = jax.devices()[0].platform != "cpu"
-    batches = (16384, 32768, 65536) if on_tpu else (16384,)
+    batches = (16384, 32768, 65536) if on_tpu else SWEEP_BATCHES_CPU
     widths = (1 << 15, 1 << 16, 1 << 17) if on_tpu else (1 << 16,)
     impls = ("xla", "pallas") if on_tpu else ("xla",)
     prefilters = (True, False) if on_tpu else (True,)
@@ -463,7 +474,7 @@ def bench_sweep() -> None:
         for _ in range(4):
             b = gen.batch(batch)
             cols = b.device_columns(("src_addr", "dst_addr", "bytes",
-                                     "packets"))
+                                     "packets", "sampling_rate"))
             staged.append({k: jax.device_put(jnp.asarray(v))
                            for k, v in cols.items()})
         valid = jax.device_put(jnp.ones(batch, bool))
@@ -479,7 +490,7 @@ def bench_sweep() -> None:
                     state = hh.hh_update(state, staged[0], valid,
                                          config=config)
                     jax.block_until_ready(state)
-                    steps = 24
+                    steps = SWEEP_STEPS
                     t0 = time.perf_counter()
                     for i in range(steps):
                         state = hh.hh_update(state, staged[i % 4], valid,
@@ -508,7 +519,7 @@ def bench_trace(logdir: str = "/tmp/flowtpu_trace") -> None:
     from flow_pipeline_tpu.models import heavy_hitter as hh
     from flow_pipeline_tpu.obs.tracing import device_trace
 
-    BATCH = 16384
+    BATCH = TRACE_BATCH
     config = hh.HeavyHitterConfig(
         key_cols=("src_addr", "dst_addr"), batch_size=BATCH,
         width=1 << 16, capacity=1024,
@@ -516,8 +527,7 @@ def bench_trace(logdir: str = "/tmp/flowtpu_trace") -> None:
     gen = FlowGenerator(ZipfProfile(n_keys=100_000, alpha=1.1), seed=0)
     b = gen.batch(BATCH)
     cols = {k: jax.device_put(jnp.asarray(v))
-            for k, v in b.device_columns(
-                [*config.key_cols, *config.value_cols]).items()}
+            for k, v in b.device_columns(hh.input_cols(config)).items()}
     valid = jax.device_put(jnp.ones(BATCH, bool))
     state = hh.hh_update(hh.hh_init(config), cols, valid, config=config)
     jax.block_until_ready(state)  # compile outside the trace
@@ -552,8 +562,7 @@ def bench_sharded(n_devices: int = 8) -> None:
     from flow_pipeline_tpu.models import heavy_hitter as hh
     from flow_pipeline_tpu.parallel import ShardedHeavyHitter, make_mesh
 
-    PER_CHIP = 16384
-    STEPS = 24
+    PER_CHIP, STEPS = SHARDED_PER_CHIP, SHARDED_STEPS
     mesh = make_mesh(n_devices)
     config = hh.HeavyHitterConfig(
         key_cols=("src_addr", "dst_addr"), batch_size=PER_CHIP,
@@ -569,7 +578,7 @@ def bench_sharded(n_devices: int = 8) -> None:
     staged = []
     for _ in range(4):
         b = gen.batch(model.global_batch)
-        cols = b.device_columns([*config.key_cols, *config.value_cols])
+        cols = b.device_columns(hh.input_cols(config))
         import numpy as np
 
         staged.append(shard_batch_columns(
@@ -625,6 +634,7 @@ def _bench_sharded_exact_merge(mesh, n_devices: int, per_chip: int) -> None:
     from flow_pipeline_tpu.models.window_agg import (
         DRAIN_PENDING_MAX,
         WindowAggConfig,
+        group_cols,
     )
     from flow_pipeline_tpu.parallel import shard_batch_columns
     from flow_pipeline_tpu.parallel.sharded import ShardedWindowAggregator
@@ -636,7 +646,7 @@ def _bench_sharded_exact_merge(mesh, n_devices: int, per_chip: int) -> None:
     for _ in range(4):
         b = gen.batch(global_batch)
         cols = b.device_columns(
-            ["time_received", *cfg.key_cols, *cfg.value_cols])
+            ["time_received", *group_cols(cfg), *cfg.value_cols])
         staged.append(shard_batch_columns(
             mesh, {k: np.asarray(v) for k, v in cols.items()},
             np.ones(global_batch, bool)))

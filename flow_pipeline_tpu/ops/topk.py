@@ -77,6 +77,50 @@ def topk_merge(table_keys, table_vals, cand_keys, cand_vals, cand_valid):
     return new_keys, new_vals
 
 
+def topk_merge_est(table_keys, table_vals, cand_keys, cand_sums, cand_est,
+                   cand_valid):
+    """topk_merge with space-saving admission: a key ALREADY in the table
+    is incremented by its batch sums (``cand_sums``), while a NEW key
+    enters with its CMS estimate (``cand_est``) — the estimate covers the
+    key's pre-entry mass (the paired CMS counts every row of the stream),
+    so table values upper-bound true totals with CMS-bounded error
+    instead of silently under-counting late entrants. This is the
+    admission rule of the space-saving algorithm, expressed as the same
+    fixed-shape sort/segment merge (candidate and table keys are each
+    unique, so every group has at most one row of each kind).
+
+    Not for table-table folds (cross-chip window close): there both
+    sides' values are already totals — use topk_merge, which sums.
+    """
+    c = table_keys.shape[0]
+    p = table_vals.shape[1]
+    table_valid = jnp.any(table_keys != SENTINEL, axis=1)
+    cand_valid = cand_valid & jnp.any(cand_keys != SENTINEL, axis=1)
+    all_keys = jnp.concatenate(
+        [table_keys, cand_keys.astype(jnp.uint32)], axis=0)
+    tz = jnp.zeros_like(table_vals)
+    cz = jnp.zeros((cand_keys.shape[0], p), jnp.float32)
+    # planes: [table mass P | batch sums P | entry est P | is_table 1]
+    t_rows = jnp.concatenate(
+        [table_vals, tz, tz, jnp.ones((c, 1), jnp.float32)], axis=1)
+    c_rows = jnp.concatenate(
+        [cz, cand_sums.astype(jnp.float32), cand_est.astype(jnp.float32),
+         jnp.zeros((cand_keys.shape[0], 1), jnp.float32)], axis=1)
+    all_vals = jnp.concatenate([t_rows, c_rows], axis=0)
+    all_valid = jnp.concatenate([table_valid, cand_valid], axis=0)
+
+    uniq, sums, counts = sort_groupby_float(all_keys, all_vals, all_valid)
+    resident = sums[:, 3 * p] > 0
+    vals = sums[:, :p] + jnp.where(
+        resident[:, None], sums[:, p:2 * p], sums[:, 2 * p:3 * p])
+    real = counts > 0
+    primary = jnp.where(real, vals[:, 0], -jnp.inf)
+    top = jnp.argsort(-primary)[:c]
+    new_keys = jnp.where(real[top][:, None], uniq[top], SENTINEL)
+    new_vals = jnp.where(real[top][:, None], vals[top], 0.0)
+    return new_keys, new_vals
+
+
 def topk_extract(table_keys, table_vals, k: int):
     """Host-facing: top-k rows (already ranked). Returns (keys, vals, valid)."""
     valid = jnp.any(table_keys != SENTINEL, axis=1)

@@ -76,6 +76,8 @@ class ClickHouseSink:
                          ddl.CLICKHOUSE_TOP_DST_PORTS,
                          ddl.CLICKHOUSE_DDOS_ALERTS):
                 self._post(stmt)
+            for stmt in ddl.CLICKHOUSE_MIGRATIONS:
+                self._post(stmt)
 
     def _post(self, query: str, body: bytes = b"") -> bytes:
         req = urllib.request.Request(

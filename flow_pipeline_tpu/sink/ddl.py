@@ -240,6 +240,23 @@ CREATE TABLE IF NOT EXISTS flows_5m (
 ORDER BY (Date, Timeslot, SrcAS, DstAS, EType);
 """
 
+# Widened-schema migrations, issued at sink startup right after the
+# CREATEs: CREATE TABLE IF NOT EXISTS silently keeps a pre-existing table
+# WITHOUT the r4 *_scaled columns, so the first insert after an upgrade
+# would fail (unknown JSONEachRow field in ClickHouse / undefined column
+# in Postgres) and crash-loop the processor — the failure mode
+# check_raw_schema exists to prevent for flows_raw (ADVICE r4). Both
+# dialects support ADD COLUMN IF NOT EXISTS, so these are idempotent and
+# free on a current schema.
+POSTGRES_MIGRATIONS = (
+    "ALTER TABLE flows_5m ADD COLUMN IF NOT EXISTS bytes_scaled BIGINT",
+    "ALTER TABLE flows_5m ADD COLUMN IF NOT EXISTS packets_scaled BIGINT",
+)
+CLICKHOUSE_MIGRATIONS = (
+    "ALTER TABLE flows_5m ADD COLUMN IF NOT EXISTS Bytes_scaled UInt64",
+    "ALTER TABLE flows_5m ADD COLUMN IF NOT EXISTS Packets_scaled UInt64",
+)
+
 # Flush-table name -> column order, shared by every SQL sink (single source
 # of truth; the sinks must not drift from each other or from the DDL above).
 TABLE_COLUMNS = {

@@ -69,6 +69,8 @@ class PostgresSink:
         with self._conn, self._conn.cursor() as cur:
             for stmt in DDL.values():
                 cur.execute(stmt)
+            for stmt in ddl.POSTGRES_MIGRATIONS:
+                cur.execute(stmt)
 
     def write(self, table: str, rows) -> None:
         records = rows_to_records(rows)

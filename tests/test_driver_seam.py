@@ -1,0 +1,99 @@
+"""Driver-seam guards: the round-4 artifact died in staging code no test
+executed (`bench.py` staged [keys, values] by hand while the model read
+config.scale_col too — KeyError at the first update). These tests run the
+REAL driver entry points and the REAL bench staging paths at tiny shapes,
+so a config-schema change that breaks the seam fails the suite instead of
+the official artifact.
+
+Methodology: bench's workload sizes are module-level constants precisely
+so this file can shrink them (monkeypatch) and execute the genuine
+functions end to end — replicating the staging logic here would guard
+nothing.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import pytest
+
+import __graft_entry__ as graft
+import bench
+
+
+def test_entry_compiles_and_runs():
+    """The driver's single-chip compile check, verbatim."""
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    # state pytree comes back with the same structure
+    assert type(out) is type(args[0])
+
+
+def test_dryrun_multichip_small_mesh():
+    """The driver's multi-chip dry run on a small virtual mesh (conftest
+    forces the 8-device CPU platform)."""
+    graft.dryrun_multichip(min(4, len(jax.devices())))
+
+
+@pytest.fixture
+def tiny_bench(monkeypatch):
+    """Shrink every bench workload and skip the host probe (tests always
+    run on the forced-CPU backend)."""
+    monkeypatch.setattr(bench, "_PLATFORM", "cpu")
+    monkeypatch.setattr(bench, "HH_BATCH", 512)
+    monkeypatch.setattr(bench, "HH_STAGED", 2)
+    monkeypatch.setattr(bench, "HH_STEPS", 2)
+    monkeypatch.setattr(bench, "E2E_FLOWS", 16384)
+    monkeypatch.setattr(bench, "SWEEP_BATCHES_CPU", (512,))
+    monkeypatch.setattr(bench, "SWEEP_STEPS", 2)
+    monkeypatch.setattr(bench, "TRACE_BATCH", 512)
+    monkeypatch.setattr(bench, "SHARDED_PER_CHIP", 256)
+    monkeypatch.setattr(bench, "SHARDED_STEPS", 2)
+    return bench
+
+
+def _last_json(capsys) -> dict:
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    return json.loads(lines[-1])
+
+
+def test_bench_main_staging(tiny_bench, monkeypatch, capsys):
+    """`python bench.py` — the artifact the driver records every round."""
+    monkeypatch.setattr(bench, "_SKIP_E2E_IN_MAIN", True)  # e2e below
+    bench.main()
+    out = _last_json(capsys)
+    assert out["value"] > 0
+    assert out["platform"] == "cpu"
+
+
+def test_bench_e2e_staging(tiny_bench, capsys):
+    """`python bench.py e2e` — full pipeline with the default model set."""
+    bench._run_e2e  # the shared path main() also records
+    stats = bench._run_e2e(tiny_bench.E2E_FLOWS, samples=1)
+    assert stats["value"] > 0
+
+
+def test_bench_sweep_staging(tiny_bench, capsys):
+    bench.bench_sweep()
+    out = _last_json(capsys)
+    assert out["metric"] == "hh sweep best"
+    assert out["value"] > 0
+
+
+def test_bench_trace_staging(tiny_bench, capsys, tmp_path):
+    bench.bench_trace(str(tmp_path / "trace"))
+    out = _last_json(capsys)
+    assert out["metric"] == "device trace captured"
+
+
+def test_bench_sharded_staging(tiny_bench, capsys):
+    n = min(4, len(jax.devices()))
+    bench.bench_sharded(n)
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    outs = [json.loads(l) for l in lines]
+    assert any("sharded heavy-hitter" in o["metric"] and o["value"] > 0
+               for o in outs)
+    assert any("sharded exact-agg" in o["metric"] and o["value"] > 0
+               for o in outs)

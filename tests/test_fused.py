@@ -178,6 +178,31 @@ class TestFusedEquivalence:
                 np.testing.assert_array_equal(np.asarray(x[k]),
                                               np.asarray(y[k]))
 
+    def test_mixed_scale_col_dst_families_demoted(self):
+        """Two dst-keyed sketch families with DIFFERENT scale_col: the
+        shared B path scales planes by the first B config's rate, so the
+        second family must be demoted to its own groupby — outputs must
+        match the serial path for both (ADVICE r4)."""
+        def models():
+            return {
+                "top_dst_ips": WindowedHeavyHitter(HeavyHitterConfig(
+                    key_cols=("dst_addr",), batch_size=BS, width=1 << 10,
+                    capacity=128), k=50),
+                "top_dst_ips_raw": WindowedHeavyHitter(HeavyHitterConfig(
+                    key_cols=("dst_addr",), batch_size=BS, width=1 << 10,
+                    capacity=128, scale_col=None), k=50),
+            }
+
+        batches = make_stream()
+        # vary the rate so a wrong scaling actually changes sums
+        for i, b in enumerate(batches):
+            b.columns["sampling_rate"] = np.full(BS, 1 + i % 3, np.uint64)
+        fused = drive_fused(models(), batches)
+        serial = drive_serial(models(), batches)
+        for name in ("top_dst_ips", "top_dst_ips_raw"):
+            assert_same_windows(fused[name].flush(True),
+                                serial[name].flush(True))
+
     def test_unsupported_model_set_falls_back(self):
         class Opaque:
             def update(self, batch):

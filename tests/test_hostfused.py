@@ -153,7 +153,10 @@ class TestHostFusedEquivalence:
         """Host rows are deferred; a drain (snapshot/flush path) must fold
         them — no rows may be lost between chunks and a checkpoint."""
         agg = WindowAggregator(WindowAggConfig(batch_size=BS))
-        keys = np.array([[6000, 1, 2, 3], [6000, 1, 2, 3]], np.uint32)
+        # key layout: [timeslot, *key lanes, sampling_rate] — the rate is a
+        # mandatory last store-key lane under the default scale_col
+        keys = np.array([[6000, 1, 2, 3, 10], [6000, 1, 2, 3, 10]],
+                        np.uint32)
         sums = np.array([[10, 1], [5, 2]], np.uint64)
         agg.add_host_rows(keys, sums, np.array([1, 1]))
         assert agg._pending_host  # still queued
@@ -162,6 +165,31 @@ class TestHostFusedEquivalence:
         assert rows["bytes"].tolist() == [15]
         assert rows["packets"].tolist() == [3]
         assert rows["count"].tolist() == [2]
+        assert rows["bytes_scaled"].tolist() == [150]  # sum * rate 10
+        assert rows["packets_scaled"].tolist() == [30]
+
+    def test_add_host_rows_rejects_wrong_key_width(self):
+        """Ingest fails fast on a pre-r4 key layout (no rate lane) instead
+        of silently consuming a key lane as the rate (ADVICE r4)."""
+        agg = WindowAggregator(WindowAggConfig(batch_size=BS))
+        keys = np.array([[6000, 1, 2, 3]], np.uint32)  # missing rate lane
+        with pytest.raises(ValueError, match="add_host_rows"):
+            agg.add_host_rows(keys, np.array([[10, 1]], np.uint64),
+                              np.array([1]))
+
+    def test_flows5m_unscaled_config_still_emits_scaled_cols(self):
+        """scale_col=None must emit *_scaled == raw sums, not drop the
+        columns — the sink schema is fixed and NULL scaled columns would
+        silently blank sum(bytes_scaled) panels (ADVICE r4)."""
+        agg = WindowAggregator(WindowAggConfig(batch_size=BS,
+                                               scale_col=None))
+        keys = np.array([[6000, 1, 2, 3]], np.uint32)  # no rate lane
+        agg.add_host_rows(keys, np.array([[10, 1]], np.uint64),
+                          np.array([2]))
+        agg.watermark = 10_000
+        rows = agg.flush(force=True)
+        assert rows["bytes_scaled"].tolist() == rows["bytes"].tolist() == [10]
+        assert rows["packets_scaled"].tolist() == [1]
 
     def test_eligible_modes(self):
         assert HostGroupPipeline.eligible("on")

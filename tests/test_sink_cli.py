@@ -77,13 +77,16 @@ class TestPostgresSQL:
     def test_insert_sql_multirow_single_statement(self):
         sql, args = insert_sql("flows_5m", [
             {"timeslot": 300, "src_as": 1, "dst_as": 2, "etype": 3,
-             "bytes": 4, "packets": 5, "count": 6},
+             "bytes": 4, "packets": 5, "count": 6,
+             "bytes_scaled": 40, "packets_scaled": 50},
             {"timeslot": 600, "src_as": 7, "dst_as": 8, "etype": 9,
-             "bytes": 10, "packets": 11, "count": 12},
+             "bytes": 10, "packets": 11, "count": 12,
+             "bytes_scaled": 100, "packets_scaled": 110},
         ])
         assert sql.startswith('INSERT INTO "flows_5m"')
         assert sql.count("(%s") == 2  # one VALUES group per record
-        assert args == [300, 1, 2, 3, 4, 5, 6, 600, 7, 8, 9, 10, 11, 12]
+        assert args == [300, 1, 2, 3, 4, 5, 6, 40, 50,
+                        600, 7, 8, 9, 10, 11, 12, 100, 110]
 
     def test_missing_fields_become_none(self):
         _, args = insert_sql("ddos_alerts", [{"rate": 1.5}])

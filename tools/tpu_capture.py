@@ -118,7 +118,8 @@ def main() -> None:
         cfg = hh.HeavyHitterConfig(batch_size=4096, cms_impl="pallas")
         cols = {"src_addr": keys[:, :4], "dst_addr": keys[:, 4:],
                 "bytes": vals[:, 0].astype(jnp.int32),
-                "packets": vals[:, 1].astype(jnp.int32)}
+                "packets": vals[:, 1].astype(jnp.int32),
+                "sampling_rate": jnp.ones(n, jnp.int32)}
         st = hh.hh_update(hh.hh_init(cfg), cols, valid, config=cfg)
         jax.block_until_ready(st)
         emit({"section": "pallas_parity", "kernel": "hh_update(pallas)",
