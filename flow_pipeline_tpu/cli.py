@@ -218,6 +218,17 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
                                      "flows_raw on sinks that support it")
     fs.integer("feed.prefetch", 2, "Decoded batches fetched ahead of the "
                                    "device step (0 disables)")
+    fs.string("ingest.mode", "pipelined",
+              "Host dataplane: pipelined (grouping overlaps the device "
+              "step, async window flush) | serial (pre-r6 path, A/B)")
+    fs.integer("ingest.shards", 0, "Grouping shards on the ingest pool "
+                                   "(0 auto, 1 disables sharding)")
+    fs.integer("ingest.depth", 2, "Prepared batches held ahead of the "
+                                  "device step")
+    fs.integer("ingest.flush_queue", 8, "Max queued background flush jobs")
+    fs.boolean("ingest.native_group", True,
+               "Group with the native radix kernel (libflowdecode); "
+               "falls back to numpy when unbuilt")
     fs.string("checkpoint.path", "", "Snapshot directory")
     fs.integer("flush.count", 50, "Batches between snapshots")
     fs.string("metrics.addr", "127.0.0.1:8081", "host:port for /metrics "
@@ -383,6 +394,11 @@ def processor_main(argv=None) -> int:
                 prefetch=vals["feed.prefetch"],
                 fused=vals["processor.fused"],
                 host_assist=vals["processor.hostassist"],
+                ingest_mode=vals["ingest.mode"],
+                ingest_shards=vals["ingest.shards"],
+                ingest_depth=vals["ingest.depth"],
+                ingest_flush_queue=vals["ingest.flush_queue"],
+                ingest_native_group=vals["ingest.native_group"],
             ),
         )
         if vals["query.addr"]:
@@ -530,7 +546,12 @@ def pipeline_main(argv=None) -> int:
                      snapshot_every=vals["flush.count"],
                      checkpoint_path=vals["checkpoint.path"] or None,
                      archive_raw=vals["archive.raw"],
-                     prefetch=vals["feed.prefetch"]),
+                     prefetch=vals["feed.prefetch"],
+                     ingest_mode=vals["ingest.mode"],
+                     ingest_shards=vals["ingest.shards"],
+                     ingest_depth=vals["ingest.depth"],
+                     ingest_flush_queue=vals["ingest.flush_queue"],
+                     ingest_native_group=vals["ingest.native_group"]),
     )
     query = None
     if vals["query.addr"]:

@@ -125,10 +125,26 @@ class TemplateCache:
     options: set = field(default_factory=set)  # keys that are options templates
     sampling: dict[tuple, int] = field(default_factory=dict)  # (src, dom) -> rate
     missing: int = 0
+    # per-ROUTER template tally (source with the ephemeral port
+    # stripped — the granularity of the exported `router` label),
+    # maintained at put() time: count_for runs once per datagram on the
+    # decode hot path, so it must not scan the whole cache (1000 routers
+    # x 20 templates would be a 20k-tuple walk per packet), and tallying
+    # the full ip:port would make one router's series flap between its
+    # per-port counts instead of aggregating.
+    by_router: dict[str, int] = field(default_factory=dict)
+
+    @staticmethod
+    def _router(source: str) -> str:
+        host, _, _ = source.rpartition(":")
+        return host if host else source
 
     def put(self, source: str, domain: int, tid: int,
             fields: list[tuple[int, int]], is_options: bool = False) -> None:
         key = (source, domain, tid)
+        if key not in self.templates:  # refreshes don't re-count
+            router = self._router(source)
+            self.by_router[router] = self.by_router.get(router, 0) + 1
         self.templates[key] = fields
         if is_options:
             self.options.add(key)
@@ -140,6 +156,13 @@ class TemplateCache:
         if t is None:
             self.missing += 1
         return t
+
+    def count_for(self, source: str) -> int:
+        """Templates cached for one ROUTER (``source`` may carry the
+        port; it is stripped to match the exported label) — the
+        per-router flow_process_nf_templates_count series
+        (collector.udp)."""
+        return self.by_router.get(self._router(source), 0)
 
     def is_options(self, source: str, domain: int, tid: int) -> bool:
         return (source, domain, tid) in self.options

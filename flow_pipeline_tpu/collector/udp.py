@@ -7,12 +7,18 @@ observed metric surface (SURVEY.md §2-C12), so the reference's perfs
 dashboard panels resolve against our /metrics:
 
     udp_traffic_bytes / udp_traffic_packets
-    flow_traffic_bytes{type=...} / flow_traffic_packets{type=...}
-    flow_process_nf_flowset_records_sum / flow_process_nf_errors_count
-    flow_process_nf_templates_count
-    flow_process_sf_samples_sum{type=FlowSample}
+    flow_traffic_bytes{type=...,remote_ip=...} / flow_traffic_packets{...}
+    flow_process_nf_flowset_records_sum{router=...}
+    flow_process_nf_errors_count{router=...}
+    flow_process_nf_templates_count  (+ per-router series)
+    flow_process_sf_samples_sum{type=FlowSample,agent=...}
+    flow_process_sf_errors_count{agent=...}
     flow_summary_decoding_time_us{name=...}
     flow_decoder_count{worker=...}
+
+The router/agent label carries the exporter's address (port stripped) so
+the dashboards can break panels down per exporter, the way the
+reference's perfs.json does with `by (router)` / `by (agent)`.
 """
 
 from __future__ import annotations
@@ -37,6 +43,15 @@ _TYPE_NAMES = {
     FlowType.NETFLOW_V9: "NetFlow",
     FlowType.IPFIX: "NetFlow",
 }
+
+
+def _exporter_ip(source: str) -> str:
+    """Exporter address without the ephemeral port: the `router`/`agent`
+    label value (the reference's perfs dashboards break every per-flow
+    panel down `by (router)` / `by (agent)` — an unlabeled counter
+    cannot answer "which exporter went quiet")."""
+    host, _, port = source.rpartition(":")
+    return host if host else source
 
 
 def _export_clock(data: bytes) -> int:
@@ -98,6 +113,7 @@ class CollectorServer:
     def handle_netflow(self, data: bytes, source: str = "") -> int:
         self.m_udp_bytes.inc(len(data))
         self.m_udp_pkts.inc()
+        router = _exporter_ip(source)
         now = time.time()
         t0 = time.perf_counter()
         try:
@@ -111,13 +127,15 @@ class CollectorServer:
             # struct.error covers malformed datagrams that trip fixed-layout
             # unpacks before a bounds check — one spoofed packet must never
             # kill the listener
-            self.m_nf_errors.inc()
+            self.m_nf_errors.inc(router=router)
             log.debug("netflow decode error from %s: %s", source, e)
             return 0
         finally:
             self.m_decode_us.observe((time.perf_counter() - t0) * 1e6)
         self.m_nf_templates.set(len(self.templates))
-        self.m_nf_records.inc(len(msgs))
+        self.m_nf_templates.set(self.templates.count_for(source),
+                                router=router)
+        self.m_nf_records.inc(len(msgs), router=router)
         # "time between flow and processing" (the reference perfs.json
         # NFDelaySummary panel): exporter header clock -> now, observed once
         # per record so busy exporters weight the quantiles like GoFlow's.
@@ -126,29 +144,30 @@ class CollectorServer:
             delay = max(0.0, now - export_clock)
             for _ in msgs:
                 self.m_nf_delay.observe(delay)
-        return self._publish(msgs)
+        return self._publish(msgs, router)
 
     def handle_sflow(self, data: bytes, source: str = "") -> int:
         self.m_udp_bytes.inc(len(data))
         self.m_udp_pkts.inc()
+        agent = _exporter_ip(source)
         t0 = time.perf_counter()
         try:
             msgs = decode_sflow(data)
         except (ValueError, struct.error) as e:
-            self.m_sf_errors.inc()
+            self.m_sf_errors.inc(agent=agent)
             log.debug("sflow decode error from %s: %s", source, e)
             return 0
         finally:
             self.m_decode_us.observe((time.perf_counter() - t0) * 1e6)
-        self.m_sf_samples.inc(len(msgs), type="FlowSample")
-        return self._publish(msgs)
+        self.m_sf_samples.inc(len(msgs), type="FlowSample", agent=agent)
+        return self._publish(msgs, agent)
 
-    def _publish(self, msgs) -> int:
+    def _publish(self, msgs, remote_ip: str = "") -> int:
         for m in msgs:
             self.producer.send(m)
             name = _TYPE_NAMES.get(m.type, "unknown")
-            self.m_flow_bytes.inc(m.bytes, type=name)
-            self.m_flow_pkts.inc(m.packets, type=name)
+            self.m_flow_bytes.inc(m.bytes, type=name, remote_ip=remote_ip)
+            self.m_flow_pkts.inc(m.packets, type=name, remote_ip=remote_ip)
         return len(msgs)
 
     # ---- service lifecycle ------------------------------------------------

@@ -360,10 +360,13 @@ class FusedPipeline:
 
     # ---- host lifecycle ---------------------------------------------------
 
-    def update(self, batch: FlowBatch) -> None:
+    def _split_parts(self, batch: FlowBatch):
+        """Split a batch at (window slot, DDoS sub-window) boundaries into
+        homogeneous parts, in (slot, sub) order. Returns (parts, wm) with
+        parts = [(slot, sub, FlowBatch)] and wm the batch watermark —
+        pure host work, shared by update() and the ingest runtime's
+        prepare stage (which runs it off the worker thread)."""
         n = len(batch)
-        if n == 0:
-            return
         t = batch.columns["time_received"].astype(np.int64)
         slots = ((t // self._window_seconds) * self._window_seconds
                  if self._whh else np.zeros(n, np.int64))
@@ -388,6 +391,7 @@ class FusedPipeline:
                 (int(slot), int(sub), np.flatnonzero(inverse == gi))
                 for gi, (slot, sub) in enumerate(uniq_pairs)
             ]
+        parts = []
         for slot, sub, idx in groups:
             if idx is None:
                 part = batch
@@ -396,10 +400,17 @@ class FusedPipeline:
                     {k: v[idx] for k, v in batch.columns.items()},
                     batch.partition,
                 )
+            parts.append((slot, sub, part))
+        return parts, int(t.max())
+
+    def update(self, batch: FlowBatch) -> None:
+        if len(batch) == 0:
+            return
+        parts, wm = self._split_parts(batch)
+        for slot, sub, part in parts:
             do_hh = self._advance_hh(slot, len(part))
             do_dd = self._advance_ddos(sub, len(part))
             self._run_chunks(part, do_hh, do_dd)
-        wm = int(t.max())
         for _, m in self._waggs:
             if wm > m.watermark:
                 m.watermark = wm

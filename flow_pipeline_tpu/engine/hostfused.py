@@ -38,20 +38,41 @@ rows and window boundaries included.
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple, Optional
 
 import jax
 import numpy as np
 
+from ..ingest.shard import ShardPool, group_by_key_sharded, shared_pool
 from ..models import heavy_hitter as hh
 from ..models.ddos import _accumulate_grouped
 from ..models.dense_top import dense_update
 from ..obs import get_logger
 from ..obs.tracing import StageTimer
-from ..ops.hostgroup import group_by_key, select_lanes
+from ..ops.hostgroup import native_group_available, select_lanes
 from ..schema.batch import FlowBatch, lane_width
 from .fused import FusedPipeline
 
 log = get_logger("hostfused")
+
+
+class PreparedChunk(NamedTuple):
+    """Host pre-aggregation of one device-sized chunk — everything the
+    apply half needs, with no model state touched yet. Group tables are
+    computed UNCONDITIONALLY (the prepare stage cannot know whether the
+    chunk's window is late until apply-time lifecycle advances); apply
+    gates them with the do_hh/do_dd valid planes exactly like the serial
+    path gates its device call."""
+    wagg: list            # per wagg model: (keys, sums, counts) host rows
+    hh_in: Optional[list]     # per hh family: (u [B,W], s [B,P+1], g)
+    dense_in: Optional[tuple]  # (dcols padded, dvalid) or None
+    ddos_in: Optional[tuple]   # (u [B,4], s [B], g) or None
+
+
+class PreparedBatch(NamedTuple):
+    batch: FlowBatch      # original batch (offsets / archive_raw / metrics)
+    parts: list           # [(slot, sub, n_rows, [PreparedChunk])]
+    watermark: int
 
 _U32_MAX = np.uint64(0xFFFFFFFF)
 
@@ -148,9 +169,22 @@ class HostGroupPipeline(FusedPipeline):
                 f"host_assist must be auto|on|off, got {mode!r}")
         return jax.default_backend() == "cpu"
 
-    def __init__(self, models: dict):
+    def __init__(self, models: dict, shards: int = 0,
+                 native_group: bool = False,
+                 pool: Optional[ShardPool] = None):
         super().__init__(models)
         self.stages = StageTimer()
+        # Grouping backends (ingest runtime knobs): shards=1 disables the
+        # sharded path entirely; 0 sizes it to the pool. native_group
+        # requests the C hash-group kernel and quietly degrades to numpy
+        # when the library is unbuilt — record which backend actually
+        # serves so operators can tell from the log.
+        self._native = native_group and native_group_available()
+        if native_group and not self._native:
+            log.warning("ingest.native_group requested but libflowdecode "
+                        "lacks flow_hash_group; using numpy grouping")
+        self._shards = shards
+        self._pool = None if shards == 1 else (pool or shared_pool())
         self._widths = {}
         # Sketch-family plan: group the maximal key families from raw
         # rows; regroup every strict-subset family (equal value planes)
@@ -204,28 +238,45 @@ class HostGroupPipeline(FusedPipeline):
             tuple(d.config for _, d in self._ddos),
         )
 
-    # ---- per-chunk work ----------------------------------------------------
+    # ---- prepare half: pure host pre-aggregation ---------------------------
+    #
+    # prepare() touches NO model state, so the ingest executor runs it on
+    # its group thread while the worker thread applies the previous
+    # batch. update() = apply(prepare()) keeps the serial path the same
+    # code — pipelined and serial modes cannot drift apart.
 
-    def _run_chunks(self, part: FlowBatch, do_hh: bool, do_dd: bool) -> None:
-        bs = self._bs
-        for start in range(0, len(part), bs):
-            chunk = part.slice(start, start + bs)
-            cols = chunk.columns
-            n = len(chunk)
-            with self.stages.stage("host_group"):
-                # flows_5m: exact uint64 groupby straight into the window
-                # store — no device partials on this path
-                for _, m in self._waggs:
-                    self._wagg_rows(m, cols, n)
-                fams = self._group_families(cols) \
-                    if (do_hh or do_dd) and (self._hh or self._ddos) else None
-            if not (do_hh or do_dd) or not (
-                    self._hh or self._dense or self._ddos):
-                continue
-            with self.stages.stage("device_apply"):
-                self._device_apply(chunk, cols, fams, do_hh, do_dd, n)
+    def prepare(self, batch: FlowBatch) -> Optional[PreparedBatch]:
+        if len(batch) == 0:
+            return None
+        parts, wm = self._split_parts(batch)
+        out_parts = []
+        with self.stages.stage("host_group"):
+            for slot, sub, part in parts:
+                chunks = []
+                bs = self._bs
+                for start in range(0, len(part), bs):
+                    chunk = part.slice(start, start + bs)
+                    chunks.append(self._prepare_chunk(
+                        chunk.columns, len(chunk)))
+                out_parts.append((slot, sub, len(part), chunks))
+        return PreparedBatch(batch, out_parts, wm)
 
-    def _wagg_rows(self, m, cols: dict, n: int) -> None:
+    def _prepare_chunk(self, cols: dict, n: int) -> PreparedChunk:
+        # flows_5m: exact uint64 groupby straight into the window store —
+        # no device partials on this path
+        wagg = [self._wagg_rows(m, cols, n) for _, m in self._waggs]
+        if not (self._hh or self._dense or self._ddos):
+            return PreparedChunk(wagg, None, None, None)
+        fams = (self._group_families(cols)
+                if (self._hh or self._ddos) else None)
+        return PreparedChunk(wagg, *self._prep_device(cols, fams, n))
+
+    def _group(self, lanes, planes, exact):
+        return group_by_key_sharded(lanes, planes, self._pool,
+                                    self._shards, exact=exact,
+                                    native=self._native)
+
+    def _wagg_rows(self, m, cols: dict, n: int):
         cfg = m.config
         t = np.minimum(cols["time_received"], _U32_MAX).astype(np.uint32)
         slot = t - t % np.uint32(cfg.window_seconds)
@@ -237,8 +288,9 @@ class HostGroupPipeline(FusedPipeline):
             lanes.append(_u32_lane(cols[cfg.scale_col])[:, None])
         lanes = np.concatenate(lanes, axis=1)
         planes = [np.minimum(cols[name], _U32_MAX) for name in cfg.value_cols]
-        uniq, sums, counts = group_by_key(lanes, [np.stack(planes, axis=1)])
-        m.add_host_rows(uniq, sums[0], counts)
+        uniq, sums, counts = self._group(
+            lanes, [np.stack(planes, axis=1)], exact=True)
+        return uniq, sums[0], counts
 
     def _group_families(self, cols: dict) -> list[tuple]:
         """Per-hh-family (uniq [G,W] u32, vsum [G,P] f64, cnt [G]) plus the
@@ -251,14 +303,14 @@ class HostGroupPipeline(FusedPipeline):
             cfg = w.config
             lanes = _key_lanes_np(cols, cfg.key_cols)
             vals = _value_planes_np(cols, cfg.value_cols, cfg.scale_col)
-            uniq, sums, counts = group_by_key(lanes, [vals], exact=False)
+            uniq, sums, counts = self._group(lanes, [vals], exact=False)
             out[i] = (uniq, sums[0], counts)
         for i, plan in enumerate(self._fam_plan):
             if plan[0] != "cascade":
                 continue
             _, parent, sel = plan
             p_uniq, p_vsum, p_cnt = out[parent]
-            uniq, sums, _ = group_by_key(
+            uniq, sums, _ = self._group(
                 p_uniq[:, list(sel)], [p_vsum, p_cnt], exact=False)
             out[i] = (uniq, sums[0], sums[1].astype(np.int64))
         if self._ddos_plan is not None:
@@ -266,29 +318,34 @@ class HostGroupPipeline(FusedPipeline):
             if self._ddos_plan[0] == "cascade":
                 _, parent, sel, plane = self._ddos_plan
                 p_uniq, p_vsum, p_cnt = out[parent]
-                uniq, sums, _ = group_by_key(
+                uniq, sums, _ = self._group(
                     p_uniq[:, list(sel)], [p_vsum[:, plane]], exact=False)
                 out.append((uniq, sums[0].astype(np.float32)))
             else:
                 lanes = _key_lanes_np(cols, ("dst_addr",))
                 vals = _value_planes_np(cols, (dcfg.value_col,),
                                         dcfg.scale_col)[:, 0]
-                uniq, sums, _ = group_by_key(lanes, [vals], exact=False)
+                uniq, sums, _ = self._group(lanes, [vals], exact=False)
                 out.append((uniq, sums[0].astype(np.float32)))
         return out
 
-    def _device_apply(self, chunk: FlowBatch, cols: dict, fams,
-                      do_hh: bool, do_dd: bool, n: int) -> None:
-        sizes = [1024]
-        if self._hh:
-            sizes += [f[0].shape[0] for f in fams[:len(self._hh)]]
-        if self._ddos_plan is not None:
-            sizes.append(fams[-1][0].shape[0])
-        B = _pow2_bucket(max(sizes), hi=max(self._bs, 1024))
+    def _prep_device(self, cols: dict, fams, n: int):
+        """Pad group tables / dense columns to their static shapes —
+        the host half of the device step. Valid planes are NOT built
+        here: they depend on apply-time lifecycle (do_hh / do_dd).
+
+        Buckets are PER FAMILY (not the old shared max): a cascade family
+        (src/dst IPs) typically groups 3-4x smaller than the 5-tuple
+        talkers, and the CMS scatter + merge cost scales with padded
+        rows — sharing the talkers' bucket made every family pay the
+        largest family's price. Each family still draws from the same
+        handful of power-of-two shapes, so the jit cache stays small."""
+        hi = max(self._bs, 1024)
         hh_in = []
         for i, (_, w) in enumerate(self._hh):
             uniq, vsum, cnt = fams[i]
             g = uniq.shape[0]
+            B = _pow2_bucket(g, hi=hi)
             W = uniq.shape[1]
             P = vsum.shape[1]
             u = np.zeros((B, W), np.uint32)
@@ -296,11 +353,9 @@ class HostGroupPipeline(FusedPipeline):
             u[:g] = uniq
             s[:g, :P] = vsum
             s[:g, P] = cnt
-            v = np.zeros(B, bool)
-            v[:g] = do_hh
-            hh_in.append((u, s, v))
+            hh_in.append((u, s, g))
         dense_in = None
-        if self._dense and do_hh:
+        if self._dense:
             need = set()
             for _, w in self._dense:
                 need.add(w.config.key_col)
@@ -321,11 +376,54 @@ class HostGroupPipeline(FusedPipeline):
         if self._ddos_plan is not None:
             uniq, dsum = fams[-1]
             g = uniq.shape[0]
+            B = _pow2_bucket(g, hi=hi)
             u = np.zeros((B, 4), np.uint32)
             s = np.zeros(B, np.float32)
             u[:g] = uniq
             s[:g] = dsum
-            v = np.zeros(B, bool)
+            ddos_in = (u, s, g)
+        return hh_in, dense_in, ddos_in
+
+    # ---- apply half: lifecycle + model state -------------------------------
+
+    def apply(self, prep: Optional[PreparedBatch]) -> None:
+        """Advance window lifecycles and fold one prepared batch into the
+        models. Must run on the thread that owns model state (the worker
+        thread, under its lock), in batch order."""
+        if prep is None:
+            return
+        for slot, sub, n_rows, chunks in prep.parts:
+            do_hh = self._advance_hh(slot, n_rows)
+            do_dd = self._advance_ddos(sub, n_rows)
+            for ch in chunks:
+                for (_, m), rows in zip(self._waggs, ch.wagg):
+                    m.add_host_rows(*rows)
+                if ch.hh_in is None and ch.dense_in is None \
+                        and ch.ddos_in is None:
+                    continue
+                if not (do_hh or do_dd):
+                    continue  # late part: device models take nothing
+                with self.stages.stage("device_apply"):
+                    self._apply_chunk(ch, do_hh, do_dd)
+        for _, m in self._waggs:
+            if prep.watermark > m.watermark:
+                m.watermark = prep.watermark
+
+    def update(self, batch: FlowBatch) -> None:
+        self.apply(self.prepare(batch))
+
+    def _apply_chunk(self, ch: PreparedChunk, do_hh: bool,
+                     do_dd: bool) -> None:
+        hh_in = []
+        for u, s, g in ch.hh_in:
+            v = np.zeros(u.shape[0], bool)
+            v[:g] = do_hh
+            hh_in.append((u, s, v))
+        dense_in = ch.dense_in if (self._dense and do_hh) else None
+        ddos_in = None
+        if ch.ddos_in is not None:
+            u, s, g = ch.ddos_in
+            v = np.zeros(u.shape[0], bool)
             v[:g] = do_dd
             ddos_in = (u, s, v)
         states = (

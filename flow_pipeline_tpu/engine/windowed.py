@@ -16,6 +16,30 @@ from ..models.oracle import SECONDS_PER_SLOT
 from ..schema.batch import FlowBatch
 
 
+class LazyWindowTop:
+    """Deferred top-K extraction for one closed window.
+
+    Closing a sketch window costs a device sync (top-K ranking + CMS
+    estimates pulled to host) that the HOT PATH does not need — only the
+    sink does. The close captures the window's state (immutable jax
+    arrays; reset() replaces rather than mutates, and the update step's
+    buffer donation only ever consumes the NEW state), and resolve()
+    materializes the rows wherever the flusher runs it.
+    """
+
+    __slots__ = ("_thunk", "timeslot")
+
+    def __init__(self, thunk, timeslot: int):
+        self._thunk = thunk
+        self.timeslot = timeslot
+
+    def resolve(self) -> dict:
+        top = self._thunk()
+        top["timeslot"] = np.full(
+            len(top["valid"]), self.timeslot, dtype=np.uint64)
+        return top
+
+
 class WindowedHeavyHitter:
     """Tumbling-window top-K: update(batch) per batch; flush() yields rows
     for closed windows (one reset sketch per window)."""
@@ -28,7 +52,12 @@ class WindowedHeavyHitter:
         self.k = k
         self.model = model_cls(config, **model_kw)
         self.current_slot: int | None = None
-        self._pending: list[dict] = []
+        # Ingest-runtime knob (engine.worker sets it in pipelined mode):
+        # close windows as LazyWindowTop handles so extraction runs on
+        # the background flusher instead of the update path. Only honored
+        # when the backing model can capture its state (top_lazy).
+        self.lazy_extract = False
+        self._pending: list = []  # dicts, or LazyWindowTop when lazy
         # Sketch windows cannot reopen (the sketch was reset at close), so
         # rows older than the current slot are DROPPED and counted — unlike
         # the exact aggregator, which emits late partials. Size
@@ -63,15 +92,20 @@ class WindowedHeavyHitter:
             self.model.update(part)
 
     def _close(self) -> None:
-        top = self.model.top(self.k)
-        top["timeslot"] = np.full(
-            len(top["valid"]), self.current_slot, dtype=np.uint64
-        )
-        self._pending.append(top)
+        if self.lazy_extract and hasattr(self.model, "top_lazy"):
+            self._pending.append(LazyWindowTop(
+                self.model.top_lazy(self.k), self.current_slot))
+        else:
+            top = self.model.top(self.k)
+            top["timeslot"] = np.full(
+                len(top["valid"]), self.current_slot, dtype=np.uint64
+            )
+            self._pending.append(top)
         self.model.reset()
 
-    def flush(self, force: bool = False) -> list[dict]:
-        """Rows for closed windows (and the open one too, when force)."""
+    def flush(self, force: bool = False) -> list:
+        """Rows for closed windows (and the open one too, when force) —
+        dicts, or unresolved LazyWindowTop handles under lazy_extract."""
         if force and self.current_slot is not None:
             self._close()
             self.current_slot = None

@@ -49,6 +49,17 @@ class WorkerConfig:
     # "on"/"off" force/forbid. On TPU "auto" keeps the device-sorted
     # fused step.
     host_assist: str = "auto"
+    # Ingest dataplane (flow_pipeline_tpu.ingest): "pipelined" runs the
+    # host pre-aggregation on a group thread (overlapping the device
+    # step), window extraction + sink writes on a background flusher, and
+    # sharded grouping on a thread pool — engaged when the host-grouped
+    # pipeline is active (it has the prepare/apply split); "serial" keeps
+    # the single-threaded path (the pre-r6 behavior, the A/B baseline).
+    ingest_mode: str = "pipelined"
+    ingest_shards: int = 0       # grouping shards: 0 auto, 1 disables
+    ingest_depth: int = 2        # prepared batches held ready
+    ingest_flush_queue: int = 8  # queued background flush jobs (bound)
+    ingest_native_group: bool = False  # C hash-group kernel (numpy fallback)
     # Full-fidelity raw archiving (the reference's flows_raw path,
     # ref: compose/clickhouse/create.sh:36-62): every consumed batch is
     # handed to sinks exposing archive_raw(batch). Off by default — the
@@ -77,6 +88,10 @@ class StreamWorker:
         self.models = models
         self.sinks = list(sinks)
         self.config = config
+        if config.ingest_mode not in ("pipelined", "serial"):
+            raise ValueError(
+                f"ingest_mode must be pipelined|serial, "
+                f"got {config.ingest_mode!r}")
         self.fused = None
         if config.fused and models:
             from .fused import FusedPipeline
@@ -84,11 +99,45 @@ class StreamWorker:
 
             if FusedPipeline.supported(models):
                 if HostGroupPipeline.eligible(config.host_assist):
-                    self.fused = HostGroupPipeline(models)
+                    self.fused = HostGroupPipeline(
+                        models, shards=config.ingest_shards,
+                        native_group=config.ingest_native_group)
                 else:
                     self.fused = FusedPipeline(models)
             else:
                 log.info("model set not fusable; using per-model updates")
+        # Pipelined ingest runtime: a group thread prepares batch N+1
+        # while this thread applies batch N, and a background flusher
+        # takes window extraction + sink writes off the hot path. Only
+        # the host-grouped pipeline has the prepare/apply split; other
+        # paths (device-sorted fused, per-model, mesh-sharded) keep the
+        # serial loop — their overlap comes from jax async dispatch.
+        self.executor = None
+        self.flusher = None
+        if config.ingest_mode == "pipelined" and consumer is not None:
+            from .hostfused import HostGroupPipeline
+            from ..ingest import AsyncFlusher, PipelinedExecutor
+
+            if isinstance(self.fused, HostGroupPipeline) and not isinstance(
+                    consumer, PrefetchConsumer):
+                # prefetch=0 leaves the raw consumer unwrapped; moving its
+                # poll() onto the group thread while commit() stays here
+                # would hit a non-thread-safe Kafka client from two
+                # threads. The PrefetchConsumer wrap is what serializes
+                # all client access on its feed thread — without it, keep
+                # the serial loop.
+                log.info("ingest pipelined mode needs the prefetch wrap "
+                         "(feed.prefetch > 0); using the serial path")
+            elif isinstance(self.fused, HostGroupPipeline):
+                self.executor = PipelinedExecutor(
+                    consumer, self.fused.prepare,
+                    poll_max=config.poll_max, depth=config.ingest_depth)
+                self.flusher = AsyncFlusher(
+                    max_queue=config.ingest_flush_queue)
+                for m in models.values():
+                    if isinstance(m, WindowedHeavyHitter) and \
+                            hasattr(m.model, "top_lazy"):
+                        m.lazy_extract = True
         self.batches_seen = 0
         self.flows_seen = 0
         # offsets covered by state (committable after next snapshot/flush)
@@ -126,13 +175,19 @@ class StreamWorker:
 
     def run_once(self) -> bool:
         """Poll one batch through the pipeline. Returns False when idle."""
+        if self.executor is not None:
+            prep = self.executor.next()  # grouped off-thread (ingest)
+            if prep is None:
+                return False
+            with self.lock:
+                return self._process(prep.batch, prep)
         batch = self.consumer.poll(self.config.poll_max)
         if batch is None or len(batch) == 0:
             return False
         with self.lock:
             return self._process(batch)
 
-    def _process(self, batch) -> bool:
+    def _process(self, batch, prep=None) -> bool:
         t0 = time.perf_counter()
         if self.config.archive_raw:
             archived = False
@@ -149,7 +204,9 @@ class StreamWorker:
             # below), not snapshot_every batches' worth of raw rows.
             self._emitted_since_snapshot |= archived
         with self.stages.stage("processing"):
-            if self.fused is not None:
+            if prep is not None:
+                self.fused.apply(prep)  # prepare ran on the group thread
+            elif self.fused is not None:
                 self.fused.update(batch)
             else:
                 for model in self.models.values():
@@ -194,10 +251,21 @@ class StreamWorker:
             self.finalize()
         finally:
             # A crash mid-loop (e.g. a sink raising in _emit) must not
-            # leak the feed thread: it owns the wrapped consumer, and with
-            # a real broker a zombie would keep the partitions assigned
-            # while a supervisor-built replacement starves. Best effort —
-            # never mask the original exception.
+            # leak the feed/group/flush threads: the group thread owns
+            # the wrapped consumer, and with a real broker a zombie would
+            # keep the partitions assigned while a supervisor-built
+            # replacement starves. Best effort — never mask the original
+            # exception.
+            if self.executor is not None:
+                try:
+                    self.executor.stop()
+                except Exception:  # noqa: BLE001
+                    log.exception("ingest executor stop failed during unwind")
+            if self.flusher is not None:
+                try:
+                    self.flusher.stop()
+                except Exception:  # noqa: BLE001
+                    log.exception("ingest flusher stop failed during unwind")
             if isinstance(self.consumer, PrefetchConsumer):
                 try:
                     self.consumer.stop()
@@ -216,21 +284,36 @@ class StreamWorker:
         # quantile of the 1024-sample summary window. (The return value,
         # not the shared snapshot flag: raw archiving sets that flag
         # before the flush and would mask every mid-stream observation.)
-        if emitted:
+        # Under the async flusher the jobs time THEMSELVES into the same
+        # summary (_write_rows); timing the submit would double-count.
+        if emitted and self.flusher is None:
             self.stages.observe("flushing", (time.perf_counter() - t0) * 1e6)
 
     def _flush_closed(self, force: bool) -> bool:
         emitted = False
         for name, model in self.models.items():
             if isinstance(model, WindowAggregator):
-                rows = model.flush(force)
-                if len(rows["timeslot"]):
-                    self._emit(f"{name}", rows, len(rows["timeslot"]))
-                    emitted = True
+                if self.flusher is not None:
+                    # detach the closed stores under the lock (cheap dict
+                    # pops); row building + sink writes run on the flusher
+                    stores = model.pop_closed(force)
+                    if stores:
+                        from ..models.window_agg import rows_from_stores
+
+                        cfg = model.config
+                        self._emit(name, lambda c=cfg, s=stores:
+                                   rows_from_stores(c, s))
+                        emitted = True
+                else:
+                    rows = model.flush(force)
+                    if len(rows["timeslot"]):
+                        self._emit(f"{name}", rows, len(rows["timeslot"]))
+                        emitted = True
             elif isinstance(model, WindowedHeavyHitter):
                 for top in model.flush(force):
-                    n = int(top["valid"].sum())
-                    self._emit(f"{name}", top, n)
+                    # dict, or an unresolved LazyWindowTop (lazy_extract):
+                    # _emit materializes it wherever the write runs
+                    self._emit(f"{name}", top)
                     emitted = True
             elif isinstance(model, DDoSDetector):
                 if force:
@@ -241,11 +324,44 @@ class StreamWorker:
                     emitted = True
         return emitted
 
-    def _emit(self, table: str, rows, n: int) -> None:
+    @staticmethod
+    def _materialize(rows):
+        """Rows as handed to _emit -> concrete columnar rows/list."""
+        if callable(rows):
+            return rows()
+        if hasattr(rows, "resolve"):
+            return rows.resolve()
+        return rows
+
+    @staticmethod
+    def _row_count(rows) -> int:
+        if isinstance(rows, dict):
+            if "timeslot" in rows and "valid" not in rows:
+                return len(rows["timeslot"])
+            return int(rows["valid"].sum())
+        return len(rows)
+
+    def _emit(self, table: str, rows, n: Optional[int] = None) -> None:
+        """Write rows (or a deferred producer of rows) to the sinks —
+        inline, or via the background flusher when the ingest runtime is
+        on. A flusher failure surfaces on the next submit/drain and fails
+        that step BEFORE its offsets commit (at-least-once)."""
+        self._emitted_since_snapshot = True
+        if self.flusher is not None:
+            self.flusher.submit(
+                lambda: self._write_rows(table, rows, n))
+            return
+        self._write_rows(table, rows, n)
+
+    def _write_rows(self, table: str, rows, n: Optional[int]) -> None:
+        t0 = time.perf_counter()
+        rows = self._materialize(rows)
+        n = self._row_count(rows) if n is None else n
         for sink in self.sinks:
             sink.write(table, rows)
+        if self.flusher is not None:
+            self.stages.observe("flushing", (time.perf_counter() - t0) * 1e6)
         self.m_rows.inc(n)
-        self._emitted_since_snapshot = True
         log.info("flushed table=%s rows=%d", table, n)
 
     def finalize(self) -> None:
@@ -255,6 +371,10 @@ class StreamWorker:
             self.snapshot_and_commit()
         if hasattr(self.consumer, "lag"):
             self.m_lag.set(self.consumer.lag())
+        if self.executor is not None:
+            self.executor.stop()
+        if self.flusher is not None:
+            self.flusher.stop()
         if isinstance(self.consumer, PrefetchConsumer):
             self.consumer.stop()
 
@@ -263,6 +383,12 @@ class StreamWorker:
     def snapshot_and_commit(self) -> None:
         """Snapshot open state, then commit covered offsets. Order matters:
         state must be durable before the bus forgets the input."""
+        if self.flusher is not None:
+            # the snapshot no longer contains windows handed to the
+            # flusher; their rows must be IN the sinks before the state
+            # and offsets that forget them become durable — a flush
+            # failure raises here and the step dies uncommitted (replay)
+            self.flusher.drain()
         if self.config.checkpoint_path:
             save_checkpoint(self.config.checkpoint_path, self._state())
         self._emitted_since_snapshot = False

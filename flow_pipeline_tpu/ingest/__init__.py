@@ -1,0 +1,38 @@
+"""Host dataplane runtime: everything between "decoded batch" and
+"device step".
+
+Five rounds of e2e budgets said the same thing (BENCH_r05: host_group
+49.6% of wall, flushing 34.3%): the host side of the pipeline had no
+runtime of its own — one thread did grouping, the device step, window
+flushing and sink writes in strict sequence. This package gives it one,
+shaped like the partitioned pre-aggregation front-ends of the streaming
+top-K literature (PAPERS.md: arxiv 2511.16797, 2504.16896 — a sharded
+pre-aggregation stage FEEDING the sketch, never a global sort on the
+hot path):
+
+- ingest.shard     sharded grouping: hash-partitioned per-shard
+                   group/sum on a persistent thread pool (numpy releases
+                   the GIL), plus the native radix-group kernel switch.
+- ingest.executor  pipelined stage graph decode -> group -> device step
+                   with bounded queues, double buffering, backpressure
+                   and a drain/stop protocol.
+- ingest.flush     background flusher: top-K extraction and sink writes
+                   for closed windows run off the hot path, with errors
+                   propagated back to the worker.
+
+engine.worker wires these in behind --ingest.mode (serial keeps the old
+single-threaded path for A/B); per-stage queue depths export through
+obs.metrics as ingest_queue_depth / ingest_queue_highwater.
+"""
+
+from .executor import PipelinedExecutor
+from .flush import AsyncFlusher, FlushError
+from .shard import ShardPool, group_by_key_sharded
+
+__all__ = [
+    "AsyncFlusher",
+    "FlushError",
+    "PipelinedExecutor",
+    "ShardPool",
+    "group_by_key_sharded",
+]

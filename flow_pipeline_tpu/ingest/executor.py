@@ -1,0 +1,155 @@
+"""Pipelined stage executor: overlap host grouping with the device step.
+
+The feed side already double-buffers fetch+decode (engine.prefetch); this
+adds the missing stage: a group thread pulls decoded batches off the
+consumer and runs the pipeline's PREPARE half (pure host pre-aggregation,
+no model state) into a bounded queue, so grouping of batch N+1 overlaps
+the device step + window lifecycle of batch N on the worker thread.
+Stage graph, each arrow a bounded queue:
+
+    bus fetch+decode -> [prefetch q] -> group/prepare -> [prepared q]
+        -> device step (worker thread) -> [flush q] -> flusher
+
+Backpressure is the queues themselves: a slow device step fills the
+prepared queue and the group thread waits; a slow flusher blocks
+submit(). Nothing is dropped anywhere — the drain/stop protocol is that
+``next()`` returns None only after a poll round STARTED AFTER the call
+came back empty with the queue drained (the same freshness rule
+engine.prefetch documents, one stage further downstream), so
+stop_when_idle callers never abandon a tail in flight.
+
+Errors from the feed or prepare stages latch and re-raise from next() —
+a poison batch crashes the worker for the supervisor to restart, exactly
+like the serial path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+from ..obs import REGISTRY, get_logger
+
+log = get_logger("ingest.executor")
+
+
+class PipelinedExecutor:
+    """Runs ``prepare`` over consumer batches on a dedicated thread.
+
+    depth is the max prepared batches held ready (2 = double buffering:
+    one applying, one ready, one in prepare).
+    """
+
+    def __init__(self, consumer, prepare: Callable, poll_max: int = 32768,
+                 depth: int = 2, idle_sleep: float = 0.02):
+        self.consumer = consumer
+        self.prepare = prepare
+        self.poll_max = poll_max
+        self.depth = depth
+        self.idle_sleep = idle_sleep
+        self._out: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._idle = threading.Event()
+        # freshness accounting (see engine.prefetch.PrefetchConsumer.poll)
+        self._started = 0
+        self._completed_start = 0
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self.m_depth = REGISTRY.gauge(
+            "ingest_queue_depth", "items queued per ingest stage")
+        self.m_high = REGISTRY.gauge(
+            "ingest_queue_highwater", "max queue depth seen per ingest stage")
+        self.high_water = 0
+
+    # ---- worker surface ---------------------------------------------------
+
+    def next(self):
+        """Next prepared batch, or None when the stream is idle (fresh
+        idle round + empty queue). Raises the first stage error."""
+        if self._thread is None:
+            self._start()
+        started_before = self._started
+        while True:
+            if self._error is not None:
+                raise self._error
+            try:
+                item = self._out.get(timeout=self.idle_sleep)
+                self.m_depth.set(self._out.qsize(), stage="group")
+                return item
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    if self._error is not None:
+                        raise self._error
+                    return None
+                if self._idle.is_set() and \
+                        self._completed_start > started_before:
+                    return None
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop the group thread. Prepared-but-unapplied batches are
+        dropped — their offsets are uncommitted, so they replay."""
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("ingest group thread did not stop in time")
+        self._thread = None
+        self._stop.clear()
+        # actually drop the retained batches (and any latched error): a
+        # worker that restore()s and runs again would otherwise apply
+        # these stale preparations AND re-poll their rewound offsets —
+        # double counting — and until then they pin full FlowBatches
+        while True:
+            try:
+                self._out.get_nowait()
+            except queue.Empty:
+                break
+        self._idle.clear()
+        self._error = None
+        self.m_depth.set(0, stage="group")
+
+    # ---- group thread -----------------------------------------------------
+
+    def _start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="ingest-group", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self._out.full():
+                # device side is behind: the bounded queue IS the
+                # backpressure — wait instead of spinning
+                self._stop.wait(self.idle_sleep)
+                continue
+            self._started += 1
+            round_no = self._started
+            try:
+                batch = self.consumer.poll(self.poll_max)
+            except Exception as e:  # noqa: BLE001 — surface via next()
+                log.exception("ingest poll failed; surfacing to worker")
+                self._error = e
+                break
+            if batch is None or len(batch) == 0:
+                self._idle.set()
+                self._completed_start = round_no
+                self._stop.wait(self.idle_sleep)
+                continue
+            try:
+                prep = self.prepare(batch)
+            except Exception as e:  # noqa: BLE001 — surface via next()
+                log.exception("ingest prepare failed; surfacing to worker")
+                self._error = e
+                break
+            self._idle.clear()
+            self._completed_start = round_no
+            # space is guaranteed: this thread is the only producer and
+            # it checked full() above; next() only ever removes items
+            self._out.put(prep)
+            depth = self._out.qsize()
+            self.m_depth.set(depth, stage="group")
+            if depth > self.high_water:
+                self.high_water = depth
+                self.m_high.set(depth, stage="group")

@@ -1,0 +1,130 @@
+"""Background flusher: closed-window extraction + sink writes off the
+hot path.
+
+The worker's flush work — building columnar rows from a closed window
+store, extracting a sketch's top-K (a device sync), writing sinks — has
+no ordering dependency on the NEXT batch's update; only on the state
+captured at close time. So the worker captures that state under its lock
+(cheap: dict pops and jax array references) and hands zero-arg jobs
+here, where they run on one background thread in submission order.
+
+Error contract: sink/extraction failures must FAIL THE STEP, not drop
+rows silently (at-least-once semantics — an unwritten window must keep
+its offsets uncommitted so a restart replays it). The first job
+exception is latched and re-raised, wrapped in FlushError, from the next
+submit()/drain() on the worker thread; drain() is called before every
+offset commit, so no commit can cover rows whose write failed.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+from ..obs import REGISTRY, get_logger
+
+log = get_logger("ingest.flush")
+
+
+class FlushError(RuntimeError):
+    """A background flush job failed; the wrapped cause is __cause__."""
+
+
+class AsyncFlusher:
+    """One background thread draining a bounded queue of flush jobs.
+
+    max_queue bounds memory (each job pins one window's rows/state);
+    submit() blocks when full — backpressure, never silent dropping.
+    """
+
+    def __init__(self, max_queue: int = 8):
+        self.max_queue = max_queue
+        self._jobs: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._error: Optional[BaseException] = None
+        self._cv = threading.Condition()
+        self._inflight = 0  # queued + currently executing
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.m_depth = REGISTRY.gauge(
+            "ingest_queue_depth", "items queued per ingest stage")
+        self.m_high = REGISTRY.gauge(
+            "ingest_queue_highwater", "max queue depth seen per ingest stage")
+        self._high = 0
+
+    # ---- worker-thread surface -------------------------------------------
+
+    def submit(self, job: Callable[[], None]) -> None:
+        """Enqueue one zero-arg flush job. Raises FlushError if a previous
+        job failed (the step that observes it must not commit)."""
+        self._check()
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="ingest-flush", daemon=True)
+            self._thread.start()
+        with self._cv:
+            self._inflight += 1
+        self._jobs.put(job)
+        depth = self._jobs.qsize()
+        self.m_depth.set(depth, stage="flush")
+        if depth > self._high:
+            self._high = depth
+            self.m_high.set(depth, stage="flush")
+
+    def drain(self, timeout: float = 60.0) -> None:
+        """Block until every submitted job has finished; re-raise the
+        first failure. Call before committing offsets."""
+        with self._cv:
+            done = self._cv.wait_for(
+                lambda: self._inflight == 0 or self._error is not None,
+                timeout)
+        self._check()
+        if not done:
+            raise FlushError("flush queue did not drain in time")
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Drain and stop the thread. Safe to call twice."""
+        if self._thread is None:
+            return
+        try:
+            self.drain(timeout)
+        finally:
+            self._stop.set()
+            self._jobs.put(None)  # wake the thread
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                # wedged inside a job (e.g. a sink write with no socket
+                # timeout): refuse to pretend it stopped — resetting
+                # _thread here would let a later submit() start a SECOND
+                # consumer of the same queue and run flush jobs out of
+                # submission order
+                raise TimeoutError(
+                    "ingest flusher thread did not stop in time")
+            self._thread = None
+            self._stop.clear()
+
+    def _check(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise FlushError(f"background flush failed: {err}") from err
+
+    # ---- flusher thread ---------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            job = self._jobs.get()
+            if job is None:
+                continue
+            try:
+                job()
+            except Exception as e:  # noqa: BLE001 — latched for the worker:
+                # swallowing would break at-least-once (rows silently lost
+                # under committed offsets)
+                log.exception("flush job failed; surfacing to worker")
+                if self._error is None:
+                    self._error = e
+            finally:
+                self.m_depth.set(self._jobs.qsize(), stage="flush")
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()

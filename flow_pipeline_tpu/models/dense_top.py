@@ -152,6 +152,21 @@ def _planes_to_uint64(planes: np.ndarray) -> np.ndarray:
     return p[..., 0] + (p[..., 1] << np.uint64(16))
 
 
+def _top_from_totals(totals, config: DenseTopConfig,
+                     k: int | None) -> dict[str, np.ndarray]:
+    """Materialize top-k rows from one captured totals array — pure
+    function so lazy extraction stays valid after the model moves on."""
+    k = min(k or 100, config.domain)
+    idx, planes, valid = dense_top(totals, config=config, k=k)
+    rows = _planes_to_uint64(np.asarray(planes))  # exact values
+    out: dict[str, np.ndarray] = {config.key_col: np.asarray(idx)}
+    for j, name in enumerate(config.value_cols):
+        out[name] = rows[:, j]
+    out["count"] = rows[:, -1]
+    out["valid"] = np.asarray(valid)
+    return out
+
+
 class DenseTopKModel:
     """Host wrapper with the HeavyHitterModel surface (update/top/reset),
     so WindowedHeavyHitter can drive it interchangeably."""
@@ -177,16 +192,14 @@ class DenseTopKModel:
         return self.totals  # sharded subclass reduces over the device axis
 
     def top(self, k: int | None = None) -> dict[str, np.ndarray]:
-        k = min(k or 100, self.config.domain)
-        idx, planes, valid = dense_top(self._merged_totals(),
-                                       config=self.config, k=k)
-        rows = _planes_to_uint64(np.asarray(planes))  # exact values
-        out: dict[str, np.ndarray] = {self.config.key_col: np.asarray(idx)}
-        for j, name in enumerate(self.config.value_cols):
-            out[name] = rows[:, j]
-        out["count"] = rows[:, -1]
-        out["valid"] = np.asarray(valid)
-        return out
+        return _top_from_totals(self._merged_totals(), self.config, k)
+
+    def top_lazy(self, k: int | None = None):
+        """Zero-arg closure producing top(k) from the totals captured now
+        (immutable array; reset/update replace it) — lets the ingest
+        flusher run the extraction off the update path."""
+        totals, config = self._merged_totals(), self.config
+        return lambda: _top_from_totals(totals, config, k)
 
     def reset(self) -> None:
         self.totals = jnp.zeros_like(self.totals)

@@ -214,6 +214,33 @@ def hh_estimates(state: HHState, *, config: HeavyHitterConfig):
     return cms_ops.cms_query(state.cms, state.table_keys)
 
 
+def _top_from_state(state: HHState, config: HeavyHitterConfig,
+                    k: int) -> dict[str, np.ndarray]:
+    """Materialize top-k rows from one captured state — pure function so
+    lazy extraction (top_lazy) stays valid after the model moves on."""
+    keys, vals, valid = topk_ops.topk_extract(
+        state.table_keys, state.table_vals, k
+    )
+    ests = hh_estimates(state, config=config)[:k]
+    keys = np.asarray(keys)
+    vals = np.asarray(vals)
+    ests = np.asarray(ests)
+    valid = np.asarray(valid)
+    out: dict[str, np.ndarray] = {}
+    col = 0
+    for name in config.key_cols:
+        w = lane_width(name)
+        out[name] = keys[:, col : col + w] if w == 4 else keys[:, col]
+        col += w
+    for j, name in enumerate(config.value_cols):
+        out[name] = vals[:, j]
+        out[f"{name}_est"] = ests[:, j]
+    out["count"] = vals[:, -1]
+    out["count_est"] = ests[:, -1]
+    out["valid"] = valid
+    return out
+
+
 class HeavyHitterModel:
     """Host wrapper: feed batches, extract top-K at window close."""
 
@@ -236,30 +263,27 @@ class HeavyHitterModel:
     def top(self, k: int | None = None) -> dict[str, np.ndarray]:
         """Top-k rows: keys split back into columns + estimated sums.
 
-        ``table`` sums rank the rows; ``est`` columns are the CMS upper
-        bounds (tighter under conservative update)."""
-        k = k or self.config.capacity
-        keys, vals, valid = topk_ops.topk_extract(
-            self.state.table_keys, self.state.table_vals, k
-        )
-        ests = hh_estimates(self.state, config=self.config)[:k]
-        keys = np.asarray(keys)
-        vals = np.asarray(vals)
-        ests = np.asarray(ests)
-        valid = np.asarray(valid)
-        out: dict[str, np.ndarray] = {}
-        col = 0
-        for name in self.config.key_cols:
-            w = lane_width(name)
-            out[name] = keys[:, col : col + w] if w == 4 else keys[:, col]
-            col += w
-        for j, name in enumerate(self.config.value_cols):
-            out[name] = vals[:, j]
-            out[f"{name}_est"] = ests[:, j]
-        out["count"] = vals[:, -1]
-        out["count_est"] = ests[:, -1]
-        out["valid"] = valid
-        return out
+        Table values rank the rows and UPPER-BOUND true totals: a key
+        admitted mid-window is seeded with its CMS estimate at admission
+        (space-saving admission, ops.topk.topk_merge_est — the estimate
+        covers the key's pre-entry mass) and then takes exact increments
+        while resident. ``est`` columns are the CMS point estimates at
+        extraction time — an independent upper bound (tighter under
+        conservative update); for a key resident since window start the
+        table value is the exact observed sum and ``est`` bounds it."""
+        return _top_from_state(self.state, self.config,
+                               k or self.config.capacity)
+
+    def top_lazy(self, k: int | None = None):
+        """Zero-arg closure producing top(k) from the state captured NOW.
+
+        For the ingest runtime's background flusher: state arrays are
+        immutable and reset()/update() replace rather than mutate them,
+        so the extraction (a device sync) can run off-thread after the
+        window rolls."""
+        state, config = self.state, self.config
+        k = k or config.capacity
+        return lambda: _top_from_state(state, config, k)
 
     def reset(self) -> None:
         self.state = hh_init(self.config)

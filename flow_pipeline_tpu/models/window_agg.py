@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.hostgroup import _lex_regroup
 from ..ops.segment import hash_groupby, sort_groupby
 from ..utils.shards import local_device_blocks
 from ..schema.batch import FlowBatch, lane_width
@@ -173,8 +174,11 @@ class WindowAggregator:
         # async, so keeping results as device arrays until a flush needs
         # them lets the next chunk's sort overlap the previous transfer
         self._pending_partials: list = []
-        # host-grouped rows not yet folded (engine.hostfused's path)
+        # host-grouped rows not yet folded (engine.hostfused's path),
+        # with the min timeslot seen so the per-batch flush probe can
+        # prove "nothing closable" without forcing a fold
         self._pending_host: list = []
+        self._min_pending_slot: Optional[int] = None
 
     @property
     def store_key_lanes(self) -> int:
@@ -238,6 +242,7 @@ class WindowAggregator:
     def _drain(self) -> None:
         if self._pending_host:
             pending_h, self._pending_host = self._pending_host, []
+            self._min_pending_slot = None
             self._fold_rows(
                 np.concatenate([k for k, _ in pending_h]),
                 np.concatenate([v for _, v in pending_h]))
@@ -329,6 +334,10 @@ class WindowAggregator:
             [sums.astype(np.uint64),
              counts.astype(np.uint64)[:, None]], axis=1)
         self._pending_host.append((keys.astype(np.uint32), vals))
+        if len(keys):
+            lo = int(keys[:, 0].min())
+            if self._min_pending_slot is None or lo < self._min_pending_slot:
+                self._min_pending_slot = lo
         if len(self._pending_host) >= DRAIN_PENDING_MAX:
             self._drain()
 
@@ -369,6 +378,36 @@ class WindowAggregator:
             s for s in self.windows if s + self.config.window_seconds <= limit
         )
 
+    def _nothing_closable(self) -> bool:
+        """Cheap proof that flush(force=False) would emit nothing, WITHOUT
+        forcing a fold of the pending queues. flush() runs after every
+        batch but windows close hundreds of batches apart; skipping the
+        per-batch drain keeps the fold cadence at DRAIN_PENDING_MAX.
+        Device partials are opaque until synced, so any pending partial
+        means "maybe closable"; host-grouped rows carry their min slot."""
+        if self._pending_partials:
+            return False
+        cand = min(self.windows) if self.windows else None
+        if self._min_pending_slot is not None and (
+                cand is None or self._min_pending_slot < cand):
+            cand = self._min_pending_slot
+        if cand is None:
+            return True
+        limit = self.watermark - self.config.allowed_lateness
+        return cand + self.config.window_seconds > limit
+
+    def pop_closed(self, force: bool = False) -> list[tuple[int, dict]]:
+        """Detach finalized windows (all, if force) as (slot, store)
+        pairs. The popped stores are exclusively the caller's — late rows
+        for them REOPEN fresh stores, emitted as additional partials —
+        so row building (rows_from_stores) can run on another thread
+        (ingest.flush) while updates continue."""
+        if not force and self._nothing_closable():
+            return []
+        self._drain()
+        slots = sorted(self.windows) if force else self.closed_slots()
+        return [(slot, self.windows.pop(slot)) for slot in slots]
+
     def flush(self, force: bool = False) -> dict[str, np.ndarray]:
         """Pop finalized windows (all, if force) as columnar rows.
 
@@ -383,58 +422,70 @@ class WindowAggregator:
         the sink schema (sink/ddl.py flows_5m) is fixed, and a deployment
         that disables scaling must not silently write NULLs into the
         scaled columns its dashboards sum over (ADVICE r4)."""
-        self._drain()
-        slots = sorted(self.windows) if force else self.closed_slots()
-        scaled = self.config.scale_col is not None
-        nvals = len(self.config.value_cols)
-        rows_ts, rows_key, rows_val, rows_scaled = [], [], [], []
-        for slot in slots:
-            store = self.windows.pop(slot)
-            if scaled:
-                merged: dict[tuple, list] = {}
-                for key, acc in store.items():
-                    base, rate = key[:-1], max(int(key[-1]), 1)
-                    s = acc[:nvals] * np.uint64(rate)
-                    ent = merged.get(base)
-                    if ent is None:
-                        merged[base] = [acc.copy(), s]
-                    else:
-                        ent[0] += acc
-                        ent[1] += s
-                items = ((k, v[0], v[1]) for k, v in sorted(merged.items()))
-            else:
-                # unscaled: scaled sums == raw sums (rate treated as 1)
-                items = ((k, v, v[:nvals].copy())
-                         for k, v in sorted(store.items()))
-            for key, acc, s in items:
-                rows_ts.append(slot)
-                rows_key.append(key)
-                rows_val.append(acc)
-                rows_scaled.append(s)
-        if not rows_ts:
-            empty = {"timeslot": np.zeros(0, np.uint64)}
-            for name in self.config.value_cols + ("count",):
-                empty[name] = np.zeros(0, np.uint64)
-            for name in self.config.key_cols:
-                empty[name] = np.zeros(0, np.uint64)
-            for name in self.config.value_cols:
-                empty[f"{name}_scaled"] = np.zeros(0, np.uint64)
-            return empty
-        key_arr = np.asarray(rows_key, dtype=np.uint64)
-        val_arr = np.asarray(rows_val, dtype=np.uint64)
-        out = {"timeslot": np.asarray(rows_ts, dtype=np.uint64)}
-        col = 0
-        for name in self.config.key_cols:
-            width = lane_width(name)
-            if width == 1:
-                out[name] = key_arr[:, col]
-            else:
-                out[name] = key_arr[:, col : col + 4]
-            col += width
-        for j, name in enumerate(self.config.value_cols):
-            out[name] = val_arr[:, j]
-        out["count"] = val_arr[:, nvals]
-        scaled_arr = np.asarray(rows_scaled, dtype=np.uint64)
-        for j, name in enumerate(self.config.value_cols):
-            out[f"{name}_scaled"] = scaled_arr[:, j]
-        return out
+        return rows_from_stores(self.config, self.pop_closed(force))
+
+
+def rows_from_stores(config: WindowAggConfig,
+                     stores: list[tuple[int, dict]]) -> dict[str, np.ndarray]:
+    """Columnar flush rows from popped (slot, store) pairs — the second
+    half of flush(), a pure function so the ingest flusher can run it off
+    the worker thread. Vectorized: one lexsort + reduceat per slot
+    instead of a Python dict loop per key (the old per-key loop was the
+    dominant flush cost at 10k+ groups/window)."""
+    scaled = config.scale_col is not None
+    nvals = len(config.value_cols)
+    ts_parts, key_parts, val_parts, scaled_parts = [], [], [], []
+    for slot, store in stores:
+        if not store:
+            continue
+        keys = np.fromiter(
+            (x for key in store for x in key), dtype=np.uint64,
+            count=len(store) * (len(next(iter(store)))),
+        ).reshape(len(store), -1)
+        vals = np.stack(list(store.values())).astype(np.uint64)
+        if scaled:
+            base, rate = keys[:, :-1], np.maximum(keys[:, -1], 1)
+            svals = vals[:, :nvals] * rate[:, None]
+            # fold per-rate subgroups back to the reference key shape
+            # (shared exact-grouping helper — ops.hostgroup)
+            order, starts = _lex_regroup(base)
+            key_arr = base[order][starts]
+            val_arr = np.add.reduceat(vals[order], starts, axis=0)
+            scaled_arr = np.add.reduceat(svals[order], starts, axis=0)
+        else:
+            # unscaled: scaled sums == raw sums (rate treated as 1)
+            order = np.lexsort(keys.T[::-1])
+            key_arr = keys[order]
+            val_arr = vals[order]
+            scaled_arr = val_arr[:, :nvals].copy()
+        ts_parts.append(np.full(len(key_arr), slot, np.uint64))
+        key_parts.append(key_arr)
+        val_parts.append(val_arr)
+        scaled_parts.append(scaled_arr)
+    if not ts_parts:
+        empty = {"timeslot": np.zeros(0, np.uint64)}
+        for name in config.value_cols + ("count",):
+            empty[name] = np.zeros(0, np.uint64)
+        for name in config.key_cols:
+            empty[name] = np.zeros(0, np.uint64)
+        for name in config.value_cols:
+            empty[f"{name}_scaled"] = np.zeros(0, np.uint64)
+        return empty
+    key_arr = np.concatenate(key_parts)
+    val_arr = np.concatenate(val_parts)
+    scaled_arr = np.concatenate(scaled_parts)
+    out = {"timeslot": np.concatenate(ts_parts)}
+    col = 0
+    for name in config.key_cols:
+        width = lane_width(name)
+        if width == 1:
+            out[name] = key_arr[:, col]
+        else:
+            out[name] = key_arr[:, col : col + 4]
+        col += width
+    for j, name in enumerate(config.value_cols):
+        out[name] = val_arr[:, j]
+    out["count"] = val_arr[:, nvals]
+    for j, name in enumerate(config.value_cols):
+        out[f"{name}_scaled"] = scaled_arr[:, j]
+    return out

@@ -55,6 +55,16 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_char_p,
                 ctypes.c_longlong,
             ]
+            if hasattr(lib, "flow_hash_group"):  # pre-r6 .so lacks it
+                lib.flow_hash_group.restype = ctypes.c_longlong
+                lib.flow_hash_group.argtypes = [
+                    ctypes.c_void_p,  # [n, w] uint32 lanes
+                    ctypes.c_longlong,
+                    ctypes.c_longlong,
+                    ctypes.c_void_p,  # [n] int32 perm out
+                    ctypes.c_void_p,  # [n] int32 starts out
+                    ctypes.POINTER(ctypes.c_int32),  # collided out
+                ]
             _LIB = lib
             break
     return _LIB
@@ -102,6 +112,42 @@ def decode_stream(data: bytes, capacity_hint: int = 0):
     if n < 0:
         raise ValueError(f"native decode failed at frame {-n - 1}")
     return batch.slice(0, int(n))
+
+
+def group_available() -> bool:
+    """Whether the loaded library exports the hash-group kernel (an .so
+    built before r6 decodes fine but cannot group)."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "flow_hash_group")
+
+
+def hash_group(lanes: np.ndarray):
+    """Native hash-grouping of [N, W] uint32 key lanes.
+
+    Computes the same 64-bit row hash as ops.hostgroup.hash_u64, radix-
+    sorts it, and verifies lane equality within each hash group in one
+    C pass. Returns (perm [N] int32, starts [G] int32, collided bool) —
+    identical contract (and identical group order) to the numpy path, so
+    callers can switch per batch. Raises RuntimeError when the library
+    is missing or too old (callers gate on group_available())."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "flow_hash_group"):
+        raise RuntimeError("libflowdecode.so missing flow_hash_group; "
+                           "run `make native`")
+    lanes = np.ascontiguousarray(lanes, dtype=np.uint32)
+    n, w = lanes.shape
+    perm = np.empty(n, np.int32)
+    starts = np.empty(max(n, 1), np.int32)
+    collided = ctypes.c_int32(0)
+    g = lib.flow_hash_group(
+        lanes.ctypes.data_as(ctypes.c_void_p), n, w,
+        perm.ctypes.data_as(ctypes.c_void_p),
+        starts.ctypes.data_as(ctypes.c_void_p),
+        ctypes.byref(collided),
+    )
+    if g < 0:
+        raise ValueError("flow_hash_group failed (batch too large?)")
+    return perm, starts[:g], bool(collided.value)
 
 
 def encode_stream(batch, out_capacity: int = 0) -> bytes:

@@ -54,36 +54,55 @@ def hash_u64(lanes: np.ndarray) -> np.ndarray:
     return (out[0].astype(np.uint64) << np.uint64(32)) | out[1]
 
 
-def group_by_key(lanes: np.ndarray, planes: list[np.ndarray],
-                 exact: bool = True):
-    """Groupby-sum of ``planes`` by row-tuples of ``lanes``.
+def native_group_available() -> bool:
+    """Whether the native hash-group kernel (native.hash_group: same
+    64-bit hash, radix sort + collision verify in one C pass) can serve
+    as grouping backend. Callers opt in per call via ``native=True``
+    (--ingest.native_group); the pure-numpy path stays the reference
+    implementation the oracle tests pin down."""
+    from .. import native
 
-    Args:
-      lanes:  [N, W] uint32 key lanes.
-      planes: list of [N] or [N, P] arrays; each is summed per group with
-              ``np.add.reduceat`` in float64 (floating inputs) or uint64
-              (integer inputs) — callers cast the results down themselves.
-      exact:  verify every row against its group's representative key and
-              fall back to a full lexicographic sort on a 64-bit hash
-              collision (~n^2/2^65 per batch). Exactness-contract callers
-              (flows_5m) keep the default; sketch callers pass False and
-              accept the same merge-two-tuples failure mode their device
-              twin (ops.segment.hash_groupby_float) documents — skipping
-              the verify saves the [N, W] gather+compare (~15% of the
-              groupby at 12 lanes).
+    return native.group_available()
 
-    Returns (uniq [G, W] uint32, sums list matching ``planes``,
-    counts [G] int64). Group order is hash order (arbitrary but
-    deterministic); no consumer in this framework orders by key.
+
+def _empty_groups(w: int, planes: list[np.ndarray]):
+    return (np.zeros((0, w), np.uint32),
+            [np.zeros((0,) + p.shape[1:],
+                      np.float64 if np.issubdtype(p.dtype, np.floating)
+                      else np.uint64) for p in planes],
+            np.zeros(0, np.int64))
+
+
+def _lex_regroup(lanes: np.ndarray):
+    """Exact lexicographic grouping — the 64-bit-collision fallback."""
+    n = lanes.shape[0]
+    perm = np.lexsort(lanes.T[::-1])
+    sl = lanes[perm]
+    boundary = np.empty(n, dtype=bool)
+    boundary[0] = True
+    np.any(sl[1:] != sl[:-1], axis=1, out=boundary[1:])
+    return perm, np.flatnonzero(boundary)
+
+
+def grouping_perm(lanes: np.ndarray, exact: bool, h: np.ndarray = None,
+                  native: bool = False):
+    """Row permutation + group starts for hash grouping of ``lanes``.
+
+    The factored-out heart of group_by_key, reused by the sharded path
+    (ingest.shard, which precomputes ``h`` per shard) and anything else
+    that wants the grouping without the sums. Returns (perm, starts).
     """
-    n, w = lanes.shape
-    if n == 0:
-        return (np.zeros((0, w), np.uint32),
-                [np.zeros((0,) + p.shape[1:],
-                          np.float64 if np.issubdtype(p.dtype, np.floating)
-                          else np.uint64) for p in planes],
-                np.zeros(0, np.int64))
-    h = hash_u64(lanes)
+    n = lanes.shape[0]
+    if native and h is None:
+        from .. import native as native_lib
+
+        if native_lib.group_available():  # else: numpy fallback below
+            perm, starts, collided = native_lib.hash_group(lanes)
+            if exact and collided:
+                return _lex_regroup(lanes)
+            return perm, starts
+    if h is None:
+        h = hash_u64(lanes)
     perm = np.argsort(h)  # introsort; stability irrelevant (identity = hash)
     sh = h[perm]
     boundary = np.empty(n, dtype=bool)
@@ -91,20 +110,25 @@ def group_by_key(lanes: np.ndarray, planes: list[np.ndarray],
     np.not_equal(sh[1:], sh[:-1], out=boundary[1:])
     starts = np.flatnonzero(boundary)
     if exact:
+        # verify every row against its group's representative key; fall
+        # back to the full lexicographic sort on a 64-bit hash collision
+        # (~n^2/2^65 per batch) — exactness is unconditional on this path
         sl = lanes[perm]
         seg = np.cumsum(boundary) - 1
         if (sl != sl[starts][seg]).any():
-            # 64-bit hash collision between distinct key tuples: regroup
-            # lexicographically — exactness is unconditional on this path
-            perm = np.lexsort(lanes.T[::-1])
-            sl = lanes[perm]
-            boundary = np.empty(n, dtype=bool)
-            boundary[0] = True
-            np.any(sl[1:] != sl[:-1], axis=1, out=boundary[1:])
-            starts = np.flatnonzero(boundary)
-        uniq = sl[starts]
-    else:
-        uniq = lanes[perm[starts]]
+            return _lex_regroup(lanes)
+    return perm, starts
+
+
+def reduce_groups(lanes: np.ndarray, planes: list[np.ndarray],
+                  perm: np.ndarray, starts: np.ndarray):
+    """(uniq, sums, counts) for a grouping permutation from grouping_perm.
+
+    Each plane is summed per group with ``np.add.reduceat`` in float64
+    (floating inputs) or uint64 (integer inputs) — callers cast the
+    results down themselves."""
+    n = perm.shape[0]
+    uniq = lanes[perm[starts]]
     counts = np.diff(np.append(starts, n)).astype(np.int64)
     sums = []
     for p in planes:
@@ -113,6 +137,38 @@ def group_by_key(lanes: np.ndarray, planes: list[np.ndarray],
         sums.append(np.add.reduceat(p[perm].astype(acc_dtype), starts,
                                     axis=0))
     return uniq, sums, counts
+
+
+def group_by_key(lanes: np.ndarray, planes: list[np.ndarray],
+                 exact: bool = True, native: bool = False):
+    """Groupby-sum of ``planes`` by row-tuples of ``lanes``.
+
+    Args:
+      lanes:  [N, W] uint32 key lanes.
+      planes: list of [N] or [N, P] arrays, summed per group
+              (see reduce_groups for the accumulator dtypes).
+      exact:  verify every row against its group's representative key and
+              fall back to a full lexicographic sort on a 64-bit hash
+              collision (~n^2/2^65 per batch). Exactness-contract callers
+              (flows_5m) keep the default; sketch callers pass False and
+              accept the same merge-two-tuples failure mode their device
+              twin (ops.segment.hash_groupby_float) documents — skipping
+              the verify saves the [N, W] gather+compare (~15% of the
+              groupby at 12 lanes).
+      native: use the C hash-group kernel when built (collision verify is
+              free there, so ``exact`` costs nothing extra); silently
+              numpy when the library is missing — callers gate defaults
+              on native_group_available().
+
+    Returns (uniq [G, W] uint32, sums list matching ``planes``,
+    counts [G] int64). Group order is hash order (arbitrary but
+    deterministic); no consumer in this framework orders by key.
+    """
+    n, w = lanes.shape
+    if n == 0:
+        return _empty_groups(w, planes)
+    perm, starts = grouping_perm(lanes, exact, native=native)
+    return reduce_groups(lanes, planes, perm, starts)
 
 
 def select_lanes(key_cols: tuple, widths: dict[str, int],

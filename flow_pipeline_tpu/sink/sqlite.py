@@ -29,7 +29,25 @@ class SQLiteSink:
                 "CREATE TABLE IF NOT EXISTS journal "
                 "(table_name TEXT, record TEXT)"
             )
+            self._migrate()
             self._conn.commit()
+
+    def _migrate(self) -> None:
+        """Upgrade pre-r4 files to the current flows_5m shape.
+
+        CREATE TABLE IF NOT EXISTS is a no-op on an existing .db, so a
+        file created before the sampling-scaled columns landed keeps the
+        old schema and the first insert dies with "no column named
+        bytes_scaled" — the crash-loop the Postgres/ClickHouse DDL
+        already guards against (sink/ddl.py migrations). SQLite has no
+        ADD COLUMN IF NOT EXISTS, so probe PRAGMA table_info first.
+        Call under self._lock."""
+        have = {row[1] for row in
+                self._conn.execute("PRAGMA table_info(flows_5m)")}
+        for col in ("bytes_scaled", "packets_scaled"):
+            if have and col not in have:
+                self._conn.execute(
+                    f'ALTER TABLE flows_5m ADD COLUMN "{col}" INTEGER')
 
     def write(self, table: str, rows) -> None:
         records = rows_to_records(rows)

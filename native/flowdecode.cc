@@ -12,6 +12,7 @@
 //   flow_count_frames(data, len)                -> frames or -1-errpos
 //   flow_decode_stream(data, len, cols, cap)    -> rows or -1-badframe
 //   flow_encode_stream(cols, n, out, cap)       -> bytes written or -1
+//   flow_hash_group(lanes, n, w, perm, starts, collided) -> n_groups or -1
 //
 // Column pointer layout (must match schema.batch.COLUMNS order + widths):
 //   24 scalar columns, then 3 address columns of [N,4] uint32 (big-endian
@@ -228,9 +229,97 @@ constexpr FieldSpec kEmitOrder[] = {
     {42, COL_FLOW_DIRECTION, -1},
 };
 
+// ---- host groupby kernel (ops.hostgroup's native twin) ---------------------
+//
+// The CPU pipeline's pre-aggregation cost is NOT the sort: it is the
+// 2W numpy passes of the 64-bit lane hash plus the [N, W] gather+compare
+// verify pass (measured ~85% of group_by_key at 11 lanes). One C pass
+// computes the same hash (identical constants — ops.hostgroup.hash_u64),
+// radix-sorts (hash, row) pairs, marks group boundaries, and verifies
+// lanes against each group's representative row in cache order.
+
+// Same decorrelated multiplier/seed pairs as ops.hostgroup._MULTS/_SEEDS.
+inline uint32_t mix_lanes(const uint32_t* row, int64_t w, uint32_t mult,
+                          uint32_t seed) {
+  uint32_t h = seed;
+  for (int64_t i = 0; i < w; ++i) {
+    h = (h ^ row[i]) * mult;
+    h = (h << 13) | (h >> 19);
+  }
+  h ^= h >> 16;
+  h *= 0x85EBCA6BU;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35U;
+  h ^= h >> 16;
+  return h;
+}
+
 }  // namespace
 
 extern "C" {
+
+// Hash-group [n, w] uint32 key lanes: writes the row permutation ordering
+// rows by their 64-bit key hash into `perm`, group start offsets into
+// `starts` (both caller-allocated, n int32 entries), and sets *collided
+// when two DISTINCT lane rows share a 64-bit hash (callers needing
+// exactness re-group lexicographically, same contract as the numpy path).
+// Returns the number of groups, or -1 when n exceeds int32 indexing.
+long long flow_hash_group(const uint32_t* lanes, long long n, long long w,
+                          int32_t* perm, int32_t* starts,
+                          int32_t* collided) {
+  *collided = 0;
+  if (n <= 0) return 0;
+  if (n > INT32_MAX) return -1;
+  // hash + index pairs, double-buffered for the LSD radix passes
+  uint64_t* h = new uint64_t[2 * n];
+  uint32_t* idx = new uint32_t[2 * n];
+  uint64_t* hb = h + n;
+  uint32_t* ib = idx + n;
+  for (int64_t r = 0; r < n; ++r) {
+    const uint32_t* row = lanes + r * w;
+    uint64_t hi = mix_lanes(row, w, 0x9E3779B1U, 0x2545F491U);
+    uint64_t lo = mix_lanes(row, w, 0x85EBCA77U, 0x27220A95U);
+    h[r] = (hi << 32) | lo;
+    idx[r] = static_cast<uint32_t>(r);
+  }
+  // LSD radix, 8-bit digits: stable, so ties keep original row order
+  int64_t count[256];
+  for (int shift = 0; shift < 64; shift += 8) {
+    std::memset(count, 0, sizeof(count));
+    for (int64_t r = 0; r < n; ++r) ++count[(h[r] >> shift) & 0xFF];
+    int64_t pos = 0;
+    for (int d = 0; d < 256; ++d) {
+      int64_t c = count[d];
+      count[d] = pos;
+      pos += c;
+    }
+    for (int64_t r = 0; r < n; ++r) {
+      int64_t dst = count[(h[r] >> shift) & 0xFF]++;
+      hb[dst] = h[r];
+      ib[dst] = idx[r];
+    }
+    uint64_t* th = h; h = hb; hb = th;
+    uint32_t* ti = idx; idx = ib; ib = ti;
+  }
+  long long n_groups = 0;
+  const uint32_t* rep = nullptr;  // current group's representative row
+  for (int64_t r = 0; r < n; ++r) {
+    perm[r] = static_cast<int32_t>(idx[r]);
+    const uint32_t* row = lanes + static_cast<int64_t>(idx[r]) * w;
+    if (r == 0 || h[r] != h[r - 1]) {
+      starts[n_groups++] = static_cast<int32_t>(r);
+      rep = row;
+    } else if (!*collided &&
+               std::memcmp(row, rep, w * sizeof(uint32_t)) != 0) {
+      *collided = 1;
+    }
+  }
+  // the radix loop runs an even number of passes (8), so the sorted data
+  // ended up back in the originally-allocated halves — free matches new[]
+  delete[] (h < hb ? h : hb);
+  delete[] (idx < ib ? idx : ib);
+  return n_groups;
+}
 
 // Count length-prefixed frames. Returns -(errpos+1) on malformed input.
 long long flow_count_frames(const char* cdata, long long len) {

@@ -419,11 +419,32 @@ class TestCollectorServer:
         assert server.handle_sflow(sflow_datagram()) == 1
         assert server.handle_netflow(b"\x00\x63bogus") == 0  # version 99
         assert producer.produced == 3
-        assert server.m_nf_records.value() == 2
-        assert server.m_sf_samples.value(type="FlowSample") == 1
-        assert server.m_nf_errors.value() == 1
-        assert server.m_flow_bytes.value(type="NetFlow") == 2001
+        assert server.m_nf_records.value(router="") == 2
+        assert server.m_sf_samples.value(type="FlowSample",
+                                          agent="") == 1
+        assert server.m_nf_errors.value(router="") == 1
+        assert server.m_flow_bytes.value(type="NetFlow",
+                                         remote_ip="") == 2001
         assert server.m_udp_pkts.value() == 3
+
+    def test_per_exporter_labels(self):
+        """router= (NetFlow) / agent= (sFlow) labels carry the exporter
+        address, so the dashboards can break down by exporter like the
+        reference perfs.json does (`by (router)` / `by (agent)`)."""
+        bus, producer, server = self.make()
+        server.handle_netflow(v9_template_and_data(), "10.0.0.1:2055")
+        server.handle_sflow(sflow_datagram(), "10.0.0.2:6343")
+        server.handle_netflow(b"\x00\x63bogus", "10.0.0.3:2055")
+        assert server.m_nf_records.value(router="10.0.0.1") == 1
+        assert server.m_nf_templates.value(router="10.0.0.1") == 1
+        assert server.m_nf_errors.value(router="10.0.0.3") == 1
+        assert server.m_sf_samples.value(type="FlowSample",
+                                         agent="10.0.0.2") == 1
+        # flow traffic carries the exporter as remote_ip (GoFlow parity)
+        assert server.m_flow_bytes.value(type="NetFlow",
+                                         remote_ip="10.0.0.1") > 0
+        assert server.m_flow_bytes.value(type="sFlow",
+                                         remote_ip="10.0.0.2") > 0
 
     def test_struct_error_datagrams_survive(self):
         # crafted packets that trip fixed-layout unpacks (struct.error) must
@@ -438,8 +459,8 @@ class TestCollectorServer:
                         + struct.pack(">IIII", 0, 1, 1, 1)
                         + struct.pack(">II", 1, 400))  # sample len > datagram
         assert server.handle_sflow(lying_sample) == 0
-        assert server.m_nf_errors.value() == 1
-        assert server.m_sf_errors.value() == 2  # sFlow errors separate metric
+        assert server.m_nf_errors.value(router="") == 1
+        assert server.m_sf_errors.value(agent="") == 2  # sFlow errors separate metric
         assert producer.produced == 0
 
     def test_template_overrun_not_cached(self):

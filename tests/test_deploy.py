@@ -58,6 +58,19 @@ class TestCompose:
             assert any("6343" in p for p in ports)  # sFlow
             assert any("2055" in p for p in ports)  # NetFlow/IPFIX
 
+    def test_services_test_compose_is_backing_services_only(self):
+        # `make services-test` composes THIS file then runs the suite
+        # in-process: backing services with healthchecks (for --wait) and
+        # localhost ports matching the CI services job's env contract
+        doc = load("compose/services-test.yml")
+        assert set(doc["services"]) == {"kafka", "postgres", "clickhouse"}
+        for name, svc in doc["services"].items():
+            assert "healthcheck" in svc, name
+        assert any("9092" in p for p in doc["services"]["kafka"]["ports"])
+        assert any("5432" in p for p in doc["services"]["postgres"]["ports"])
+        assert any("8123" in p
+                   for p in doc["services"]["clickhouse"]["ports"])
+
     def test_fixedlen_on_clickhouse_paths(self):
         for path in ("compose/clickhouse-mock.yml",
                      "compose/clickhouse-collect.yml"):

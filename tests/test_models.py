@@ -335,3 +335,94 @@ class TestTablePrefilter:
             got = int(top["bytes"][i])
             assert abs(got - want[i]) <= 0.01 * want[i] + 1, \
                 (i, got, want[i])
+
+
+def drive_admission_rounds(rounds):
+    """Assert the space-saving admission bounds over a candidate stream.
+
+    ``rounds``: list of [(key, value), ...] batches. Uses a deliberately
+    NARROW CMS (width 64, depth 2 — ~20x more keys than cells) so
+    estimates over-state grossly and newcomers enter inflated, competing
+    with residents at the eviction boundary. Asserts after every merge:
+
+      (1) upper bound — every resident's table value >= its true total
+          (admission seeds the CMS estimate covering pre-entry mass;
+          residents take exact increments thereafter);
+      (2) Misra-Gries dropped mass — every evicted resident leaves with
+          tracked mass <= the minimum SURVIVING table value, so a key
+          whose true total dominates the boundary cannot be displaced,
+          over-estimated newcomers included (ops.topk.topk_merge_est's
+          documented guarantee).
+
+    Returns the number of resident evictions exercised, so callers can
+    require the adversarial case actually occurred.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from flow_pipeline_tpu.ops import cms as cms_ops
+    from flow_pipeline_tpu.ops import topk as topk_ops
+
+    C, N, DEPTH, WIDTH = 8, 16, 2, 64
+    cms = cms_ops.cms_init(1, DEPTH, WIDTH)
+    tk, tv = topk_ops.topk_init(C, 1, 1)
+    cms_add = jax.jit(cms_ops.cms_add_conservative)
+    cms_query = jax.jit(cms_ops.cms_query)
+    merge = jax.jit(topk_ops.topk_merge_est)
+    sentinel = int(topk_ops.SENTINEL)
+
+    def as_dict(keys, vals):
+        return {int(k[0]): float(v[0]) for k, v in
+                zip(np.asarray(keys), np.asarray(vals))
+                if k[0] != sentinel}
+
+    true: dict[int, float] = {}
+    evictions = 0
+    for pairs in rounds:
+        sums: dict[int, float] = {}
+        for k, v in pairs:
+            sums[k] = sums.get(k, 0.0) + v
+            true[k] = true.get(k, 0.0) + v
+        uniq = np.full((N, 1), topk_ops.SENTINEL, np.uint32)
+        vals = np.zeros((N, 1), np.float32)
+        valid = np.zeros(N, bool)
+        for i, (k, v) in enumerate(list(sums.items())[:N]):
+            uniq[i, 0] = k
+            vals[i, 0] = v
+            valid[i] = True
+        cms = cms_add(cms, jnp.asarray(uniq), jnp.asarray(vals),
+                      jnp.asarray(valid))
+        est = cms_query(cms, jnp.asarray(uniq))
+        old = as_dict(tk, tv)
+        tk, tv = merge(tk, tv, jnp.asarray(uniq), jnp.asarray(vals), est,
+                       jnp.asarray(valid))
+        table = as_dict(tk, tv)
+        for k, v in table.items():
+            assert v >= true[k] - 1e-3 * max(1.0, true[k]), \
+                f"table under-counts key {k}: {v} < true {true[k]}"
+        if table:
+            boundary = min(table.values())
+            for k, v in old.items():
+                if k not in table:
+                    evictions += 1
+                    assert v <= boundary + 1e-3 * max(1.0, boundary), (
+                        f"evicted resident {k} carried {v} past the "
+                        f"rank-C boundary {boundary}")
+    return evictions
+
+
+class TestSpaceSavingAdmissionSeeded:
+    """Seeded adversarial admission run (VERDICT r5 #5) — the same
+    bounds test_property.py fuzzes with hypothesis, kept runnable in
+    environments without it."""
+
+    def test_bounds_hold_and_evictions_occur(self):
+        rng = np.random.default_rng(3)
+        rounds = []
+        for _ in range(50):
+            ks = rng.integers(1, 1200, size=rng.integers(1, 17))
+            vs = rng.integers(1, 1000, size=len(ks))
+            rounds.append([(int(k), float(v)) for k, v in zip(ks, vs)])
+        evictions = drive_admission_rounds(rounds)
+        # the adversarial case must actually be exercised, not vacuous
+        assert evictions > 20

@@ -181,6 +181,18 @@ E2E_SCRIPT = textwrap.dedent("""
 """)
 
 
+def _skip_if_backend_cannot_multiprocess(outs) -> None:
+    """Old jax builds (<=0.4.x) cannot run multi-process collectives on
+    the CPU backend at all — the child dies inside XLA with this exact
+    message. That's an environment limit, not a regression in the
+    distributed program (newer jax runs these green); skip instead of
+    failing so the suite stays meaningful on both."""
+    for out in outs:
+        if "Multiprocess computations aren't implemented on the CPU" in out:
+            pytest.skip("installed jax cannot run multi-process CPU "
+                        "collectives (XLA INVALID_ARGUMENT)")
+
+
 def _run_pair(script, phase, ckpt, outdir, port, expect_crash=False):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -205,6 +217,7 @@ def _run_pair(script, phase, ckpt, outdir, port, expect_crash=False):
                 q.kill()
             pytest.fail(f"{phase} worker timed out")
         outs.append(out)
+    _skip_if_backend_cannot_multiprocess(outs)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         if expect_crash:
             # one process os._exit()s first and the other may be torn
@@ -336,6 +349,7 @@ class TestTwoProcessDistributed:
                     q.kill()
                 pytest.fail("distributed worker timed out")
             outs.append(out)
+        _skip_if_backend_cannot_multiprocess(outs)
         for pid, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"worker {pid} failed:\n{out}"
             assert f"MULTIHOST_OK {pid} 24.0" in out

@@ -55,6 +55,42 @@ class TestSQLite:
         })
         assert sink.query("SELECT bytes FROM flows_5m") == [(99,)]
 
+    def test_migrates_pre_r4_file_missing_scaled_columns(self, tmp_path):
+        """A .db created before the sampling-scaled columns landed must
+        be ALTERed at sink init, not crash-loop on the first insert
+        ('no column named bytes_scaled') — CREATE TABLE IF NOT EXISTS is
+        a no-op on existing files (ADVICE r5)."""
+        path = str(tmp_path / "pre_r4.db")
+        conn = sqlite3.connect(path)
+        conn.execute(
+            "CREATE TABLE flows_5m (timeslot INTEGER, src_as INTEGER, "
+            "dst_as INTEGER, etype INTEGER, bytes INTEGER, "
+            "packets INTEGER, count INTEGER)")
+        conn.execute(
+            "INSERT INTO flows_5m VALUES (0, 1, 2, 3, 10, 1, 1)")
+        conn.commit()
+        conn.close()
+        sink = SQLiteSink(path)
+        sink.write("flows_5m", {
+            "timeslot": np.array([300], np.uint64),
+            "src_as": np.array([65000], np.uint64),
+            "dst_as": np.array([65001], np.uint64),
+            "etype": np.array([0x86DD], np.uint64),
+            "bytes": np.array([99], np.uint64),
+            "packets": np.array([3], np.uint64),
+            "count": np.array([1], np.uint64),
+            "bytes_scaled": np.array([990], np.uint64),
+            "packets_scaled": np.array([30], np.uint64),
+        })
+        assert sink.query(
+            "SELECT bytes, bytes_scaled FROM flows_5m "
+            "WHERE timeslot = 300") == [(99, 990)]
+        # pre-migration rows survive with NULL scaled columns
+        assert sink.query(
+            "SELECT bytes_scaled FROM flows_5m WHERE timeslot = 0"
+        ) == [(None,)]
+        sink.close()
+
     def test_unknown_table_journaled(self):
         sink = SQLiteSink()
         sink.write("mystery", [{"a": 1}])
