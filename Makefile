@@ -3,7 +3,7 @@
 # The reference drives protoc through make (ref: Makefile:1-4); here make
 # additionally builds the native host-path library and runs the suite.
 
-.PHONY: all native test bench proto clean services-test
+.PHONY: all native test bench proto clean services-test lint native-san
 
 all: native
 
@@ -15,6 +15,21 @@ test:
 
 bench:
 	python bench.py
+
+# Static analysis (tools/flowlint): jit-purity, uint64 discipline, lock
+# annotations, flag registry. Dependency-free (stdlib ast only); exits
+# nonzero on any finding. docs/STATIC_ANALYSIS.md describes the rules.
+lint:
+	python -m tools.flowlint
+
+# Sanitizer builds + the 8-thread adversarial stress driver, both
+# ASan+UBSan and TSan (the correctness backstop for the native kernel
+# the concurrent ingest dataplane leans on).
+native-san:
+	$(MAKE) -C native san
+	$(MAKE) -C native tsan
+	python tools/flowlint/native_stress.py --mode san
+	python tools/flowlint/native_stress.py --mode tsan
 
 # Real-broker/-database integration proof (VERDICT r3/r4/r5): compose up
 # Kafka (KRaft) + Postgres + ClickHouse, run the service-integration

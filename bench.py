@@ -481,9 +481,18 @@ def bench_e2e() -> None:
 
 
 def bench_sweep() -> None:
-    """Tuning sweep for the flagship step: batch size x CMS width x impl.
-    One JSON line per point plus a final best-config line — run this the
-    moment real hardware is attached to pick hh defaults empirically."""
+    """Tuning sweep for the flagship step: batch size x CMS width x impl
+    x table prefilter x admission rule. One JSON line per point plus a
+    final best-config line — run this the moment real hardware is
+    attached to pick hh defaults empirically.
+
+    The (prefilter, admission) axes quantify the admission path
+    (VERDICT #2): prefilter on/off isolates the table-aware candidate
+    truncation, admission est/plain isolates topk_merge_est's extra
+    planes (space-saving CMS-seeded entry) vs the plain batch-sum merge.
+    These two legs run on CPU as well — the regression question is about
+    the admission path's relative cost, which the CPU A/B answers on
+    the same box with the same stream."""
     import jax
     import jax.numpy as jnp
 
@@ -494,9 +503,11 @@ def bench_sweep() -> None:
     batches = (16384, 32768, 65536) if on_tpu else SWEEP_BATCHES_CPU
     widths = (1 << 15, 1 << 16, 1 << 17) if on_tpu else (1 << 16,)
     impls = ("xla", "pallas") if on_tpu else ("xla",)
-    prefilters = (True, False) if on_tpu else (True,)
+    prefilters = (True, False)
+    admissions = ("est", "plain")
     gen = FlowGenerator(ZipfProfile(n_keys=100_000, alpha=1.1), seed=0)
     best = None
+    points = []
     for batch in batches:
         staged = []
         for _ in range(4):
@@ -509,31 +520,54 @@ def bench_sweep() -> None:
         for width in widths:
             for impl in impls:
                 for pre in prefilters:
-                    config = hh.HeavyHitterConfig(
-                        key_cols=("src_addr", "dst_addr"), batch_size=batch,
-                        width=width, capacity=1024, cms_impl=impl,
-                        table_prefilter=pre,
-                    )
-                    state = hh.hh_init(config)
-                    state = hh.hh_update(state, staged[0], valid,
-                                         config=config)
-                    jax.block_until_ready(state)
-                    steps = SWEEP_STEPS
-                    t0 = time.perf_counter()
-                    for i in range(steps):
-                        state = hh.hh_update(state, staged[i % 4], valid,
+                    for adm in admissions:
+                        config = hh.HeavyHitterConfig(
+                            key_cols=("src_addr", "dst_addr"),
+                            batch_size=batch,
+                            width=width, capacity=1024, cms_impl=impl,
+                            table_prefilter=pre, table_admission=adm,
+                        )
+                        state = hh.hh_init(config)
+                        state = hh.hh_update(state, staged[0], valid,
                                              config=config)
-                    jax.block_until_ready(state)
-                    rate = batch * steps / (time.perf_counter() - t0)
-                    point = {"batch": batch, "width": width, "impl": impl,
-                             "prefilter": pre,
-                             "flows_per_sec": round(rate, 1)}
-                    print(json.dumps({"metric": "hh sweep point", **point}))
-                    if best is None or rate > best["flows_per_sec"]:
-                        best = point
-    print(json.dumps({"metric": "hh sweep best", "unit": "flows/sec",
-                      "value": best["flows_per_sec"], "platform": _PLATFORM,
-                      **best}))
+                        jax.block_until_ready(state)
+                        steps = SWEEP_STEPS
+                        t0 = time.perf_counter()
+                        for i in range(steps):
+                            state = hh.hh_update(state, staged[i % 4],
+                                                 valid, config=config)
+                        jax.block_until_ready(state)
+                        rate = batch * steps / (time.perf_counter() - t0)
+                        point = {"batch": batch, "width": width,
+                                 "impl": impl, "prefilter": pre,
+                                 "admission": adm,
+                                 "flows_per_sec": round(rate, 1)}
+                        points.append(point)
+                        print(json.dumps(
+                            {"metric": "hh sweep point", **point}))
+                        if best is None or rate > best["flows_per_sec"]:
+                            best = point
+
+    def _median_rate(**match):
+        sel = [p["flows_per_sec"] for p in points
+               if all(p[k] == v for k, v in match.items())]
+        return statistics.median(sel) if sel else 0.0
+
+    # The two admission-path ratios the artifact exists to record: each
+    # compares matched configs differing ONLY in the axis under test.
+    pre_on, pre_off = (_median_rate(prefilter=True, admission="est"),
+                       _median_rate(prefilter=False, admission="est"))
+    adm_est, adm_plain = (_median_rate(prefilter=True, admission="est"),
+                          _median_rate(prefilter=True, admission="plain"))
+    print(json.dumps({
+        "metric": "hh sweep best", "unit": "flows/sec",
+        "value": best["flows_per_sec"], "platform": _PLATFORM,
+        **best,
+        "prefilter_speedup": round(pre_on / pre_off, 3) if pre_off else 0.0,
+        "est_vs_plain_admission": round(adm_est / adm_plain, 3)
+        if adm_plain else 0.0,
+        **_host_conditions(),
+    }))
 
 
 def bench_trace(logdir: str = "/tmp/flowtpu_trace") -> None:

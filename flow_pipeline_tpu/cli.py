@@ -141,6 +141,7 @@ def _build_models(vals):
             capacity=vals["sketch.capacity"],
             cms_impl=vals["sketch.cms"],
             table_prefilter=vals["sketch.prefilter"],
+            table_admission=vals["sketch.admission"],
         )
         if mesh:
             from .parallel import ShardedHeavyHitter
@@ -209,6 +210,9 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.boolean("model.ddos", True, "DDoS spike detector")
     fs.integer("sketch.width", 1 << 16, "Count-min width")
     fs.string("sketch.cms", "xla", "CMS update impl: xla | pallas")
+    fs.string("sketch.admission", "est",
+              "Top-K table admission: est (space-saving, CMS-seeded) | "
+              "plain (batch-sum merge; benchmarking A/B only)")
     fs.boolean("sketch.prefilter", True, "Pre-truncate table-merge "
                                          "candidates to top-capacity")
     fs.integer("sketch.capacity", 1024, "Top-K table capacity")

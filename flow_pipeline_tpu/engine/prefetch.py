@@ -19,6 +19,10 @@ protocol (state durable -> offsets committed) keeps its ordering.
 
 from __future__ import annotations
 
+# flowlint: lock-checked
+# (shared attributes declare their lock / single-writer story below;
+# `make lint` verifies write sites — see docs/STATIC_ANALYSIS.md)
+
 import queue
 import threading
 from typing import Optional
@@ -40,6 +44,7 @@ class PrefetchConsumer:
                  idle_sleep: float = 0.02):
         self.inner = consumer
         self.depth = depth
+        # flowlint: unguarded -- worker writes, feed thread reads; stale sizes are tolerated by the documented poll() contract
         self.poll_max = poll_max
         self.idle_sleep = idle_sleep
         self._batches: queue.Queue = queue.Queue(maxsize=depth)
@@ -47,18 +52,22 @@ class PrefetchConsumer:
         # pending-commit accounting: incremented on enqueue, decremented
         # after execution on the owner thread; a bare "queue empty" test
         # would race with a commit that is cleared-but-not-yet-enqueued
-        self._pending = 0
+        self._pending = 0  # guarded-by: _cv
+        # flowlint: unguarded -- the lock itself; bound once, never rebound
         self._cv = threading.Condition()
         self._stop = threading.Event()
         self._idle = threading.Event()  # last inner.poll returned nothing
         # freshness accounting for poll(): _started counts rounds begun,
         # _completed_start is the start-number of the last finished round
+        # flowlint: unguarded -- feed thread is the sole writer; worker reads a monotonic int
         self._started = 0
+        # flowlint: unguarded -- feed thread is the sole writer; worker reads a monotonic int
         self._completed_start = 0
         # first error from the feed thread; surfaced to the caller so a
         # poison message / dead broker crashes the worker (supervisor
         # restart semantics) instead of hanging or silently looping
-        self._error: Optional[BaseException] = None
+        self._error: Optional[BaseException] = None  # guarded-by: _cv
+        # flowlint: unguarded -- worker-thread lifecycle only (poll()/stop() run on the one owner thread)
         self._thread: Optional[threading.Thread] = None
 
     # ---- consumer surface --------------------------------------------------
@@ -180,7 +189,9 @@ class PrefetchConsumer:
                 # broker (which crashes the unwrapped worker for the
                 # supervisor to restart) into a silent infinite loop
                 log.exception("prefetch poll failed; surfacing to caller")
-                self._error = e
+                with self._cv:
+                    self._error = e
+                    self._cv.notify_all()  # flush_commits waiters re-check
                 break
             if batch is None or len(batch) == 0:
                 self._idle.set()
@@ -204,8 +215,9 @@ class PrefetchConsumer:
                 # reporting success for a commit that never reached the
                 # broker would falsify "state durable -> offsets committed"
                 log.exception("prefetch commit failed; surfacing to caller")
-                if self._error is None:
-                    self._error = e
+                with self._cv:
+                    if self._error is None:
+                        self._error = e
             finally:
                 with self._cv:
                     self._pending -= 1

@@ -25,6 +25,12 @@ like the serial path.
 
 from __future__ import annotations
 
+# flowlint: lock-checked
+# (this stage is deliberately lock-free: one group thread produces, one
+# worker thread consumes, and every shared field below is a single-writer
+# latch or counter handed across the GIL / the bounded queue. The
+# annotations make that story machine-checked — see docs/STATIC_ANALYSIS.md)
+
 import queue
 import threading
 from typing import Callable, Optional
@@ -52,14 +58,19 @@ class PipelinedExecutor:
         self._stop = threading.Event()
         self._idle = threading.Event()
         # freshness accounting (see engine.prefetch.PrefetchConsumer.poll)
+        # flowlint: unguarded -- group thread is the sole writer; worker reads a monotonic int
         self._started = 0
+        # flowlint: unguarded -- group thread is the sole writer; worker reads a monotonic int
         self._completed_start = 0
+        # flowlint: unguarded -- group thread is the sole writer; worker reads the GIL-atomic latch (stop() clears it after join)
         self._error: Optional[BaseException] = None
+        # flowlint: unguarded -- worker-thread lifecycle only (next()/stop() run on the one owner thread)
         self._thread: Optional[threading.Thread] = None
         self.m_depth = REGISTRY.gauge(
             "ingest_queue_depth", "items queued per ingest stage")
         self.m_high = REGISTRY.gauge(
             "ingest_queue_highwater", "max queue depth seen per ingest stage")
+        # flowlint: unguarded -- group thread is the sole writer; readers tolerate staleness (gauge)
         self.high_water = 0
 
     # ---- worker surface ---------------------------------------------------

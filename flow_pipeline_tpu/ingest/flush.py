@@ -18,6 +18,10 @@ offset commit, so no commit can cover rows whose write failed.
 
 from __future__ import annotations
 
+# flowlint: lock-checked
+# (shared attributes declare their lock / single-writer story below;
+# `make lint` verifies write sites — see docs/STATIC_ANALYSIS.md)
+
 import queue
 import threading
 from typing import Callable, Optional
@@ -41,15 +45,18 @@ class AsyncFlusher:
     def __init__(self, max_queue: int = 8):
         self.max_queue = max_queue
         self._jobs: queue.Queue = queue.Queue(maxsize=max_queue)
-        self._error: Optional[BaseException] = None
+        self._error: Optional[BaseException] = None  # guarded-by: _cv
+        # flowlint: unguarded -- the lock itself; bound once, never rebound
         self._cv = threading.Condition()
-        self._inflight = 0  # queued + currently executing
+        self._inflight = 0  # queued + currently executing  # guarded-by: _cv
         self._stop = threading.Event()
+        # flowlint: unguarded -- worker-thread lifecycle only (submit/stop run on the one owner thread)
         self._thread: Optional[threading.Thread] = None
         self.m_depth = REGISTRY.gauge(
             "ingest_queue_depth", "items queued per ingest stage")
         self.m_high = REGISTRY.gauge(
             "ingest_queue_highwater", "max queue depth seen per ingest stage")
+        # flowlint: unguarded -- highwater cache written only by the worker thread (submit)
         self._high = 0
 
     # ---- worker-thread surface -------------------------------------------
@@ -104,8 +111,10 @@ class AsyncFlusher:
             self._stop.clear()
 
     def _check(self) -> None:
-        if self._error is not None:
-            err, self._error = self._error, None
+        with self._cv:
+            err = self._error
+            self._error = None
+        if err is not None:
             raise FlushError(f"background flush failed: {err}") from err
 
     # ---- flusher thread ---------------------------------------------------
@@ -121,8 +130,9 @@ class AsyncFlusher:
                 # swallowing would break at-least-once (rows silently lost
                 # under committed offsets)
                 log.exception("flush job failed; surfacing to worker")
-                if self._error is None:
-                    self._error = e
+                with self._cv:
+                    if self._error is None:
+                        self._error = e
             finally:
                 self.m_depth.set(self._jobs.qsize(), stage="flush")
                 with self._cv:

@@ -20,7 +20,12 @@ The pool is a plain ThreadPoolExecutor kept alive across batches
 
 from __future__ import annotations
 
+# flowlint: lock-checked
+# (shared state declares its lock below; `make lint` verifies write
+# sites — see docs/STATIC_ANALYSIS.md)
+
 import os
+import threading
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -75,14 +80,19 @@ class ShardPool:
 
 # One process-wide pool: pipelines are rebuilt freely (bench samples,
 # supervisor restarts) and per-instance pools would strand idle threads.
-_SHARED: ShardPool | None = None
+_SHARED_LOCK = threading.Lock()
+_SHARED: ShardPool | None = None  # guarded-by: _SHARED_LOCK
 
 
 def shared_pool() -> ShardPool:
+    """The process-wide pool, created once. Two pipelines built
+    concurrently (supervisor restart racing a bench sample) must not
+    each spin up a pool and strand one forever — hence the lock."""
     global _SHARED
-    if _SHARED is None:
-        _SHARED = ShardPool()
-    return _SHARED
+    with _SHARED_LOCK:
+        if _SHARED is None:
+            _SHARED = ShardPool()
+        return _SHARED
 
 
 def _shard_bits(shards: int) -> int:

@@ -65,6 +65,14 @@ class HeavyHitterConfig:
     # throughput with zero top-20 error at the flagship config (100k-key
     # alpha=1.1 Zipf, 32k batches — flatter than real flow traffic).
     table_prefilter: bool = True
+    # Top-K table admission rule: "est" (default) is space-saving
+    # admission via ops.topk.topk_merge_est — a NEW key enters with its
+    # CMS estimate so table values upper-bound true totals; "plain" is
+    # the pre-r4 batch-sum merge (ops.topk.topk_merge), which silently
+    # under-counts keys admitted mid-window. "plain" exists for the A/B:
+    # `bench.py sweep` quantifies what the est admission's extra planes
+    # cost on the hot path (VERDICT #2).
+    table_admission: str = "est"
     # Serving-side sampling correction: multiply every value plane by
     # max(<scale_col>, 1) per row, so ranked bytes/packets estimate the
     # TRUE traffic the samples represent — the reference's dashboards
@@ -169,6 +177,17 @@ def _apply_grouped(state: HHState, uniq, sums, row_valid,
         metric = jnp.where(resident, jnp.inf, metric)
         _, sel = jax.lax.top_k(metric, 2 * c)
         uniq, sums, row_valid = uniq[sel], sums[sel], row_valid[sel]
+    if config.table_admission == "plain":
+        # A/B leg: batch-sum merge without the CMS-seeded admission (see
+        # HeavyHitterConfig.table_admission — benchmarking only)
+        tk, tv = topk_ops.topk_merge(
+            state.table_keys, state.table_vals, uniq, sums, row_valid
+        )
+        return HHState(cms=new_cms, table_keys=tk, table_vals=tv)
+    if config.table_admission != "est":
+        raise ValueError(
+            f"table_admission must be est|plain, got "
+            f"{config.table_admission!r}")
     # Space-saving admission: new keys enter with their CMS estimate (the
     # CMS above counted the FULL batch, so the estimate covers pre-entry
     # mass); resident keys take exact increments (topk_merge_est).

@@ -22,6 +22,10 @@ set ``allowed_lateness`` high enough to make reopening impossible.
 
 from __future__ import annotations
 
+# flowlint: uint64-exact
+# (flows_5m promises BIT-exact uint64 sums vs the reference rollup; see
+# docs/STATIC_ANALYSIS.md for what the marker enforces)
+
 import functools
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
@@ -98,7 +102,9 @@ def _window_keys_values(window, key_cols, value_cols, cols):
     planes = []
     for name in value_cols:
         v = cols[name].astype(jnp.uint32)
+        # flowlint: disable=uint64-discipline -- 16-bit planes: batch_size <= 32768 keeps int32 plane sums < 2^31 (exact)
         planes.append((v & jnp.uint32(0xFFFF)).astype(jnp.int32))
+        # flowlint: disable=uint64-discipline -- 16-bit planes: batch_size <= 32768 keeps int32 plane sums < 2^31 (exact)
         planes.append((v >> jnp.uint32(16)).astype(jnp.int32))
     values = jnp.stack(planes, axis=1)
     return keys, values

@@ -27,10 +27,39 @@ _SEARCH = [
     os.path.join(_HERE, "..", "..", "native", "libflowdecode.so"),
 ]
 
+# Loader override for instrumented builds (`make -C native san` / `tsan`
+# produce libflowdecode_{san,tsan}.so): FLOWDECODE_LIB points the ctypes
+# loader at an explicit .so. The override is STRICT — if the named
+# library cannot be loaded we raise instead of quietly falling back to
+# the regular build, because the only reason to set it is a sanitizer
+# run (tools/flowlint/native_stress.py) and a silent fallback would fake
+# a clean pass with uninstrumented code.
+_LIB_ENV = "FLOWDECODE_LIB"
+
 
 def _load() -> Optional[ctypes.CDLL]:
     global _LIB, _TRIED
     if _TRIED:
+        return _LIB
+    override = os.environ.get(_LIB_ENV)
+    if override:
+        # raise WITHOUT latching _TRIED: a failed strict override must
+        # stay loud on every call — latching would let a caller that
+        # swallowed the first error fall through to "no native library"
+        # and silently run uninstrumented code with the override set
+        if not os.path.exists(override):
+            raise RuntimeError(
+                f"{_LIB_ENV}={override} does not exist (build it with "
+                "`make -C native san` / `tsan`)")
+        try:
+            lib = ctypes.CDLL(override)
+        except OSError as e:
+            raise RuntimeError(
+                f"{_LIB_ENV}={override} failed to load: {e} (sanitizer "
+                "builds need their runtime preloaded — see "
+                "tools/flowlint/native_stress.py)") from e
+        _LIB = _bind(lib)
+        _TRIED = True
         return _LIB
     _TRIED = True
     for path in _SEARCH:
@@ -39,35 +68,41 @@ def _load() -> Optional[ctypes.CDLL]:
                 lib = ctypes.CDLL(path)
             except OSError:
                 continue
-            lib.flow_decode_stream.restype = ctypes.c_longlong
-            lib.flow_decode_stream.argtypes = [
-                ctypes.c_char_p,
-                ctypes.c_longlong,
-                ctypes.POINTER(ctypes.c_void_p),  # column buffer pointers
-                ctypes.c_longlong,  # capacity (rows)
-            ]
-            lib.flow_count_frames.restype = ctypes.c_longlong
-            lib.flow_count_frames.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
-            lib.flow_encode_stream.restype = ctypes.c_longlong
-            lib.flow_encode_stream.argtypes = [
-                ctypes.POINTER(ctypes.c_void_p),
-                ctypes.c_longlong,
-                ctypes.c_char_p,
-                ctypes.c_longlong,
-            ]
-            if hasattr(lib, "flow_hash_group"):  # pre-r6 .so lacks it
-                lib.flow_hash_group.restype = ctypes.c_longlong
-                lib.flow_hash_group.argtypes = [
-                    ctypes.c_void_p,  # [n, w] uint32 lanes
-                    ctypes.c_longlong,
-                    ctypes.c_longlong,
-                    ctypes.c_void_p,  # [n] int32 perm out
-                    ctypes.c_void_p,  # [n] int32 starts out
-                    ctypes.POINTER(ctypes.c_int32),  # collided out
-                ]
-            _LIB = lib
+            _LIB = _bind(lib)
             break
     return _LIB
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Attach the C ABI signatures (shared by the default search path and
+    the FLOWDECODE_LIB override)."""
+    lib.flow_decode_stream.restype = ctypes.c_longlong
+    lib.flow_decode_stream.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_void_p),  # column buffer pointers
+        ctypes.c_longlong,  # capacity (rows)
+    ]
+    lib.flow_count_frames.restype = ctypes.c_longlong
+    lib.flow_count_frames.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+    lib.flow_encode_stream.restype = ctypes.c_longlong
+    lib.flow_encode_stream.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_longlong,
+        ctypes.c_char_p,
+        ctypes.c_longlong,
+    ]
+    if hasattr(lib, "flow_hash_group"):  # pre-r6 .so lacks it
+        lib.flow_hash_group.restype = ctypes.c_longlong
+        lib.flow_hash_group.argtypes = [
+            ctypes.c_void_p,  # [n, w] uint32 lanes
+            ctypes.c_longlong,
+            ctypes.c_longlong,
+            ctypes.c_void_p,  # [n] int32 perm out
+            ctypes.c_void_p,  # [n] int32 starts out
+            ctypes.POINTER(ctypes.c_int32),  # collided out
+        ]
+    return lib
 
 
 def available() -> bool:

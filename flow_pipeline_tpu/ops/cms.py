@@ -23,6 +23,10 @@ a distinct seed per row.
 
 from __future__ import annotations
 
+# flowlint: uint64-exact
+# (bucket hashing must stay exact unsigned arithmetic — a signed cast
+# here skews every estimate; see docs/STATIC_ANALYSIS.md)
+
 from functools import partial
 
 import jax
@@ -44,6 +48,7 @@ def cms_buckets(keys, depth: int, width: int):
     cols = []
     for d in range(depth):  # depth is small + static: unrolled
         h = hash_words(keys, seed=d)
+        # flowlint: disable=uint64-discipline -- bucket INDICES in [0, width < 2^31), not counters; scatter wants int32
         cols.append((h % jnp.uint32(width)).astype(jnp.int32))
     return jnp.stack(cols, axis=0)
 

@@ -12,6 +12,10 @@ pure VPU element-wise work that XLA fuses into the surrounding kernel.
 
 from __future__ import annotations
 
+# flowlint: uint64-exact
+# (murmur3 word-lane hashing is pure uint32 wraparound arithmetic; a
+# signed cast or defaulted dtype silently changes every hash)
+
 from typing import Sequence
 
 import jax.numpy as jnp

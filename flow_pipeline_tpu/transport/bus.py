@@ -8,6 +8,10 @@ group. Thread-safe: producers and consumers may run on different threads
 
 from __future__ import annotations
 
+# flowlint: lock-checked
+# (every shared attribute below declares its lock; `make lint` verifies
+# each write site holds it — see docs/STATIC_ANALYSIS.md)
+
 import threading
 from dataclasses import dataclass
 from typing import Iterable, Optional
@@ -25,10 +29,12 @@ class InProcessBus:
     """A broker-less Kafka: partitioned logs + group offset commits."""
 
     def __init__(self):
+        # flowlint: unguarded -- the lock itself; bound once, never rebound
         self._lock = threading.RLock()
-        self._topics: dict[str, list[list[bytes]]] = {}
-        self._commits: dict[tuple[str, str, int], int] = {}  # (group, topic, p) -> next offset
-        self._rr = 0  # keyless-produce round-robin cursor (lock-guarded)
+        self._topics: dict[str, list[list[bytes]]] = {}  # guarded-by: _lock
+        # (group, topic, p) -> next offset
+        self._commits: dict[tuple[str, str, int], int] = {}  # guarded-by: _lock
+        self._rr = 0  # keyless-produce round-robin cursor  # guarded-by: _lock
 
     def create_topic(self, topic: str, partitions: int = 2) -> None:
         """Idempotent; the reference's default is 2 partitions

@@ -16,6 +16,39 @@ import os
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
+# The single flag registry. Every flag any binary declares MUST be listed
+# here (FlagSet's builders assert it; tools/flowlint's flag-registry rule
+# additionally checks that every `-x.y` string literal in the repo names
+# a registered flag and that every dotted flag is documented in
+# README/docs — see docs/STATIC_ANALYSIS.md). A typo'd flag name in a
+# bench harness or compose file otherwise parses fine and silently
+# measures the wrong configuration.
+KNOWN_FLAGS = frozenset({
+    # common
+    "loglevel", "kafka.topic", "kafka.brokers", "proto.fixedlen",
+    # generator / mocker
+    "produce.count", "produce.rate", "produce.seed", "produce.profile",
+    "produce.batch", "zipf.keys", "zipf.alpha", "out",
+    # processor
+    "processor.backend", "processor.batch", "processor.mesh",
+    "processor.fused", "processor.hostassist",
+    "model.flows5m", "model.talkers", "model.ips", "model.ports",
+    "model.ddos",
+    "sketch.width", "sketch.cms", "sketch.prefilter", "sketch.admission",
+    "sketch.capacity", "sketch.topk",
+    "window.lateness", "archive.raw", "feed.prefetch",
+    "ingest.mode", "ingest.shards", "ingest.depth", "ingest.flush_queue",
+    "ingest.native_group",
+    "checkpoint.path", "flush.count", "metrics.addr", "sink", "in",
+    "listen.feed", "query.addr",
+    # inserter
+    "postgres.dsn", "postgres.pass", "sqlite", "flush.dur",
+    # topic admin
+    "bus.partitions",
+    # collector
+    "listen.netflow", "listen.sflow", "run.seconds",
+})
+
 
 @dataclass
 class Flag:
@@ -41,20 +74,28 @@ class FlagSet:
         self._flags: dict[str, Flag] = {}
         self.values: dict[str, Any] = {}
 
+    def _register(self, flag: Flag) -> None:
+        if flag.name not in KNOWN_FLAGS:
+            raise ValueError(
+                f"flag -{flag.name} is not in utils.flags.KNOWN_FLAGS; "
+                "add it to the registry (and document it — `make lint` "
+                "enforces both)")
+        self._flags[flag.name] = flag
+
     def string(self, name: str, default: str, help_: str, env: str | None = None):
-        self._flags[name] = Flag(name, default, help_, str, env)
+        self._register(Flag(name, default, help_, str, env))
         return self
 
     def integer(self, name: str, default: int, help_: str):
-        self._flags[name] = Flag(name, default, help_, int)
+        self._register(Flag(name, default, help_, int))
         return self
 
     def number(self, name: str, default: float, help_: str):
-        self._flags[name] = Flag(name, default, help_, float)
+        self._register(Flag(name, default, help_, float))
         return self
 
     def boolean(self, name: str, default: bool, help_: str):
-        self._flags[name] = Flag(name, default, help_, _parse_bool, is_bool=True)
+        self._register(Flag(name, default, help_, _parse_bool, is_bool=True))
         return self
 
     def usage(self) -> str:

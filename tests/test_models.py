@@ -55,6 +55,31 @@ class TestHeavyHitterParity:
             errs.append(abs(got[key] - true) / true)
         assert max(errs) <= 0.01, f"max top-K bytes error {max(errs):.4f}"
 
+    def test_plain_admission_ab_leg_stays_accurate(self):
+        # -sketch.admission=plain (the bench A/B baseline without the
+        # CMS-seeded space-saving entry) must still place the oracle
+        # top keys — with capacity >= distinct keys nothing is evicted,
+        # so table sums are exact even without seeded admission
+        config = HeavyHitterConfig(
+            key_cols=("src_addr", "dst_addr"), batch_size=2048,
+            width=1 << 12, capacity=256, table_admission="plain",
+        )
+        g = FlowGenerator(ZipfProfile(n_keys=100, alpha=1.3), seed=13)
+        batches = [g.batch(2048) for _ in range(4)]
+        model = self.run_model(config, batches)
+        top = model.top(5)
+        oracle = self.oracle_top(batches, config.key_cols, 5)
+        for i in range(5):
+            assert (top["src_addr"][i] == oracle["src_addr"][i]).all()
+            assert float(top["bytes"][i]) == float(oracle["bytes"][i])
+
+    def test_bad_admission_rejected(self):
+        config = HeavyHitterConfig(batch_size=256, width=1 << 10,
+                                   capacity=32, table_admission="bogus")
+        g = FlowGenerator(ZipfProfile(n_keys=10), seed=1)
+        with pytest.raises(ValueError, match="table_admission"):
+            HeavyHitterModel(config).update(g.batch(256))
+
     def test_five_tuple_talkers(self):
         config = HeavyHitterConfig(
             key_cols=("src_addr", "dst_addr", "src_port", "dst_port", "proto"),

@@ -193,7 +193,11 @@ def _skip_if_backend_cannot_multiprocess(outs) -> None:
                         "collectives (XLA INVALID_ARGUMENT)")
 
 
-def _run_pair(script, phase, ckpt, outdir, port, expect_crash=False):
+def _run_procs(script, phase, ckpt, outdir, port, nprocs=2,
+               expect_crash=False, timeout=300):
+    """Launch ``nprocs`` jax.distributed worker processes of ``script``
+    and collect their outputs (generalized from the original 2-process
+    pair runner; the host-loss test runs 4 then 3)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
@@ -202,16 +206,16 @@ def _run_pair(script, phase, ckpt, outdir, port, expect_crash=False):
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(pid), str(port), phase,
-             str(ckpt), str(outdir)],
+             str(ckpt), str(outdir), str(nprocs)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env=env,
         )
-        for pid in (0, 1)
+        for pid in range(nprocs)
     ]
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -228,6 +232,11 @@ def _run_pair(script, phase, ckpt, outdir, port, expect_crash=False):
         else:
             assert p.returncode == 0, f"{phase} worker {pid} failed:\n{out}"
     return outs
+
+
+def _run_pair(script, phase, ckpt, outdir, port, expect_crash=False):
+    return _run_procs(script, phase, ckpt, outdir, port, nprocs=2,
+                      expect_crash=expect_crash, timeout=240)
 
 
 class TestTwoProcessWorkerE2E:
@@ -311,6 +320,227 @@ class TestTwoProcessWorkerE2E:
         }
         # the emitted top-20 rows must each match the oracle exactly, and
         # the oracle's 20 heaviest pairs must all be present
+        for key, vals in got_top.items():
+            assert want_top[key] == vals
+        heaviest = sorted(want_top, key=lambda k: -want_top[k][0])[:20]
+        assert set(heaviest) == set(got_top)
+
+
+REBALANCE_SCRIPT = textwrap.dedent("""
+    import json, os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, {repo!r})
+    from flow_pipeline_tpu.utils.platform import force_cpu
+    force_cpu()
+    import jax
+    import numpy as np
+    from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+    from flow_pipeline_tpu.models import HeavyHitterConfig, WindowAggConfig
+    from flow_pipeline_tpu.parallel import make_mesh
+    from flow_pipeline_tpu.parallel.multihost import (
+        MultihostPipeline, init_distributed, reassign_lost_partitions)
+
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    phase = sys.argv[3]; ckpt = sys.argv[4]; outdir = sys.argv[5]
+    nprocs = int(sys.argv[6])
+    N_PARTS, PER_CHIP, N_BATCHES, SNAP_AT = 4, 128, 8, 3
+    GLOBAL = PER_CHIP * N_PARTS
+    init_distributed(f"127.0.0.1:{{port}}", nprocs, pid)
+    mesh = make_mesh()  # 1 local device per process
+
+    pipe = MultihostPipeline(
+        mesh,
+        WindowAggConfig(batch_size=PER_CHIP),
+        {{"top_pairs": HeavyHitterConfig(
+            key_cols=("src_addr", "dst_addr"), batch_size=PER_CHIP,
+            width=1 << 10, capacity=64)}},
+        k=20,
+    )
+
+    # every process derives the identical global stream; partition p is
+    # the p-th contiguous row-quarter of each global batch
+    gen = FlowGenerator(ZipfProfile(n_keys=30, alpha=1.4), seed=11, t0=9000)
+    batches = [gen.batch(GLOBAL) for _ in range(N_BATCHES)]
+    COLS = ("time_received", "src_as", "dst_as", "etype", "bytes",
+            "packets", "src_addr", "dst_addr", "sampling_rate")
+    def part_slice(b, part):
+        cols = batches[b].device_columns(COLS)
+        sl = slice(part * PER_CHIP, (part + 1) * PER_CHIP)
+        return {{k: np.ascontiguousarray(np.asarray(v)[sl])
+                for k, v in cols.items()}}
+    # watermark may be passed eagerly: no flush happens until the final
+    # force-flush, and update() only records the max
+    wm = max(int(b.columns["time_received"].max()) for b in batches)
+
+    if phase == "first":
+        # 4 processes; each ingests its own partition for SNAP_AT batches.
+        # Processes 0-2 snapshot (committing offsets 0..SNAP_AT-1);
+        # process 3 is then permanently lost with NOTHING durable — its
+        # committed offset stays 0, so the whole partition must replay.
+        for b in range(SNAP_AT):
+            pipe.update(part_slice(b, pid), np.ones(PER_CHIP, bool), wm)
+        if pid != 3:
+            pipe.snapshot(os.path.join(ckpt, str(pid)))
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("snapshots-durable")
+        print("SNAPSHOT_DONE", pid, flush=True)
+        if pid == 3:
+            # hard kill AFTER the barrier (a pre-barrier kill would hang
+            # the others inside the collective): this host never returns,
+            # and nothing of it is durable
+            print("LOST", pid, flush=True)
+            os._exit(0)
+        sys.exit(0)
+
+    # phase == "rebalance": the 3 survivors form a NEW world (nprocs=3),
+    # restore their own durable state, and re-consume the dead host's
+    # partition from its committed offset (0) — round-robined by the
+    # deterministic pure reassignment every survivor computes alone.
+    start = pipe.restore(os.path.join(ckpt, str(pid)))
+    assert start == SNAP_AT, start
+    survivors = list(range(nprocs))
+    assign = reassign_lost_partitions({{3: 0}}, survivors, N_BATCHES)
+    worklists = {{s: [(s, b) for b in range(SNAP_AT, N_BATCHES)] + assign[s]
+                 for s in survivors}}
+    rounds = max(len(w) for w in worklists.values())
+    zero = {{k: np.zeros_like(v) for k, v in part_slice(0, 0).items()}}
+    mine = worklists[pid]
+    for r in range(rounds):
+        if r < len(mine):
+            part, b = mine[r]
+            pipe.update(part_slice(b, part), np.ones(PER_CHIP, bool), wm)
+        else:  # padding round: all-invalid rows keep the collective shape
+            pipe.update(zero, np.zeros(PER_CHIP, bool), wm)
+
+    rows = pipe.flush(force=True)
+    f5 = rows["flows_5m"]
+    with open(os.path.join(outdir, f"flows5m_{{pid}}.json"), "w") as f:
+        json.dump({{k: np.asarray(v).tolist() for k, v in f5.items()}}, f)
+    if pid == 0:  # replicated merged top-K: identical on every survivor
+        top = rows["top_pairs"]
+        with open(os.path.join(outdir, "top.json"), "w") as f:
+            json.dump({{k: np.asarray(v).tolist() for k, v in top.items()}},
+                      f)
+    print("REBALANCE_OK", pid, flush=True)
+""")
+
+
+class TestReassignLostPartitions:
+    """The pure rebalance rule itself — runs everywhere (no collectives)."""
+
+    def test_round_robin_from_committed_offsets(self):
+        from flow_pipeline_tpu.parallel.multihost import (
+            reassign_lost_partitions,
+        )
+
+        out = reassign_lost_partitions({3: 0}, [0, 1, 2], 8)
+        # 8 orphan slices round-robined: deterministic, disjoint, complete
+        assert out[0] == [(3, 0), (3, 3), (3, 6)]
+        assert out[1] == [(3, 1), (3, 4), (3, 7)]
+        assert out[2] == [(3, 2), (3, 5)]
+
+    def test_committed_offsets_not_replayed(self):
+        from flow_pipeline_tpu.parallel.multihost import (
+            reassign_lost_partitions,
+        )
+
+        out = reassign_lost_partitions({5: 6, 7: 8}, [1, 2], 8)
+        got = sorted(sl for w in out.values() for sl in w)
+        # partition 5 replays only batches >= its committed offset 6;
+        # partition 7 was fully durable — nothing to replay
+        assert got == [(5, 6), (5, 7)]
+
+    def test_every_survivor_computes_identical_maps(self):
+        from flow_pipeline_tpu.parallel.multihost import (
+            reassign_lost_partitions,
+        )
+
+        maps = [reassign_lost_partitions({2: 1, 3: 4}, [0, 1], 6)
+                for _ in range(3)]
+        assert maps[0] == maps[1] == maps[2]
+
+
+class TestPermanentHostLoss:
+    """VERDICT r5 #5: 4 jax.distributed processes, one killed PERMANENTLY
+    (nothing durable), the 3 survivors restart as a smaller world and
+    re-consume the dead host's partition from its committed offset —
+    merged output must be oracle-exact over the full stream: nothing
+    lost with the dead host, nothing double-counted by the replay."""
+
+    def test_survivors_reconsume_lost_partition(self, tmp_path):
+        script = tmp_path / "worker_loss.py"
+        script.write_text(REBALANCE_SCRIPT.format(repo=os.path.abspath(REPO)))
+        ckpt = tmp_path / "ckpt"
+        outdir = tmp_path / "out"
+        ckpt.mkdir()
+        outdir.mkdir()
+
+        outs = _run_procs(script, "first", ckpt, outdir, _free_port(),
+                          nprocs=4)
+        assert any("LOST 3" in out for out in outs)
+        for pid in (0, 1, 2):
+            assert (ckpt / str(pid)).is_dir()
+        assert not (ckpt / "3").exists()  # the lost host left nothing
+        assert not list(outdir.iterdir())
+
+        outs = _run_procs(script, "rebalance", ckpt, outdir, _free_port(),
+                          nprocs=3)
+        for pid, out in enumerate(outs):
+            assert f"REBALANCE_OK {pid}" in out
+
+        import json
+
+        from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+        from flow_pipeline_tpu.models.oracle import exact_groupby
+        from flow_pipeline_tpu.schema.batch import FlowBatch
+
+        gen = FlowGenerator(ZipfProfile(n_keys=30, alpha=1.4), seed=11,
+                            t0=9000)
+        full = FlowBatch.concat([gen.batch(512) for _ in range(8)])
+
+        # flows_5m host-partial rows from the 3 survivors, merged by key,
+        # must equal the exact oracle over ALL FOUR partitions' rows
+        merged: dict[tuple, np.ndarray] = {}
+        for pid in (0, 1, 2):
+            rows = json.loads((outdir / f"flows5m_{pid}.json").read_text())
+            for i in range(len(rows["timeslot"])):
+                key = (rows["timeslot"][i], rows["src_as"][i],
+                       rows["dst_as"][i], rows["etype"][i])
+                acc = merged.setdefault(key, np.zeros(3, np.uint64))
+                acc += np.array([rows["bytes"][i], rows["packets"][i],
+                                 rows["count"][i]], np.uint64)
+        oracle = exact_groupby(full, ["src_as", "dst_as", "etype"],
+                               timeslot=True)
+        want = {
+            (int(oracle["timeslot"][i]), int(oracle["src_as"][i]),
+             int(oracle["dst_as"][i]), int(oracle["etype"][i])):
+            (int(oracle["bytes"][i]), int(oracle["packets"][i]),
+             int(oracle["count"][i]))
+            for i in range(len(oracle["timeslot"]))
+        }
+        got = {k: tuple(int(x) for x in v) for k, v in merged.items()}
+        assert got == want
+        # exact row conservation: the lost partition replayed exactly once
+        assert sum(v[2] for v in got.values()) == len(full)
+
+        # top-K (capacity 64 > 30 keys: nothing evicted -> exact sums)
+        top = json.loads((outdir / "top.json").read_text())
+        got_top = {}
+        for i in range(len(top["valid"])):
+            if not top["valid"][i]:
+                continue
+            key = (tuple(top["src_addr"][i]), tuple(top["dst_addr"][i]))
+            got_top[key] = (int(top["bytes"][i]), int(top["packets"][i]),
+                            int(top["count"][i]))
+        pairs = exact_groupby(full, ["src_addr", "dst_addr"])
+        src = np.asarray(pairs["src_addr"]).reshape(len(pairs["bytes"]), -1)
+        dst = np.asarray(pairs["dst_addr"]).reshape(len(pairs["bytes"]), -1)
+        want_top = {
+            (tuple(int(x) for x in src[i]), tuple(int(x) for x in dst[i])):
+            (int(pairs["bytes"][i]), int(pairs["packets"][i]),
+             int(pairs["count"][i]))
+            for i in range(len(pairs["bytes"]))
+        }
         for key, vals in got_top.items():
             assert want_top[key] == vals
         heaviest = sorted(want_top, key=lambda k: -want_top[k][0])[:20]

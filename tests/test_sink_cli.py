@@ -139,6 +139,7 @@ class TestCLI:
         assert main(["fnord"]) == 2
 
     def test_unknown_flag(self, capsys):
+        # flowlint: disable=flag-registry -- deliberately unregistered: this IS the unknown-flag rejection test
         assert main(["pipeline", "-not.a.flag", "x"]) == 2
         assert "not.a.flag" in capsys.readouterr().err
 

@@ -1,0 +1,8 @@
+"""flowlint — the repo's dependency-free static-analysis suite.
+
+Run as ``python -m tools.flowlint`` from the repo root (``make lint``).
+Rules: jit-purity, uint64-discipline, lock-discipline, flag-registry
+(see docs/STATIC_ANALYSIS.md).
+"""
+
+from .runner import run_lint  # noqa: F401

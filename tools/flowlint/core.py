@@ -1,0 +1,176 @@
+"""flowlint core: file model, suppression handling, finding reports.
+
+flowlint is the repo's dependency-free static analyzer (stdlib ``ast``
+only — it must run in a bare CI interpreter before any wheel installs).
+Each rule module consumes ``SourceFile`` objects and yields ``Finding``s;
+this module owns everything rule-independent:
+
+- loading + parsing source files once, shared across rules;
+- module markers (``# flowlint: uint64-exact``, ``# flowlint:
+  lock-checked``) that opt a file into a rule's scope;
+- line suppressions: ``# flowlint: disable=<rule>[,<rule>] -- <reason>``
+  on the finding line or the line above. The justification text after
+  ``--`` is MANDATORY — an unexplained suppression is itself a finding
+  (rule ``suppression``), so every escape hatch documents why it is safe
+  (see docs/STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+_DISABLE_RE = re.compile(
+    r"#\s*flowlint:\s*disable=([\w,-]+)(?:\s*--\s*(.*\S))?")
+_MARKER_RE = re.compile(r"#\s*flowlint:\s*([\w-]+)\s*$")
+
+
+@dataclass
+class Suppression:
+    rules: tuple[str, ...]
+    line: int
+    reason: str | None
+    used: bool = False
+
+
+class SourceFile:
+    """One parsed source file plus its flowlint annotations."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: ast.Module | None = None
+        self.parse_error: str | None = None
+        try:
+            self.tree = ast.parse(text, filename=rel)
+        except SyntaxError as e:  # surfaced as a finding by the runner
+            self.parse_error = f"syntax error: {e}"
+        self.markers: set[str] = set()
+        self.suppressions: list[Suppression] = []
+        for i, line in enumerate(self.lines, start=1):
+            m = _DISABLE_RE.search(line)
+            if m:
+                rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+                self.suppressions.append(Suppression(rules, i, m.group(2)))
+            m = _MARKER_RE.search(line)
+            if m and m.group(1) not in ("disable",):
+                self.markers.add(m.group(1))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A finding is suppressed by a disable comment on its own line, or
+        on a comment-only line directly above (a trailing comment on the
+        previous statement must not mask the next line)."""
+        for s in self.suppressions:
+            if rule not in s.rules:
+                continue
+            if s.line == line:
+                s.used = True
+                return True
+            if s.line == line - 1 and \
+                    self.lines[s.line - 1].lstrip().startswith("#"):
+                s.used = True
+                return True
+        return False
+
+
+def load_files(root: str, rel_paths: list[str]) -> list[SourceFile]:
+    out = []
+    for rel in rel_paths:
+        path = os.path.join(root, rel)
+        with open(path, "r", encoding="utf-8") as f:
+            out.append(SourceFile(path, rel, f.read()))
+    return out
+
+
+def discover(root: str, subdirs: tuple[str, ...]) -> list[str]:
+    """Repo-relative .py paths under the given subdirs (sorted, stable)."""
+    rels = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        if os.path.isfile(base) and base.endswith(".py"):
+            rels.append(sub)
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rels.append(os.path.relpath(
+                        os.path.join(dirpath, fn), root))
+    return sorted(set(rels))
+
+
+def suppression_findings(files: list[SourceFile],
+                         known_rules: tuple[str, ...] = (),
+                         report_unused: bool = False) -> list[Finding]:
+    """Suppressions must carry a justification; unknown-rule and (on full
+    runs) unused suppressions are reported so they cannot rot in place.
+
+    Call AFTER the rules have run — ``Suppression.used`` is set by
+    ``suppressed()`` when a finding actually matches. ``report_unused``
+    is only sound when every rule a suppression names has run (the
+    runner sets it on full-scope runs only)."""
+    out = []
+    for sf in files:
+        for s in sf.suppressions:
+            if not s.reason:
+                out.append(Finding(
+                    "suppression", sf.rel, s.line,
+                    "disable comment without a justification "
+                    "(use `# flowlint: disable=<rule> -- <why this is safe>`)"))
+                continue
+            unknown = [r for r in s.rules
+                       if known_rules and r not in known_rules]
+            if unknown:
+                out.append(Finding(
+                    "suppression", sf.rel, s.line,
+                    f"disable comment names unknown rule(s) "
+                    f"{', '.join(unknown)} (known: "
+                    f"{', '.join(known_rules)})"))
+            elif report_unused and not s.used:
+                out.append(Finding(
+                    "suppression", sf.rel, s.line,
+                    "suppression no longer matches any finding — remove "
+                    "it (or the finding it hid has moved)"))
+    return out
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding] = field(default_factory=list)
+
+    def extend_filtered(self, files_by_rel: dict[str, SourceFile],
+                        findings: list[Finding]) -> None:
+        for f in findings:
+            sf = files_by_rel.get(f.path)
+            if sf is not None and sf.suppressed(f.rule, f.line):
+                continue
+            self.findings.append(f)

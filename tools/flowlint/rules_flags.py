@@ -1,0 +1,130 @@
+"""flag-registry: every dotted ``-x.y`` flag is declared and documented.
+
+The CLI reproduces the reference's Go-flag surface, which means flag
+names are plain strings — a typo in ``-ingest.natve_group`` inside a
+bench harness or compose file parses fine and silently measures the
+wrong configuration. This rule pins the whole surface to ONE registry:
+
+- ``utils/flags.py`` owns ``KNOWN_FLAGS`` (the registry; FlagSet's
+  builder methods also assert membership at runtime);
+- every ``FlagSet.string/integer/number/boolean("name", ...)`` literal
+  anywhere must be in the registry;
+- every string literal that IS a flag token (``"-x.y"`` or
+  ``"-x.y=value"``) must name a registered flag;
+- every dotted registry entry must be mentioned as ``-name`` in
+  README.md or docs/*.md — an undocumented knob is indistinguishable
+  from a dead one.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Finding, SourceFile, dotted_name
+
+RULE = "flag-registry"
+
+_DECL_METHODS = {"string", "integer", "number", "boolean"}
+_FLAG_TOKEN_RE = re.compile(r"^-{1,2}([a-z][\w]*(?:\.[\w]+)+)(?:=.*)?$")
+
+
+def _registry(files: list[SourceFile]) -> tuple[set[str], str | None]:
+    """KNOWN_FLAGS names from utils/flags.py, plus its rel path."""
+    for sf in files:
+        if not sf.rel.replace("\\", "/").endswith("utils/flags.py"):
+            continue
+        if sf.tree is None:
+            return set(), sf.rel
+        for node in sf.tree.body:
+            targets = node.targets if isinstance(node, ast.Assign) else (
+                [node.target] if isinstance(node, ast.AnnAssign) else [])
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == "KNOWN_FLAGS":
+                    val = node.value
+                    # unwrap frozenset({...}) / set({...}) constructor calls
+                    if isinstance(val, ast.Call) and val.args and \
+                            dotted_name(val.func) in ("frozenset", "set"):
+                        val = val.args[0]
+                    try:
+                        return set(ast.literal_eval(val)), sf.rel
+                    except (ValueError, TypeError):
+                        return set(), sf.rel
+        return set(), sf.rel
+    return set(), None
+
+
+def _doc_text(root: str) -> str:
+    chunks = []
+    candidates = [os.path.join(root, "README.md")]
+    docdir = os.path.join(root, "docs")
+    if os.path.isdir(docdir):
+        candidates += [os.path.join(docdir, f)
+                       for f in sorted(os.listdir(docdir))
+                       if f.endswith(".md")]
+    for path in candidates:
+        if os.path.exists(path):
+            with open(path, "r", encoding="utf-8") as f:
+                chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def check(files: list[SourceFile], root: str) -> list[Finding]:
+    findings: list[Finding] = []
+    known, reg_rel = _registry(files)
+    if reg_rel is None:
+        return findings  # no registry module in scope (fixture runs)
+    if not known:
+        findings.append(Finding(
+            RULE, reg_rel, 1,
+            "utils/flags.py must define KNOWN_FLAGS (a literal set of "
+            "every flag name)"))
+        return findings
+
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _DECL_METHODS \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                # only FlagSet-like receivers: fs.string(...), not
+                # arbitrary .string() methods — heuristic on the arg shape
+                # (a help string is also required, so >= 3 args/kwargs)
+                if len(node.args) + len(node.keywords) < 3:
+                    continue
+                name = node.args[0].value
+                if name not in known:
+                    findings.append(Finding(
+                        RULE, sf.rel, node.lineno,
+                        f"flag `-{name}` declared here but missing from "
+                        "KNOWN_FLAGS in utils/flags.py"))
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                m = _FLAG_TOKEN_RE.match(node.value)
+                if m and m.group(1) not in known:
+                    findings.append(Finding(
+                        RULE, sf.rel, node.lineno,
+                        f"flag token `{node.value}` does not name a "
+                        "registered flag (KNOWN_FLAGS)"))
+
+    docs = _doc_text(root)
+    reg_line = 1
+    reg_sf = next(sf for sf in files if sf.rel == reg_rel)
+    for i, line in enumerate(reg_sf.lines, start=1):
+        if "KNOWN_FLAGS" in line:
+            reg_line = i
+            break
+    for name in sorted(known):
+        if "." not in name:
+            continue  # the rule covers dotted flags; bare ones are legacy
+        if f"-{name}" not in docs:
+            findings.append(Finding(
+                RULE, reg_rel, reg_line,
+                f"registered flag `-{name}` is not mentioned in README.md "
+                "or docs/*.md"))
+    return sorted(findings, key=lambda f: (f.path, f.line))

@@ -1,0 +1,286 @@
+"""lock-discipline: machine-checked ``# guarded-by:`` annotations.
+
+In modules marked ``# flowlint: lock-checked`` (the concurrency layer:
+ingest/, transport/bus.py, engine/prefetch.py), every shared mutable
+attribute must declare its synchronization story at its ``__init__``
+assignment:
+
+    self._topics = {}          # guarded-by: _lock
+    self._error = None         # flowlint: unguarded -- single writer ...
+
+and the checker enforces three things:
+
+1. **Guarded writes**: every write to a ``guarded-by: L`` attribute
+   outside ``__init__`` is lexically inside ``with self.L:``.
+2. **Completeness**: every ``self.X`` written outside ``__init__`` is
+   annotated one way or the other — an undeclared mutable attribute in a
+   concurrency module is exactly the field the next refactor races.
+3. **No blocking while holding a lock**: inside any ``with self.L:``
+   block (L a declared lock), calls that can block the thread —
+   ``time.sleep``, ``subprocess.*``, ``socket.*``, thread ``.join()``,
+   future ``.result()``, foreign ``.wait()/.wait_for()`` — are flagged.
+   Waiting on the HELD lock itself (the condition-variable pattern
+   ``with self._cv: self._cv.wait_for(...)``) is allowed.
+
+Module globals support the same annotation (``X = None  # guarded-by:
+_X_LOCK``), enforced against ``with _X_LOCK:``.
+
+Lexical limits (documented in docs/STATIC_ANALYSIS.md): container
+mutation through method calls (``self._topics[t].append``) and writes
+through aliases are invisible to this rule — the annotation convention
+still documents them, the checker catches rebinding races.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, SourceFile, dotted_name
+
+RULE = "lock-discipline"
+MARKER = "lock-checked"
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([\w.]+)")
+_UNGUARDED_RE = re.compile(r"#\s*flowlint:\s*unguarded\s*--\s*(\S.*)")
+
+_BLOCKING_PREFIXES = ("time.sleep", "subprocess.", "socket.", "requests.")
+_BLOCKING_METHODS = {"result", "communicate", "acquire", "drain"}
+
+
+def _own_exprs(node: ast.AST):
+    """The expression nodes belonging to ONE statement: recurse through
+    child nodes but stop at nested statements (their bodies are scanned
+    separately, under their own held-lock set). Expressions never contain
+    statements, so stopping at ast.stmt is exact."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, ast.stmt):
+            continue
+        yield child
+        yield from _own_exprs(child)
+
+
+def _line_annotation(sf: SourceFile, lineno: int):
+    """(kind, value) from the guarded-by / unguarded comment on a line, or
+    on a comment-only line directly above (a TRAILING comment on the
+    previous statement must not leak onto this one)."""
+    for ln in (lineno, lineno - 1):
+        if not 1 <= ln <= len(sf.lines):
+            continue
+        text = sf.lines[ln - 1]
+        if ln != lineno and not text.lstrip().startswith("#"):
+            continue
+        m = _GUARDED_RE.search(text)
+        if m:
+            return "guarded", m.group(1)
+        m = _UNGUARDED_RE.search(text)
+        if m:
+            return "unguarded", m.group(1)
+    return None, None
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _write_targets(node: ast.AST):
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    else:
+        return []
+    out = []
+    for t in targets:  # expand tuple unpacking: a, self.x = ...
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(t.elts)
+        else:
+            out.append(t)
+    return out
+
+
+class _ClassChecker:
+    def __init__(self, sf: SourceFile, cls: ast.ClassDef):
+        self.sf = sf
+        self.cls = cls
+        self.guarded: dict[str, str] = {}    # attr -> lock attr name
+        self.unguarded: set[str] = set()
+        init = next((n for n in cls.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "__init__"), None)
+        self.init = init
+        if init is None:
+            return
+        for node in ast.walk(init):
+            for t in _write_targets(node):
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                kind, val = _line_annotation(sf, node.lineno)
+                if kind == "guarded":
+                    self.guarded[attr] = val
+                elif kind == "unguarded":
+                    self.unguarded.add(attr)
+
+    def check(self) -> list[Finding]:
+        out: list[Finding] = []
+        for meth in self.cls.body:
+            if not isinstance(meth, ast.FunctionDef) or meth is self.init:
+                continue
+            out.extend(self._check_body(meth.body, held=[]))
+        return out
+
+    def _lock_of(self, expr: ast.AST) -> str | None:
+        """'with <expr>:' -> the declared-lock name it holds, if any."""
+        d = dotted_name(expr)
+        if d is None:
+            return None
+        locks = set(self.guarded.values())
+        if d.startswith("self."):
+            name = d[len("self."):]
+            if name in locks:
+                return name
+        return None
+
+    def _check_body(self, stmts, held: list[str]) -> list[Finding]:
+        out: list[Finding] = []
+        for node in stmts:
+            if isinstance(node, ast.With):
+                newly = []
+                for item in node.items:
+                    lk = self._lock_of(item.context_expr)
+                    if lk:
+                        newly.append(lk)
+                out.extend(self._check_exprs(node, held))
+                out.extend(self._check_body(node.body, held + newly))
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested defs execute later — the lock is NOT known to be
+                # held at call time, so their bodies start from held=[]
+                out.extend(self._check_body(node.body, held=[]))
+                continue
+            # recurse into compound statements, keeping the held set
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(node, attr, None)
+                if sub:
+                    out.extend(self._check_body(sub, held))
+            for h in getattr(node, "handlers", []):
+                out.extend(self._check_body(h.body, held))
+            out.extend(self._check_stmt(node, held))
+        return out
+
+    def _check_stmt(self, node: ast.AST, held: list[str]) -> list[Finding]:
+        out: list[Finding] = []
+        for t in _write_targets(node):
+            attr = _self_attr(t)
+            if attr is None:
+                continue
+            if attr in self.guarded:
+                lock = self.guarded[attr]
+                if lock not in held:
+                    out.append(Finding(
+                        RULE, self.sf.rel, node.lineno,
+                        f"write to self.{attr} (guarded-by: {lock}) outside "
+                        f"`with self.{lock}:`"))
+            elif attr not in self.unguarded:
+                out.append(Finding(
+                    RULE, self.sf.rel, node.lineno,
+                    f"write to undeclared attribute self.{attr} in a "
+                    "lock-checked module — annotate its __init__ "
+                    "assignment with `# guarded-by: <lock>` or "
+                    "`# flowlint: unguarded -- <why safe>`"))
+        out.extend(self._check_exprs(node, held))
+        return out
+
+    def _check_exprs(self, node: ast.AST, held: list[str]) -> list[Finding]:
+        """Blocking-call scan of the expressions hanging off one statement
+        (not its nested statement bodies — those recurse separately with
+        their own held set, so descending here would both double-report
+        and apply a stale held set to inner `with` bodies)."""
+        if not held:
+            return []
+        out: list[Finding] = []
+        for sub in _own_exprs(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = dotted_name(sub.func) or ""
+            blocking = None
+            if any(d == p or d.startswith(p) for p in _BLOCKING_PREFIXES):
+                blocking = d
+            elif isinstance(sub.func, ast.Attribute):
+                m = sub.func.attr
+                recv = dotted_name(sub.func.value) or ""
+                if m in _BLOCKING_METHODS:
+                    blocking = d
+                elif m in ("wait", "wait_for"):
+                    # waiting on the held lock itself = CV pattern, fine
+                    held_exprs = {f"self.{h}" for h in held}
+                    if recv not in held_exprs:
+                        blocking = d
+                elif m == "join" and "thread" in recv.lower():
+                    blocking = d
+            if blocking:
+                out.append(Finding(
+                    RULE, self.sf.rel, sub.lineno,
+                    f"potentially blocking call `{blocking}()` while "
+                    f"holding lock(s) {', '.join(held)}"))
+        return out
+
+
+def _check_module_globals(sf: SourceFile) -> list[Finding]:
+    """Module-level `X = ...  # guarded-by: LOCK` annotations: every
+    `global X` rebind must sit inside `with LOCK:`."""
+    out: list[Finding] = []
+    guarded: dict[str, str] = {}
+    for node in sf.tree.body:
+        for t in _write_targets(node):
+            if isinstance(t, ast.Name):
+                kind, val = _line_annotation(sf, node.lineno)
+                if kind == "guarded":
+                    guarded[t.id] = val
+    if not guarded:
+        return out
+
+    def walk(stmts, held: set[str]):
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # each def's body is walked from its own entry
+            if isinstance(node, ast.With):
+                newly = {dotted_name(i.context_expr)
+                         for i in node.items if dotted_name(i.context_expr)}
+                walk(node.body, held | newly)
+                continue
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(node, attr, None)
+                if sub:
+                    walk(sub, held)
+            for h in getattr(node, "handlers", []):
+                walk(h.body, held)
+            for t in _write_targets(node):
+                if isinstance(t, ast.Name) and t.id in guarded \
+                        and guarded[t.id] not in held:
+                    out.append(Finding(
+                        RULE, sf.rel, node.lineno,
+                        f"write to module global {t.id} (guarded-by: "
+                        f"{guarded[t.id]}) outside `with {guarded[t.id]}:`"))
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef):
+            walk(node.body, set())
+    return out
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        if sf.tree is None or MARKER not in sf.markers:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_ClassChecker(sf, node).check())
+        findings.extend(_check_module_globals(sf))
+    return sorted(findings, key=lambda f: (f.path, f.line))

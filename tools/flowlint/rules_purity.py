@@ -1,0 +1,261 @@
+"""jit-purity: functions reachable from a ``@jax.jit`` / ``shard_map`` /
+``pallas_call`` body must be side-effect free.
+
+A side effect baked into a traced body is the worst kind of bug this
+codebase can have: it runs ONCE at trace time (then never again, however
+many batches flow through the compiled step), or it runs on the host at
+surprising times under retracing. The classes flagged here:
+
+- I/O and host-state calls: ``print``/``open``/``input``, ``time.*``,
+  ``os.environ``/``os.*``, ``socket``/``subprocess``/``requests``;
+- stdlib / numpy RNG (``random.*``, ``np.random.*``): trace-time
+  constants masquerading as per-step randomness;
+- observability: ``obs.metrics`` counters (``REGISTRY``-rooted calls,
+  ``.inc()`` / ``.observe()``) and loggers (``log.*`` / ``logging.*`` /
+  ``get_logger``) — these silently record only the trace;
+- writes to module globals (``global x`` + assignment).
+
+Reachability is computed over the project's own modules: jit roots are
+found syntactically (decorators, ``jax.jit(fn)`` / ``shard_map(fn)`` /
+``pallas_call(kernel)`` call forms), then calls are resolved through
+module-local defs and project imports (``from ..m import f``,
+``from .. import m as alias``). Unresolvable calls (externals, method
+dispatch) are ignored — the rule over-approximates reachability but
+never guesses at externals.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+
+from .core import Finding, SourceFile, dotted_name
+
+RULE = "jit-purity"
+
+# call-name prefixes that are impure inside a traced body
+_IMPURE_PREFIXES = (
+    "time.", "random.", "np.random.", "numpy.random.", "os.",
+    "socket.", "subprocess.", "requests.", "logging.", "log.",
+    "logger.", "REGISTRY.", "shutil.", "pathlib.",
+)
+_IMPURE_NAMES = {"print", "open", "input", "get_logger"}
+_IMPURE_METHODS = {"inc", "observe"}  # metric mutation (``.set`` would
+# collide with jnp's ``x.at[..].set`` — REGISTRY-rooted calls cover gauges)
+
+
+def _module_name(rel: str) -> str:
+    return rel[:-3].replace("/", ".").replace("\\", ".")
+
+
+class _ModuleIndex:
+    """Defs + import aliases for one module."""
+
+    def __init__(self, sf: SourceFile, modname: str):
+        self.sf = sf
+        self.modname = modname
+        self.package = modname.rsplit(".", 1)[0] if "." in modname else ""
+        self.defs: dict[str, list[ast.AST]] = defaultdict(list)
+        self.import_mod: dict[str, str] = {}   # alias -> module
+        self.import_from: dict[str, tuple[str, str]] = {}  # name -> (mod, nm)
+        if sf.tree is None:
+            return
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs[node.name].append(node)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_mod[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_relative(node)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.import_from[a.asname or a.name] = (base, a.name)
+
+    def _resolve_relative(self, node: ast.ImportFrom) -> str:
+        if node.level == 0:
+            return node.module or ""
+        parts = self.modname.split(".")
+        # level=1 strips the module name itself, each extra level one pkg
+        base_parts = parts[: len(parts) - node.level]
+        if node.module:
+            base_parts.append(node.module)
+        return ".".join(base_parts)
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    d = dotted_name(node)
+    return d in ("jax.jit", "jit")
+
+
+def _jit_fn_arg(call: ast.Call):
+    """The function operand of jax.jit(...) / shard_map(...) /
+    pallas_call(...) — unwraps nested wrapper calls and partial()."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    while isinstance(arg, ast.Call):
+        d = dotted_name(arg.func) or ""
+        if not (_wrapper_kind(arg) or d in ("partial", "functools.partial")):
+            break
+        if not arg.args:
+            return None
+        arg = arg.args[0]
+    return arg
+
+
+def _wrapper_kind(call: ast.Call) -> str | None:
+    d = dotted_name(call.func) or ""
+    if _is_jax_jit(call.func):
+        return "jax.jit"
+    if d == "shard_map" or d.endswith(".shard_map"):
+        return "shard_map"
+    if d == "pallas_call" or d.endswith(".pallas_call"):
+        return "pallas_call"
+    return None
+
+
+def _decorated_jit(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        if _is_jax_jit(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            d = dotted_name(dec.func) or ""
+            if _is_jax_jit(dec.func):
+                return True
+            if d in ("partial", "functools.partial") and dec.args \
+                    and _is_jax_jit(dec.args[0]):
+                return True
+    return False
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    idx = {}
+    for sf in files:
+        if sf.tree is None:
+            continue
+        m = _ModuleIndex(sf, _module_name(sf.rel))
+        idx[m.modname] = m
+
+    # ---- jit roots ---------------------------------------------------------
+    roots: list[tuple[_ModuleIndex, ast.AST, str]] = []
+    for m in idx.values():
+        for node in ast.walk(m.sf.tree):
+            if isinstance(node, ast.FunctionDef) and _decorated_jit(node):
+                roots.append((m, node, f"@jit {node.name}"))
+            elif isinstance(node, ast.Call) and _wrapper_kind(node):
+                fn = _jit_fn_arg(node)
+                if isinstance(fn, ast.Lambda):
+                    roots.append((m, fn, f"{_wrapper_kind(node)} lambda"))
+                elif isinstance(fn, ast.Name):
+                    for d in m.defs.get(fn.id, []):
+                        roots.append((m, d, f"{_wrapper_kind(node)} {fn.id}"))
+
+    # ---- reachability over project calls -----------------------------------
+    seen: set[tuple[str, int]] = set()
+    work: list[tuple[_ModuleIndex, ast.AST, str]] = []
+    origin: dict[tuple[str, int], str] = {}
+    for m, node, why in roots:
+        key = (m.modname, node.lineno)
+        if key not in seen:
+            seen.add(key)
+            origin[key] = why
+            work.append((m, node, why))
+
+    def resolve(m: _ModuleIndex, ref: ast.AST):
+        """Project functions a Name/Attribute reference may denote."""
+        out = []
+        if isinstance(ref, ast.Name):
+            if ref.id in m.defs:
+                out.extend((m, d) for d in m.defs[ref.id])
+            elif ref.id in m.import_from:
+                mod, nm = m.import_from[ref.id]
+                tm = idx.get(mod)
+                if tm:
+                    out.extend((tm, d) for d in tm.defs.get(nm, []))
+        elif isinstance(ref, ast.Attribute):
+            parts = (dotted_name(ref) or "").split(".")
+            if len(parts) >= 2:
+                root, attr = parts[0], parts[1]
+                mod = None
+                if root in m.import_mod:
+                    mod = m.import_mod[root]
+                elif root in m.import_from:
+                    base, nm = m.import_from[root]
+                    mod = f"{base}.{nm}"
+                tm = idx.get(mod) if mod else None
+                if tm:
+                    out.extend((tm, d) for d in tm.defs.get(attr, []))
+        return out
+
+    while work:
+        m, node, why = work.pop()
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            targets = resolve(m, sub.func)
+            d = dotted_name(sub.func) or ""
+            if d in ("partial", "functools.partial") and sub.args:
+                targets += resolve(m, sub.args[0])
+            for tm, td in targets:
+                key = (tm.modname, td.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                origin[key] = f"{why} -> {getattr(td, 'name', '<lambda>')}"
+                work.append((tm, td, origin[key]))
+
+    # ---- impurity scan of every reachable body -----------------------------
+    findings: list[Finding] = []
+    flagged: set[tuple[str, int]] = set()
+
+    def flag(m: _ModuleIndex, node: ast.AST, msg: str, why: str) -> None:
+        key = (m.sf.rel, node.lineno)
+        if key in flagged:
+            return
+        flagged.add(key)
+        findings.append(Finding(
+            RULE, m.sf.rel, node.lineno, f"{msg} (reachable via {why})"))
+
+    for key in seen:
+        modname, lineno = key
+        m = idx[modname]
+        fn = next((d for ds in m.defs.values() for d in ds
+                   if d.lineno == lineno), None)
+        if fn is None:  # lambda root: re-find by walking
+            fn = next((n for n in ast.walk(m.sf.tree)
+                       if isinstance(n, ast.Lambda) and n.lineno == lineno),
+                      None)
+        if fn is None:
+            continue
+        why = origin[key]
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call):
+                d = dotted_name(sub.func) or ""
+                if d in _IMPURE_NAMES:
+                    flag(m, sub, f"impure call `{d}()` in jit-traced code",
+                         why)
+                elif any(d.startswith(p) for p in _IMPURE_PREFIXES):
+                    flag(m, sub, f"impure call `{d}()` in jit-traced code",
+                         why)
+                elif isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in _IMPURE_METHODS:
+                    flag(m, sub,
+                         f"metric mutation `.{sub.func.attr}()` in "
+                         "jit-traced code", why)
+            elif isinstance(sub, ast.Global):
+                assigned = set()
+                for s in ast.walk(fn):
+                    if isinstance(s, ast.Assign):
+                        assigned.update(t.id for t in s.targets
+                                        if isinstance(t, ast.Name))
+                    elif isinstance(s, ast.AugAssign) \
+                            and isinstance(s.target, ast.Name):
+                        assigned.add(s.target.id)
+                hit = [n for n in sub.names if n in assigned]
+                if hit:
+                    flag(m, sub,
+                         f"module-global write to {', '.join(hit)} in "
+                         "jit-traced code", why)
+    return sorted(findings, key=lambda f: (f.path, f.line))

@@ -1,0 +1,91 @@
+"""uint64-discipline: in modules that declare ``# flowlint: uint64-exact``,
+integer exactness must not leak through a narrowing cast or a defaulted
+dtype.
+
+The flows_5m rollup promises BIT-exact uint64 byte/packet counters
+against the reference (PARITY.md); the hash/key modules promise exact
+uint32/uint64 lane arithmetic. The bugs this rule exists for are silent:
+an ``astype(np.int64)`` on a uint64 counter column flips values past
+2^63 negative; ``uint64 + np.int64`` promotes to float64 and rounds
+above 2^53; a dtype-less ``np.array([...])`` picks platform defaults.
+
+Checks, in marked modules only:
+
+- ``.astype(<signed int dtype>)`` — flag every int/int32/int64 cast
+  (deliberate narrow casts, e.g. bounded 16-bit planes, carry a
+  justification suppression);
+- ``np.int32(x)`` / ``np.int64(x)`` (and jnp twins) used as VALUE
+  constructors — signed scalars mixing into uint64 lanes promote the
+  whole expression to float64;
+- array constructors (``np.array``, ``np.empty``, ``np.zeros``,
+  ``np.ones``, ``np.full``, ``np.fromiter`` + jnp twins) without an
+  explicit dtype — defaults are never uint64.
+
+``np.asarray``/``jnp.asarray`` without dtype are allowed: they preserve
+the input's dtype, which is exactly the discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile, dotted_name
+
+RULE = "uint64-discipline"
+MARKER = "uint64-exact"
+
+_SIGNED_DTYPES = {
+    "int", "np.int32", "np.int64", "numpy.int32", "numpy.int64",
+    "jnp.int32", "jnp.int64", "np.intp", "np.int_",
+}
+# builtin int() is arbitrary-precision (exact) — only the fixed-width
+# numpy/jax signed scalars are dangerous as VALUE constructors
+_SIGNED_CONSTRUCTORS = _SIGNED_DTYPES - {"int"}
+# constructors that must carry an explicit dtype (2nd positional arg or
+# dtype= keyword); name -> index of the positional dtype slot
+_NEED_DTYPE = {
+    "np.array": 1, "numpy.array": 1, "jnp.array": 1,
+    "np.empty": 1, "numpy.empty": 1,
+    "np.zeros": 1, "numpy.zeros": 1, "jnp.zeros": 1,
+    "np.ones": 1, "numpy.ones": 1, "jnp.ones": 1,
+    "np.full": 2, "numpy.full": 2, "jnp.full": 2,
+    "np.fromiter": 1, "numpy.fromiter": 1,
+}
+
+
+def _has_dtype(call: ast.Call, pos: int) -> bool:
+    if len(call.args) > pos:
+        return True
+    return any(kw.arg == "dtype" for kw in call.keywords)
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        if sf.tree is None or MARKER not in sf.markers:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func) or ""
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "astype" and node.args:
+                target = dotted_name(node.args[0]) or ""
+                if target in _SIGNED_DTYPES or (
+                        isinstance(node.args[0], ast.Constant)
+                        and node.args[0].value in ("int32", "int64")):
+                    findings.append(Finding(
+                        RULE, sf.rel, node.lineno,
+                        f"signed narrowing cast `.astype({target or node.args[0].value})` "
+                        "in a uint64-exact module"))
+            elif d in _SIGNED_CONSTRUCTORS and node.args:
+                findings.append(Finding(
+                    RULE, sf.rel, node.lineno,
+                    f"signed scalar constructor `{d}(...)` in a "
+                    "uint64-exact module (mixes to float64 against uint64)"))
+            elif d in _NEED_DTYPE and not _has_dtype(node, _NEED_DTYPE[d]):
+                findings.append(Finding(
+                    RULE, sf.rel, node.lineno,
+                    f"`{d}(...)` without an explicit dtype in a "
+                    "uint64-exact module"))
+    return sorted(findings, key=lambda f: (f.path, f.line))

@@ -1,0 +1,89 @@
+"""flowlint runner: rule orchestration + reporting.
+
+Scope: the whole ``flow_pipeline_tpu`` package plus ``bench.py`` and
+``tests/`` (flag tokens in tests must be real flags too). Exit status:
+0 = clean, 1 = findings (printed one per line), so ``make lint`` and CI
+gate on it directly.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from . import rules_flags, rules_locks, rules_purity, rules_uint64
+from .core import (
+    Finding,
+    LintResult,
+    discover,
+    load_files,
+    suppression_findings,
+)
+
+DEFAULT_SUBDIRS = ("flow_pipeline_tpu", "bench.py", "tests")
+ALL_RULES = ("jit-purity", "uint64-discipline", "lock-discipline",
+             "flag-registry")
+
+
+def run_lint(root: str, rel_paths: list[str] | None = None,
+             rules: tuple[str, ...] | None = None) -> list[Finding]:
+    """Lint the repo at ``root``; returns surviving (unsuppressed)
+    findings. ``rel_paths``/``rules`` narrow the run (tests use this)."""
+    rels = rel_paths if rel_paths is not None else \
+        discover(root, DEFAULT_SUBDIRS)
+    files = load_files(root, rels)
+    # `# flowlint: skip-file` opts a whole file out — for files whose
+    # PURPOSE is to contain bad code (the lint fixture tests themselves)
+    files = [sf for sf in files if "skip-file" not in sf.markers]
+    by_rel = {sf.rel: sf for sf in files}
+
+    result = LintResult()
+    for sf in files:
+        if sf.parse_error:
+            result.findings.append(
+                Finding("parse", sf.rel, 1, sf.parse_error))
+
+    selected = rules or ALL_RULES
+    if "jit-purity" in selected:
+        result.extend_filtered(by_rel, rules_purity.check(files))
+    if "uint64-discipline" in selected:
+        result.extend_filtered(by_rel, rules_uint64.check(files))
+    if "lock-discipline" in selected:
+        result.extend_filtered(by_rel, rules_locks.check(files))
+    if "flag-registry" in selected:
+        result.extend_filtered(by_rel, rules_flags.check(files, root))
+    # suppressions themselves must be justified + must still bite;
+    # unused-reporting is only sound when every rule actually ran
+    result.findings.extend(suppression_findings(
+        files, known_rules=ALL_RULES,
+        report_unused=set(selected) == set(ALL_RULES)))
+    return sorted(result.findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def main(argv: list[str]) -> int:
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(
+        prog="flowlint",
+        description="project static analysis: jit-purity, uint64 "
+                    "discipline, lock annotations, flag registry")
+    p.add_argument("paths", nargs="*",
+                   help="repo-relative files/dirs (default: full scope)")
+    p.add_argument("--root", default=os.getcwd(),
+                   help="repo root (default: cwd)")
+    p.add_argument("--rule", action="append",
+                   help="run only this rule (repeatable)")
+    args = p.parse_args(argv)
+
+    rels = None
+    if args.paths:
+        rels = discover(args.root, tuple(args.paths))
+    findings = run_lint(args.root, rels,
+                        tuple(args.rule) if args.rule else None)
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"flowlint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("flowlint: clean", file=sys.stderr)
+    return 0
