@@ -21,6 +21,7 @@ Modes (default ``hh`` is what the driver records):
     python bench.py decode       # native host decode throughput
     python bench.py cms          # XLA scatter vs Pallas CMS updates (x4)
     python bench.py e2e          # full in-process pipeline flows/sec
+    python bench.py hostsketch   # sketch.backend=device|host e2e A/B
     python bench.py sharded [n]  # n-device mesh rate + merge cost
     python bench.py sweep        # batch x width x impl tuning sweep
     python bench.py trace [dir]  # jax.profiler device trace of the step
@@ -373,7 +374,8 @@ def _stage_sums() -> dict:
 
 
 def _run_e2e(n_flows: int, samples: int = 5,
-             ingest_mode: str = "pipelined") -> dict:
+             ingest_mode: str = "pipelined",
+             sketch_backend: str = "device") -> dict:
     """Shared e2e measurement: stats + per-stage budget (VERDICT r3 #1).
 
     The budget diffs the stage summaries across the timed samples and
@@ -382,7 +384,10 @@ def _run_e2e(n_flows: int, samples: int = 5,
     group thread, flushing on the background flusher (pipelined mode) —
     all overlapped with the worker — so shares are a breakdown, not a
     disjoint partition. ingest_mode="serial" is the pre-r6
-    single-threaded path, the A/B baseline the artifact records."""
+    single-threaded path, the A/B baseline the artifact records;
+    sketch_backend="host" swaps the jitted CMS/top-K apply for the
+    native hostsketch engine (the r8 A/B — device_apply share is the
+    number that leg exists to shrink)."""
     from flow_pipeline_tpu.cli import (
         _batch_frames, _build_models, _make_generator, _processor_flags,
         _common_flags, _gen_flags,
@@ -411,6 +416,7 @@ def _run_e2e(n_flows: int, samples: int = 5,
             # instead of conflating it with the C kernel
             WorkerConfig(poll_max=vals["processor.batch"], snapshot_every=0,
                          ingest_mode=ingest_mode,
+                         sketch_backend=sketch_backend,
                          ingest_native_group=True),
         )
         t0 = time.perf_counter()
@@ -449,11 +455,62 @@ def _run_e2e(n_flows: int, samples: int = 5,
     # first-class artifact fields (acceptance: host_group <30, flush <20)
     stats["ingest_mode"] = ingest_mode
     stats["ingest_native_group"] = True  # both A/B legs (see run_stream)
+    stats["sketch_backend"] = sketch_backend
     stats["host_group_share_pct"] = stages.get(
         "host_group", {}).get("share_pct", 0.0)
     stats["flushing_share_pct"] = stages.get(
         "flushing", {}).get("share_pct", 0.0)
+    # the share the hostsketch backend exists to shrink (r8 acceptance:
+    # host leg cuts it >=2x vs the device leg on the same box)
+    stats["device_apply_share_pct"] = stages.get(
+        "device_apply", {}).get("share_pct", 0.0)
     return stats
+
+
+def bench_hostsketch() -> None:
+    """Same-box sketch-backend A/B (the BENCH_r08 artifact): the full
+    e2e pipeline with the jitted sketch apply vs the native hostsketch
+    engine, per-stage shares included. Same stream, same process, legs
+    interleaved only by the jit warm-up order — never compare the
+    absolute rates across boxes or rounds (r06 host-variance caveat);
+    the A/B ratio and the device_apply share delta are the portable
+    numbers."""
+    global _NATIVE
+    _NATIVE = _ensure_native()
+    from flow_pipeline_tpu import native as native_lib
+
+    device = _run_e2e(E2E_FLOWS, samples=3, sketch_backend="device")
+    host = _run_e2e(E2E_FLOWS, samples=3, sketch_backend="host")
+    print(json.dumps({
+        "metric": "e2e sketch-backend A/B (device_apply offload)",
+        "unit": "flows/sec",
+        "value": host["value"],
+        "device_flows_per_sec": device["value"],
+        "host_flows_per_sec": host["value"],
+        "host_speedup": round(host["value"] / device["value"], 3)
+        if device["value"] else 0.0,
+        "device_apply_share_device_pct": device["device_apply_share_pct"],
+        "device_apply_share_host_pct": host["device_apply_share_pct"],
+        "device_apply_share_cut": round(
+            device["device_apply_share_pct"]
+            / host["device_apply_share_pct"], 2)
+        if host["device_apply_share_pct"] else 0.0,
+        "host_sketch_share_pct": host["stages"].get(
+            "host_sketch", {}).get("share_pct", 0.0),
+        "stages_device": device["stages"],
+        "stages_host": host["stages"],
+        "spread_pct_device": device["spread_pct"],
+        "spread_pct_host": host["spread_pct"],
+        "native_decode": _NATIVE,
+        "native_sketch": native_lib.sketch_available(),
+        "platform": _PLATFORM,
+        "host_note": (
+            "bench boxes differ 3-4x between rounds and swing within "
+            "hours (r06 caveat); a 2-core throttled box cannot sustain "
+            "the 1M flows/s target — the portable numbers are the "
+            "same-box host_speedup and the device_apply share cut"),
+        **_host_conditions(),
+    }))
 
 
 def bench_e2e() -> None:
@@ -770,6 +827,8 @@ if __name__ == "__main__":
         bench_cms()
     elif mode == "e2e":
         bench_e2e()
+    elif mode == "hostsketch":
+        bench_hostsketch()
     elif mode == "sharded":
         bench_sharded(int(sys.argv[2]) if len(sys.argv) > 2 else 8)
     elif mode == "sweep":

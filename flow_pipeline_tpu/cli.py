@@ -210,6 +210,10 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.boolean("model.ddos", True, "DDoS spike detector")
     fs.integer("sketch.width", 1 << 16, "Count-min width")
     fs.string("sketch.cms", "xla", "CMS update impl: xla | pallas")
+    fs.string("sketch.backend", "device",
+              "Sketch step executor: device (jitted CMS/top-K apply) | "
+              "host (native threaded uint64 engine; needs the "
+              "host-grouped pipeline)")
     fs.string("sketch.admission", "est",
               "Top-K table admission: est (space-saving, CMS-seeded) | "
               "plain (batch-sum merge; benchmarking A/B only)")
@@ -398,6 +402,7 @@ def processor_main(argv=None) -> int:
                 prefetch=vals["feed.prefetch"],
                 fused=vals["processor.fused"],
                 host_assist=vals["processor.hostassist"],
+                sketch_backend=vals["sketch.backend"],
                 ingest_mode=vals["ingest.mode"],
                 ingest_shards=vals["ingest.shards"],
                 ingest_depth=vals["ingest.depth"],
@@ -551,6 +556,7 @@ def pipeline_main(argv=None) -> int:
                      checkpoint_path=vals["checkpoint.path"] or None,
                      archive_raw=vals["archive.raw"],
                      prefetch=vals["feed.prefetch"],
+                     sketch_backend=vals["sketch.backend"],
                      ingest_mode=vals["ingest.mode"],
                      ingest_shards=vals["ingest.shards"],
                      ingest_depth=vals["ingest.depth"],

@@ -131,7 +131,8 @@ class CollectorServer:
             log.debug("netflow decode error from %s: %s", source, e)
             return 0
         finally:
-            self.m_decode_us.observe((time.perf_counter() - t0) * 1e6)
+            self.m_decode_us.observe((time.perf_counter() - t0) * 1e6,
+                                     name="NetFlow")
         self.m_nf_templates.set(len(self.templates))
         self.m_nf_templates.set(self.templates.count_for(source),
                                 router=router)
@@ -143,7 +144,11 @@ class CollectorServer:
         if export_clock:
             delay = max(0.0, now - export_clock)
             for _ in msgs:
-                self.m_nf_delay.observe(delay)
+                # labeled per exporter so the dashboards can chart delay
+                # quantiles BY ROUTER (the reference perfs.json breaks
+                # NFDelaySummary down the same way); the quantile-only
+                # panels keep matching — they filter no other label
+                self.m_nf_delay.observe(delay, router=router)
         return self._publish(msgs, router)
 
     def handle_sflow(self, data: bytes, source: str = "") -> int:
@@ -158,7 +163,8 @@ class CollectorServer:
             log.debug("sflow decode error from %s: %s", source, e)
             return 0
         finally:
-            self.m_decode_us.observe((time.perf_counter() - t0) * 1e6)
+            self.m_decode_us.observe((time.perf_counter() - t0) * 1e6,
+                                     name="sFlow")
         self.m_sf_samples.inc(len(msgs), type="FlowSample", agent=agent)
         return self._publish(msgs, agent)
 

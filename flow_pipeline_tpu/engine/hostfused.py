@@ -403,14 +403,23 @@ class HostGroupPipeline(FusedPipeline):
                     continue
                 if not (do_hh or do_dd):
                     continue  # late part: device models take nothing
-                with self.stages.stage("device_apply"):
-                    self._apply_chunk(ch, do_hh, do_dd)
+                self._timed_apply_chunk(ch, do_hh, do_dd)
         for _, m in self._waggs:
             if prep.watermark > m.watermark:
                 m.watermark = prep.watermark
 
     def update(self, batch: FlowBatch) -> None:
         self.apply(self.prepare(batch))
+
+    def _timed_apply_chunk(self, ch: PreparedChunk, do_hh: bool,
+                           do_dd: bool) -> None:
+        """Stage attribution seam: here the whole chunk apply IS the
+        jitted device step. The hostsketch pipeline overrides this to
+        split its chunk between host_sketch (the native engine) and
+        device_apply (what remains jitted), so the two backends' stage
+        budgets stay comparable per stage."""
+        with self.stages.stage("device_apply"):
+            self._apply_chunk(ch, do_hh, do_dd)
 
     def _apply_chunk(self, ch: PreparedChunk, do_hh: bool,
                      do_dd: bool) -> None:

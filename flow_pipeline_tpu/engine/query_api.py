@@ -102,6 +102,9 @@ class QueryServer:
         name, model = self._model(q, WindowedHeavyHitter)
         if not isinstance(model, WindowedHeavyHitter):
             raise ValueError(f"model {name!r} has no top-K surface")
+        # host sketch backend: model state is engine-resident between
+        # syncs; pull it current before reading (we hold worker.lock)
+        self.worker.sync_sketch_states()
         k = int(q.get("k", 10))
         top = model.model.top(k)
         return {

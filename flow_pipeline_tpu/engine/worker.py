@@ -49,6 +49,15 @@ class WorkerConfig:
     # "on"/"off" force/forbid. On TPU "auto" keeps the device-sorted
     # fused step.
     host_assist: str = "auto"
+    # Sketch-step backend (flow_pipeline_tpu.hostsketch): "device" keeps
+    # the jitted CMS/top-K apply (engine.hostfused/_cached_apply — the
+    # TPU dataplane and the pre-r8 CPU path); "host" executes it in the
+    # native threaded uint64 engine behind the same apply seam —
+    # bit-exact on the integer envelope (tests/test_hostsketch.py) and
+    # the big remaining CPU lever (device_apply was ~66% of e2e wall,
+    # BENCH_r06). Requires the host-grouped pipeline (CPU backend or
+    # host_assist="on"); falls back to device with a warning otherwise.
+    sketch_backend: str = "device"
     # Ingest dataplane (flow_pipeline_tpu.ingest): "pipelined" runs the
     # host pre-aggregation on a group thread (overlapping the device
     # step), window extraction + sink writes on a background flusher, and
@@ -92,13 +101,32 @@ class StreamWorker:
             raise ValueError(
                 f"ingest_mode must be pipelined|serial, "
                 f"got {config.ingest_mode!r}")
+        if config.sketch_backend not in ("device", "host"):
+            raise ValueError(
+                f"sketch_backend must be device|host, "
+                f"got {config.sketch_backend!r}")
         self.fused = None
         if config.fused and models:
             from .fused import FusedPipeline
             from .hostfused import HostGroupPipeline
 
             if FusedPipeline.supported(models):
-                if HostGroupPipeline.eligible(config.host_assist):
+                host_grouped = HostGroupPipeline.eligible(config.host_assist)
+                if config.sketch_backend == "host" and host_grouped:
+                    from ..hostsketch import HostSketchPipeline
+
+                    self.fused = HostSketchPipeline(
+                        models, shards=config.ingest_shards,
+                        native_group=config.ingest_native_group)
+                elif config.sketch_backend == "host":
+                    # the host engine consumes the host-grouped prepare
+                    # tables; without them there is nothing to feed it
+                    log.warning(
+                        "sketch.backend=host needs the host-grouped "
+                        "pipeline (CPU backend or -processor.hostassist "
+                        "on); keeping the device sketch step")
+                    self.fused = FusedPipeline(models)
+                elif host_grouped:
                     self.fused = HostGroupPipeline(
                         models, shards=config.ingest_shards,
                         native_group=config.ingest_native_group)
@@ -289,7 +317,21 @@ class StreamWorker:
         if emitted and self.flusher is None:
             self.stages.observe("flushing", (time.perf_counter() - t0) * 1e6)
 
+    def sync_sketch_states(self) -> None:
+        """Export host-backend sketch state into the models before a read
+        (checkpoint, forced flush, live top-K query). No-op on the device
+        backend, where model state is always current. Callers must hold
+        self.lock (the worker loop does; query_api acquires it)."""
+        sync = getattr(self.fused, "sync_states", None)
+        if sync is not None:
+            sync()
+
     def _flush_closed(self, force: bool) -> bool:
+        if force:
+            # force closes the OPEN window straight off model state;
+            # mid-stream (force=False) closes go through the pipeline's
+            # _advance_hh, which syncs itself
+            self.sync_sketch_states()
         emitted = False
         for name, model in self.models.items():
             if isinstance(model, WindowAggregator):
@@ -402,6 +444,9 @@ class StreamWorker:
             self.m_lag.set(self.consumer.lag())
 
     def _state(self) -> dict:
+        # host-backend sketch state lives in the engine between syncs;
+        # the snapshot must cover everything the committed offsets cover
+        self.sync_sketch_states()
         models_state: dict[str, Any] = {}
         for name, model in self.models.items():
             if isinstance(model, WindowAggregator):

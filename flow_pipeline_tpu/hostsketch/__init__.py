@@ -1,0 +1,28 @@
+"""Host-resident sketch dataplane (the `-sketch.backend=host` path).
+
+The jitted sketch step (CMS scatter + heavy-hitter admission) dominates
+CPU-backend wall time once the host side is pipelined; this package
+executes that step natively on the host instead — a threaded uint64
+count-min engine plus the space-saving top-K merge
+(native/hostsketch.cc), driven through the SAME group tables the XLA
+step consumes, behind the ``apply``/``_apply_chunk`` seam of
+engine.hostfused. The JAX path remains the TPU dataplane.
+
+Parity contract: bit-exact against the device path on the uint64-exact
+envelope (integer-valued counters, per-cell totals < 2^24 where float32
+is exact) — enforced by tests/test_hostsketch.py and
+`make hostsketch-parity`, never eyeballed. State converts losslessly to
+and from the device HHState, so checkpoints written under one backend
+restore under the other (docs/ARCHITECTURE.md "hostsketch").
+"""
+
+from .engine import HostSketchEngine, sketch_backend_available
+from .pipeline import HostSketchPipeline
+from .state import HostHHState
+
+__all__ = [
+    "HostHHState",
+    "HostSketchEngine",
+    "HostSketchPipeline",
+    "sketch_backend_available",
+]

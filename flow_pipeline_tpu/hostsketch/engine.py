@@ -1,0 +1,291 @@
+"""Host sketch engine: the native twin of models.heavy_hitter's
+``_apply_grouped`` (CMS update -> table prefilter -> admission merge).
+
+Two interchangeable backends behind one surface:
+
+- **native** — the threaded uint64 engine in native/hostsketch.cc
+  (ctypes via flow_pipeline_tpu.native). The production path.
+- **numpy** — a pure-numpy twin of the same semantics, used when the
+  library is unbuilt and as the reference implementation the native
+  kernels are tested against (tests/test_hostsketch.py pins both to
+  the jitted path).
+
+Every step reproduces the jitted graph's arithmetic decisions exactly
+(see native/hostsketch.cc for the parity argument): buckets from the
+same murmur3 word-lane hash, conservative targets against the
+pre-update sketch, the prefilter's resident-hash boost with
+lax.top_k's lowest-index tie-break, and the admission merge's
+(primary desc, lex key asc) ranking.
+"""
+
+from __future__ import annotations
+
+# flowlint: uint64-exact
+# (counter arithmetic must stay exact unsigned; the f32 casts below are
+# the DEVICE layout's own value planes, mirrored bit-for-bit)
+
+import os
+
+import numpy as np
+
+from ..models.heavy_hitter import HeavyHitterConfig
+from ..ops.hostgroup import hash_u64
+from ..schema.keys import hash_words_np
+from .state import (
+    _U64_CAP,
+    HostHHState,
+    from_device_state,
+    host_hh_init,
+    to_device_state,
+)
+
+_SENTINEL = np.uint32(0xFFFFFFFF)
+
+
+def sketch_backend_available() -> bool:
+    """Whether the NATIVE engine can serve (the numpy twin always can —
+    this gates logging/bench notes, not correctness)."""
+    from .. import native
+
+    return native.sketch_available()
+
+
+# ---- numpy twin of the native entry points --------------------------------
+
+
+def _addend_u64(vals: np.ndarray) -> np.ndarray:
+    """f32 addends -> u64, matching native addend_u64 (negatives and NaN
+    contribute nothing; out-of-envelope values clamp)."""
+    v = np.asarray(vals, dtype=np.float32)
+    with np.errstate(invalid="ignore"):
+        v = np.where(np.isnan(v) | (v <= 0), np.float32(0.0), v)
+        v = np.minimum(v, _U64_CAP)
+    return v.astype(np.uint64)
+
+
+def _np_buckets(keys: np.ndarray, depth: int, width: int) -> np.ndarray:
+    """[depth, n] bucket indices — ops.cms.cms_buckets' numpy twin."""
+    out = np.empty((depth, keys.shape[0]), np.int64)
+    for d in range(depth):
+        h = hash_words_np(keys, seed=d)
+        # flowlint: disable=uint64-discipline -- bucket INDICES in [0, width), not counters (same trade as ops.cms.cms_buckets)
+        out[d] = (h % np.uint32(width)).astype(np.int64)
+    return out
+
+
+def np_cms_update(cms: np.ndarray, keys: np.ndarray, vals: np.ndarray,
+                  conservative: bool) -> None:
+    """uint64 CMS update in place over valid rows only (callers slice)."""
+    p, depth, width = cms.shape
+    if keys.shape[0] == 0:
+        return
+    buckets = _np_buckets(keys, depth, width)
+    add = _addend_u64(vals)
+    if not conservative:
+        for pi in range(p):
+            for d in range(depth):
+                np.add.at(cms[pi, d], buckets[d], add[:, pi])
+        return
+    # conservative: targets against the PRE-update sketch, then
+    # scatter-max (order-free, exactly the XLA graph's two halves)
+    est = np_cms_query_u64(cms, keys, buckets)
+    target = est + add
+    for pi in range(p):
+        for d in range(depth):
+            np.maximum.at(cms[pi, d], buckets[d], target[:, pi])
+
+
+def np_cms_query_u64(cms: np.ndarray, keys: np.ndarray,
+                     buckets: np.ndarray | None = None) -> np.ndarray:
+    """[n, P] uint64 min-over-depth estimates."""
+    p, depth, width = cms.shape
+    if buckets is None:
+        buckets = _np_buckets(keys, depth, width)
+    ests = np.stack([cms[:, d, buckets[d]] for d in range(depth)])
+    return ests.min(axis=0).T  # [n, P]
+
+
+def np_cms_query(cms: np.ndarray, keys: np.ndarray) -> np.ndarray:
+    """[n, P] float32 estimates — ops.cms.cms_query's host twin."""
+    return np_cms_query_u64(cms, keys).astype(np.float32)
+
+
+def np_topk_merge(table_keys: np.ndarray, table_vals: np.ndarray,
+                  cand_keys: np.ndarray, cand_sums: np.ndarray,
+                  cand_est: np.ndarray):
+    """ops.topk.topk_merge_est's host twin (pass cand_est=cand_sums for
+    the 'plain' batch-sum merge). Returns (new_keys, new_vals); callers
+    pre-filter candidates to valid rows."""
+    cap, kw = table_keys.shape
+    planes = table_vals.shape[1]
+    t_real = (table_keys != _SENTINEL).any(axis=1)
+    # the all-sentinel key tuple is unrepresentable in the table (it
+    # marks empty slots) — topk_merge_est drops it from candidates
+    c_real = (cand_keys != _SENTINEL).any(axis=1)
+    n_t = int(t_real.sum())
+    keys = np.concatenate([table_keys[t_real],
+                           cand_keys[c_real].astype(np.uint32)])
+    zeros_t = np.zeros((n_t, planes), np.float32)
+    zeros_c = np.zeros((int(c_real.sum()), planes), np.float32)
+    tvals = np.concatenate([table_vals[t_real], zeros_c])
+    csums = np.concatenate([zeros_t,
+                            cand_sums[c_real].astype(np.float32)])
+    cests = np.concatenate([zeros_t,
+                            cand_est[c_real].astype(np.float32)])
+    is_table = np.zeros(len(keys), bool)
+    is_table[:n_t] = True
+    if len(keys) == 0:
+        return (np.full((cap, kw), _SENTINEL, np.uint32),
+                np.zeros((cap, planes), np.float32))
+    # group by key in lexicographic order (sort_groupby_float's slot
+    # order — the tie-break baseline for the ranking below)
+    order = np.lexsort(keys.T[::-1])
+    sk = keys[order]
+    boundary = np.empty(len(keys), bool)
+    boundary[0] = True
+    np.any(sk[1:] != sk[:-1], axis=1, out=boundary[1:])
+    starts = np.flatnonzero(boundary)
+    uniq = sk[starts]
+    g_t = np.add.reduceat(tvals[order], starts, axis=0)
+    g_s = np.add.reduceat(csums[order], starts, axis=0)
+    g_e = np.add.reduceat(cests[order], starts, axis=0)
+    resident = np.add.reduceat(
+        is_table[order].astype(np.uint64), starts) > 0
+    vals = g_t + np.where(resident[:, None], g_s, g_e)
+    # rank by primary desc; stable sort keeps lex order on ties —
+    # jnp.argsort(-primary)'s exact behavior
+    top = np.argsort(-vals[:, 0], kind="stable")[:cap]
+    new_keys = np.full((cap, kw), _SENTINEL, np.uint32)
+    new_vals = np.zeros((cap, planes), np.float32)
+    new_keys[:len(top)] = uniq[top]
+    new_vals[:len(top)] = vals[top]
+    return new_keys, new_vals
+
+
+# ---- the engine -----------------------------------------------------------
+
+
+class HostSketchEngine:
+    """Per-family host sketch state + the grouped-update step.
+
+    Owned and driven by HostSketchPipeline on the worker thread (under
+    the worker's lock); the engine itself is single-threaded at the
+    Python level — the parallelism lives inside the native kernels,
+    which join before returning.
+    """
+
+    def __init__(self, configs: list[HeavyHitterConfig],
+                 use_native: str = "auto", threads: int = 0):
+        if use_native not in ("auto", "native", "numpy"):
+            raise ValueError(
+                f"use_native must be auto|native|numpy, got {use_native!r}")
+        native_ok = sketch_backend_available()
+        if use_native == "native" and not native_ok:
+            raise RuntimeError(
+                "native hostsketch engine requested but libflowdecode "
+                "lacks hs_cms_update; run `make native`")
+        self.configs = list(configs)
+        self.native = native_ok if use_native == "auto" \
+            else use_native == "native"
+        # Auto thread count deliberately conservative: the kernels are
+        # memory-bound (random access into the MB-scale sketch), so on
+        # small hosts extra threads just thrash the shared cache —
+        # measured 2x SLOWER with 2 threads on a 2-core box. Half the
+        # cores, capped at 4, floor 1; operators with wide hosts can
+        # pass an explicit count.
+        self.threads = threads or max(1, min(4, (os.cpu_count() or 1) // 2))
+        self.states: list[HostHHState | None] = [None] * len(self.configs)
+        for cfg in self.configs:
+            if cfg.table_admission not in ("est", "plain"):
+                raise ValueError(
+                    f"table_admission must be est|plain, got "
+                    f"{cfg.table_admission!r}")
+
+    # ---- state plumbing ---------------------------------------------------
+
+    def reset(self, i: int) -> None:
+        self.states[i] = host_hh_init(self.configs[i])
+
+    def import_state(self, i: int, device_state) -> None:
+        self.states[i] = from_device_state(device_state)
+
+    def export_state(self, i: int):
+        if self.states[i] is None:
+            self.reset(i)
+        return to_device_state(self.states[i])
+
+    # ---- the grouped update step ------------------------------------------
+
+    def update(self, i: int, uniq: np.ndarray, sums: np.ndarray,
+               n_groups: int) -> None:
+        """Fold one prepared group table into family ``i`` — the host twin
+        of heavy_hitter._apply_grouped. ``uniq`` [B, W] uint32 padded,
+        ``sums`` [B, P+1] float32 (count plane last), first ``n_groups``
+        rows real. The prefilter condition intentionally tests the PADDED
+        B (the jit's static-shape condition); with n_groups <= 2*capacity
+        both branches are proven output-equal, so slicing to the real
+        rows first stays bit-exact."""
+        cfg = self.configs[i]
+        st = self.states[i]
+        if st is None:
+            self.reset(i)
+            st = self.states[i]
+        if n_groups <= 0:
+            return  # all-invalid chunk: CMS and table are both no-ops
+        padded_b = uniq.shape[0]
+        uniq = np.ascontiguousarray(uniq[:n_groups], dtype=np.uint32)
+        sums = np.ascontiguousarray(sums[:n_groups], dtype=np.float32)
+        threads = 1 if n_groups < 2048 else self.threads
+        if self.native:
+            from .. import native
+
+            native.hs_cms_update(st.cms, uniq, sums, None,
+                                 cfg.conservative, threads)
+        else:
+            np_cms_update(st.cms, uniq, sums, cfg.conservative)
+        if cfg.table_prefilter and padded_b > 2 * cfg.capacity:
+            uniq, sums = self._prefilter(st, uniq, sums, cfg.capacity,
+                                         threads)
+        if cfg.table_admission == "plain":
+            est = sums
+        else:
+            if self.native:
+                from .. import native
+
+                est = native.hs_cms_query(st.cms, uniq, threads)
+            else:
+                est = np_cms_query(st.cms, uniq)
+        if self.native:
+            from .. import native
+
+            native.hs_topk_merge(st.table_keys, st.table_vals,
+                                 uniq, sums, est, None)
+        else:
+            st.table_keys, st.table_vals = np_topk_merge(
+                st.table_keys, st.table_vals, uniq, sums, est)
+
+    def _prefilter(self, st: HostHHState, uniq: np.ndarray,
+                   sums: np.ndarray, cap: int, threads: int):
+        """Table-aware candidate truncation — _apply_grouped's prefilter
+        block. Membership rides the same 32-bit hash lane (hash_lanes'
+        first mix = the high word of ops.hostgroup.hash_u64), and the
+        2C selection reproduces lax.top_k's lowest-index tie-break via a
+        stable argsort (numpy) / a (metric desc, index asc) partial sort
+        (native)."""
+        if self.native:
+            from .. import native
+
+            sel = native.hs_hh_prefilter(st.table_keys, uniq, sums,
+                                         threads)
+        else:
+            th = (hash_u64(np.ascontiguousarray(st.table_keys))
+                  >> np.uint64(32)).astype(np.uint32)
+            gh = (hash_u64(uniq) >> np.uint64(32)).astype(np.uint32)
+            ts = np.sort(th)
+            pos = np.clip(np.searchsorted(ts, gh), 0, cap - 1)
+            resident = ts[pos] == gh
+            metric = sums[:, 0].copy()
+            metric[resident] = np.float32(np.inf)
+            sel = np.argsort(-metric, kind="stable")[:2 * cap]
+        return (np.ascontiguousarray(uniq[sel]),
+                np.ascontiguousarray(sums[sel]))

@@ -1,0 +1,143 @@
+"""HostSketchPipeline: the `-sketch.backend=host` dataplane.
+
+A HostGroupPipeline whose heavy-hitter apply half runs on the HOST
+sketch engine instead of the jitted step — the prepare half (sharded
+grouping, family cascade, padding) is inherited untouched, so the two
+backends consume byte-identical group tables and bit-exact parity
+reduces to the engine reproducing ``_apply_grouped``
+(tests/test_hostsketch.py). Dense port scatters and the DDoS
+accumulate keep the jitted path (they are cheap next to the CMS
+scatter and have no host engine yet); flows_5m already bypasses the
+device on the host-grouped pipeline.
+
+State ownership: while streaming, sketch state lives in the engine's
+uint64 buffers and the wrapped models' ``.state`` goes stale; every
+read point syncs first — ``_advance_hh`` before a window close,
+``StreamWorker.sync_sketch_states()`` before snapshots, forced
+flushes, and live top-K queries. Staleness is tracked by object
+identity: ``model.reset()`` and ``worker.restore()`` REPLACE the state
+object, which the next apply detects and re-imports, so backend
+switches at restore need no extra plumbing.
+"""
+
+from __future__ import annotations
+
+# flowlint: lock-checked
+# (every mutation below runs on the worker thread under worker.lock —
+# apply() via _process, sync_states() via the worker's read hooks; the
+# engine buffers are only ever touched from that context)
+
+from typing import Optional
+
+import numpy as np
+
+from ..engine.hostfused import HostGroupPipeline, PreparedChunk, _cached_apply
+from ..ingest.shard import ShardPool
+from ..obs import get_logger
+from .engine import HostSketchEngine, sketch_backend_available
+
+log = get_logger("hostsketch")
+
+
+class HostSketchPipeline(HostGroupPipeline):
+    """Host-grouped pipeline with the native host sketch apply half."""
+
+    def __init__(self, models: dict, shards: int = 0,
+                 native_group: bool = False,
+                 pool: Optional[ShardPool] = None,
+                 sketch_native: str = "auto"):
+        super().__init__(models, shards=shards, native_group=native_group,
+                         pool=pool)
+        self._engine = HostSketchEngine(
+            [w.config for _, w in self._hh], use_native=sketch_native)
+        if not self._engine.native and sketch_native != "numpy":
+            log.warning("hostsketch native engine unavailable "
+                        "(libflowdecode lacks hs_cms_update); using the "
+                        "numpy twin — run `make native` for the fast path")
+        # The jitted rest-step covers what the engine does not: dense
+        # port scatters + the DDoS accumulate. Same module-level cache
+        # as the full apply, keyed with no hh families.
+        self._apply_rest = _cached_apply(
+            (), tuple(w.config for _, w in self._dense),
+            tuple(d.config for _, d in self._ddos),
+        ) if (self._dense or self._ddos) else None
+        # Identity tokens of the HHState objects the engine's buffers
+        # mirror: `model.state is not token` means reset()/restore()
+        # swapped the state under us -> re-import before the next fold.
+        # flowlint: unguarded -- worker thread only (apply/sync under worker.lock)
+        self._shadow: list = [None] * len(self._hh)
+        # flowlint: unguarded -- worker thread only (apply/sync under worker.lock)
+        self._sketch_dirty: list = [False] * len(self._hh)
+
+    # ---- apply half --------------------------------------------------------
+
+    def _timed_apply_chunk(self, ch: PreparedChunk, do_hh: bool,
+                           do_dd: bool) -> None:
+        # split attribution: host_sketch is the native engine,
+        # device_apply what remains jitted — so the A/B's per-stage
+        # budget compares the same seam under both backends
+        self._apply_chunk(ch, do_hh, do_dd)
+
+    def _apply_chunk(self, ch: PreparedChunk, do_hh: bool,
+                     do_dd: bool) -> None:
+        if do_hh and ch.hh_in is not None:
+            with self.stages.stage("host_sketch"):
+                for i, (u, s, g) in enumerate(ch.hh_in):
+                    self._ensure_imported(i)
+                    self._engine.update(i, u, s, g)
+                    self._sketch_dirty[i] = True
+        # do_hh False is a late part: the jitted path would run the merge
+        # with all-invalid candidates, a proven no-op — skipping is exact.
+        if self._apply_rest is None:
+            return
+        dense_in = ch.dense_in if (self._dense and do_hh) else None
+        ddos_in = None
+        if ch.ddos_in is not None and do_dd:
+            u, s, g = ch.ddos_in
+            v = np.zeros(u.shape[0], bool)
+            v[:g] = True
+            ddos_in = (u, s, v)
+        if dense_in is None and ddos_in is None:
+            return
+        with self.stages.stage("device_apply"):
+            states = (
+                (),
+                tuple(w.model.totals for _, w in self._dense),
+                tuple(d.state for _, d in self._ddos),
+            )
+            _, new_dense, new_ddos = self._apply_rest(
+                states, (), dense_in, ddos_in)
+            if dense_in is not None:
+                for (_, w), tot in zip(self._dense, new_dense):
+                    w.model.totals = tot
+            for (_, d), st in zip(self._ddos, new_ddos):
+                d.state = st
+
+    # ---- state synchronization --------------------------------------------
+
+    def _ensure_imported(self, i: int) -> None:
+        model = self._hh[i][1].model
+        if model.state is not self._shadow[i]:
+            # reset()/restore() replaced the state object: adopt it
+            self._engine.import_state(i, model.state)
+            self._shadow[i] = model.state
+            self._sketch_dirty[i] = False
+
+    def sync_states(self) -> None:
+        """Export engine state back into the wrapped models so reads
+        (window close, checkpoint, live queries) see current sketches.
+        Cheap when nothing folded since the last sync."""
+        for i, (_, w) in enumerate(self._hh):
+            if not self._sketch_dirty[i]:
+                continue
+            state = self._engine.export_state(i)
+            w.model.state = state
+            self._shadow[i] = state
+            self._sketch_dirty[i] = False
+
+    def _advance_hh(self, slot: int, n_rows: int) -> bool:
+        cur = self._whh[0].current_slot if self._whh else None
+        if cur is not None and slot > cur:
+            # the close extracts top-K from model state: sync first
+            self.sync_states()
+        return super()._advance_hh(slot, n_rows)

@@ -1,0 +1,93 @@
+"""Host-resident heavy-hitter state and its device-state conversions.
+
+The host engine keeps CMS counters in uint64 (the exact monoid — u64
+addition is associative, which is what makes the threaded native update
+deterministic for free) and the top-K table in the device layout
+(uint32 keys, float32 values: table values accumulate by single f32
+adds per round on BOTH paths, so keeping f32 here makes table parity
+unconditional). Conversions to/from the device ``HHState`` are lossless
+on the uint64-exact envelope:
+
+- u64 -> f32 export is exact while cells stay below 2^24 — the same
+  envelope inside which the device's own f32 accumulation is exact;
+- f32 -> u64 import is exact for every integer-valued f32 cell, which
+  the device path produces by construction (counters are integer sums
+  of integer-valued addends).
+
+Out-of-envelope values clamp instead of corrupting (NaN/negative -> 0,
+overflow -> the largest f32 below 2^64), so a restore from a hot
+device sketch never produces garbage counters.
+"""
+
+from __future__ import annotations
+
+# flowlint: uint64-exact
+# (the whole point of this state is exact unsigned counters; a signed
+# cast here silently re-introduces the float error the engine removes)
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..models.heavy_hitter import HeavyHitterConfig, HHState, key_width
+
+# Largest float32 strictly below 2^64 — the clamp for out-of-envelope
+# device cells on import (astype(u64) of +/-inf or >=2^64 is undefined).
+_U64_CAP = np.float32(1.8446742e19)
+
+
+@dataclass
+class HostHHState:
+    """One family's host-resident sketch state (engine-owned buffers)."""
+
+    cms: np.ndarray         # [P+1, depth, width] uint64, C-contiguous
+    table_keys: np.ndarray  # [capacity, key_width] uint32, C-contiguous
+    table_vals: np.ndarray  # [capacity, P+1] float32, C-contiguous
+
+
+def host_hh_init(config: HeavyHitterConfig) -> HostHHState:
+    planes = len(config.value_cols) + 1  # + count plane
+    w = key_width(config)
+    return HostHHState(
+        cms=np.zeros((planes, config.depth, config.width), np.uint64),
+        table_keys=np.full((config.capacity, w), 0xFFFFFFFF, np.uint32),
+        table_vals=np.zeros((config.capacity, planes), np.float32),
+    )
+
+
+def _cms_to_u64(cms) -> np.ndarray:
+    a = np.asarray(cms, dtype=np.float32)
+    with np.errstate(invalid="ignore"):
+        a = np.nan_to_num(a, nan=0.0, posinf=float(_U64_CAP), neginf=0.0)
+        a = np.clip(a, np.float32(0.0), _U64_CAP)
+    return np.ascontiguousarray(a.astype(np.uint64))
+
+
+def from_device_state(state) -> HostHHState:
+    """Import a device ``HHState`` (jax or numpy leaves; also accepts the
+    checkpoint loader's field-dict form) into engine-owned host buffers.
+    Always copies — the engine mutates its state in place and must never
+    alias arrays a LazyWindowTop or checkpoint may still read."""
+    if isinstance(state, dict):  # engine.checkpoint decodes NamedTuples so
+        cms, tk, tv = (state["cms"], state["table_keys"],
+                       state["table_vals"])
+    else:
+        cms, tk, tv = state.cms, state.table_keys, state.table_vals
+    return HostHHState(
+        cms=_cms_to_u64(cms),
+        table_keys=np.ascontiguousarray(np.asarray(tk), dtype=np.uint32)
+        .copy(),
+        table_vals=np.ascontiguousarray(np.asarray(tv), dtype=np.float32)
+        .copy(),
+    )
+
+
+def to_device_state(host: HostHHState) -> HHState:
+    """Export engine state as a device-layout ``HHState`` with fresh numpy
+    leaves (consumed by model.top()/top_lazy(), checkpoints, and a
+    backend switch back to the jitted path)."""
+    return HHState(
+        cms=host.cms.astype(np.float32),
+        table_keys=host.table_keys.copy(),
+        table_vals=host.table_vals.copy(),
+    )

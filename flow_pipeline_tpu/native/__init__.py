@@ -102,6 +102,48 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_void_p,  # [n] int32 starts out
             ctypes.POINTER(ctypes.c_int32),  # collided out
         ]
+    if hasattr(lib, "hs_cms_update"):  # pre-r8 .so lacks the sketch engine
+        lib.hs_cms_update.restype = ctypes.c_longlong
+        lib.hs_cms_update.argtypes = [
+            ctypes.c_void_p,  # [P, D, W] uint64 sketch (in place)
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, kw] uint32 keys
+            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, P] float32 addends
+            ctypes.c_void_p,  # [n] uint8 valid (NULL = all)
+            ctypes.c_int,     # conservative
+            ctypes.c_int,     # threads
+        ]
+        lib.hs_cms_query.restype = ctypes.c_longlong
+        lib.hs_cms_query.argtypes = [
+            ctypes.c_void_p,  # [P, D, W] uint64 sketch
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, kw] uint32 keys
+            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, P] float32 out
+            ctypes.c_int,     # threads
+        ]
+        lib.hs_hh_prefilter.restype = ctypes.c_longlong
+        lib.hs_hh_prefilter.argtypes = [
+            ctypes.c_void_p,  # [cap, kw] uint32 table keys
+            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, kw] uint32 candidate keys
+            ctypes.c_void_p,  # [n, P] float32 sums (plane 0 ranks)
+            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [2*cap] int32 selection out
+            ctypes.c_int,     # threads
+        ]
+        lib.hs_topk_merge.restype = ctypes.c_longlong
+        lib.hs_topk_merge.argtypes = [
+            ctypes.c_void_p,  # [cap, kw] uint32 table keys (in place)
+            ctypes.c_void_p,  # [cap, P] float32 table vals (in place)
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, kw] uint32 candidate keys
+            ctypes.c_void_p,  # [n, P] float32 batch sums
+            ctypes.c_void_p,  # [n, P] float32 CMS estimates
+            ctypes.c_void_p,  # [n] uint8 valid (NULL = all)
+            ctypes.c_longlong,
+        ]
     return lib
 
 
@@ -183,6 +225,120 @@ def hash_group(lanes: np.ndarray):
     if g < 0:
         raise ValueError("flow_hash_group failed (batch too large?)")
     return perm, starts[:g], bool(collided.value)
+
+
+def sketch_available() -> bool:
+    """Whether the loaded library exports the hostsketch engine (an .so
+    built before r8 decodes and groups fine but cannot sketch)."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "hs_cms_update")
+
+
+def _c_arr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+def hs_cms_update(cms: np.ndarray, keys: np.ndarray, vals: np.ndarray,
+                  valid, conservative: bool, threads: int = 1) -> None:
+    """Native uint64 CMS update (plain or conservative) in place.
+
+    cms [P, D, W] uint64 C-contiguous; keys [n, kw] uint32; vals [n, P]
+    float32; valid [n] bool or None. Deterministic for any thread count
+    (see native/hostsketch.cc). Raises on degenerate shapes."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "hs_cms_update"):
+        raise RuntimeError("libflowdecode.so missing hostsketch engine; "
+                           "run `make native`")
+    assert cms.dtype == np.uint64 and cms.flags["C_CONTIGUOUS"]
+    p, d, w = cms.shape
+    keys = np.ascontiguousarray(keys, dtype=np.uint32)
+    vals = np.ascontiguousarray(vals, dtype=np.float32)
+    n, kw = keys.shape
+    vptr = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, dtype=np.uint8)
+        vptr = _c_arr(valid)
+    rc = lib.hs_cms_update(_c_arr(cms), p, d, w, _c_arr(keys), n, kw,
+                           _c_arr(vals), vptr, int(bool(conservative)),
+                           int(threads))
+    if rc != 0:
+        raise ValueError(f"hs_cms_update failed (rc={rc}): degenerate "
+                         f"shape planes={p} depth={d} width={w}")
+
+
+def hs_cms_query(cms: np.ndarray, keys: np.ndarray,
+                 threads: int = 1) -> np.ndarray:
+    """Native CMS point query: [n, P] float32 min-over-depth estimates."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "hs_cms_query"):
+        raise RuntimeError("libflowdecode.so missing hostsketch engine; "
+                           "run `make native`")
+    assert cms.dtype == np.uint64 and cms.flags["C_CONTIGUOUS"]
+    p, d, w = cms.shape
+    keys = np.ascontiguousarray(keys, dtype=np.uint32)
+    n, kw = keys.shape
+    out = np.empty((n, p), np.float32)
+    rc = lib.hs_cms_query(_c_arr(cms), p, d, w, _c_arr(keys), n, kw,
+                          _c_arr(out), int(threads))
+    if rc != 0:
+        raise ValueError(f"hs_cms_query failed (rc={rc})")
+    return out
+
+
+def hs_hh_prefilter(table_keys: np.ndarray, cand_keys: np.ndarray,
+                    cand_sums: np.ndarray, threads: int = 1) -> np.ndarray:
+    """Native table-aware candidate prefilter: selected row indices in
+    (metric desc, index asc) order — lax.top_k's tie-break. Returns
+    [min(n, 2*cap)] int32."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "hs_hh_prefilter"):
+        raise RuntimeError("libflowdecode.so missing hostsketch engine; "
+                           "run `make native`")
+    table_keys = np.ascontiguousarray(table_keys, dtype=np.uint32)
+    cand_keys = np.ascontiguousarray(cand_keys, dtype=np.uint32)
+    cand_sums = np.ascontiguousarray(cand_sums, dtype=np.float32)
+    cap, kw = table_keys.shape
+    n, planes = cand_sums.shape
+    sel = np.empty(2 * cap, np.int32)
+    m = lib.hs_hh_prefilter(_c_arr(table_keys), cap, kw, _c_arr(cand_keys),
+                            _c_arr(cand_sums), n, planes, _c_arr(sel),
+                            int(threads))
+    if m < 0:
+        raise ValueError(f"hs_hh_prefilter failed (rc={m})")
+    return sel[:m]
+
+
+def hs_topk_merge(table_keys: np.ndarray, table_vals: np.ndarray,
+                  cand_keys: np.ndarray, cand_sums: np.ndarray,
+                  cand_est: np.ndarray, valid) -> int:
+    """Native space-saving admission merge, in place on the table buffers
+    (ops.topk.topk_merge_est semantics — pass cand_est=cand_sums for the
+    'plain' batch-sum merge). Returns the number of real rows."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "hs_topk_merge"):
+        raise RuntimeError("libflowdecode.so missing hostsketch engine; "
+                           "run `make native`")
+    assert table_keys.dtype == np.uint32 and \
+        table_keys.flags["C_CONTIGUOUS"]
+    assert table_vals.dtype == np.float32 and \
+        table_vals.flags["C_CONTIGUOUS"]
+    cap, kw = table_keys.shape
+    planes = table_vals.shape[1]
+    cand_keys = np.ascontiguousarray(cand_keys, dtype=np.uint32)
+    cand_sums = np.ascontiguousarray(cand_sums, dtype=np.float32)
+    cand_est = np.ascontiguousarray(cand_est, dtype=np.float32)
+    n = cand_keys.shape[0]
+    vptr = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, dtype=np.uint8)
+        vptr = _c_arr(valid)
+    rc = lib.hs_topk_merge(_c_arr(table_keys), _c_arr(table_vals),
+                           cap, kw, planes, _c_arr(cand_keys),
+                           _c_arr(cand_sums), _c_arr(cand_est), vptr, n)
+    if rc < 0:
+        raise ValueError(f"hs_topk_merge failed (rc={rc}): degenerate "
+                         f"shape cap={cap} kw={kw} planes={planes}")
+    return int(rc)
 
 
 def encode_stream(batch, out_capacity: int = 0) -> bytes:

@@ -61,37 +61,82 @@ class Gauge(Counter):
 
 class Summary:
     """Sliding-window summary with quantiles + running sum/count (the shape
-    GoFlow's *_time_us summaries take)."""
+    GoFlow's *_time_us summaries take).
 
-    def __init__(self, name: str, help_: str = "", window: int = 1024):
+    Observations may carry labels (``observe(v, router="10.0.0.1")``):
+    each label set keeps its own window/sum/count and renders as its own
+    quantile series — how the reference's perfs dashboards break the
+    NFDelaySummary panel down ``by (router)``. The unlabeled form is the
+    plain single-series summary it always was, and ``_sum``/``_count``
+    stay the ACROSS-ALL-LABELS totals (bench.py's stage budget reads
+    them).
+
+    Label values can be attacker-controlled (the collector labels by
+    spoofable UDP source address) and each label set pins a full sample
+    window, so distinct label sets are CAPPED: once ``max_label_sets``
+    exist, observations for unseen label sets fold into an ``_other``
+    series per label name — the tail stays measured, memory and scrape
+    cost stay bounded."""
+
+    def __init__(self, name: str, help_: str = "", window: int = 1024,
+                 max_label_sets: int = 64):
         self.name = name
         self.help = help_
+        self._window = window
+        self._max_label_sets = max_label_sets
         self._lock = threading.Lock()
-        self._obs: deque[float] = deque(maxlen=window)
-        self._sum = 0.0
+        self._obs: dict[tuple, deque] = {}
+        self._sums: dict[tuple, float] = {}
+        self._counts: dict[tuple, int] = {}
+        self._sum = 0.0  # totals across label sets (stage budgets)
         self._count = 0
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
         with self._lock:
-            self._obs.append(value)
+            dq = self._obs.get(key)
+            if dq is None:
+                if key and len(self._obs) >= self._max_label_sets:
+                    # cardinality cap: fold the tail into _other so a
+                    # spoofed-exporter flood cannot grow this unbounded
+                    key = tuple((name, "_other") for name, _ in key)
+                    dq = self._obs.get(key)
+                if dq is None:
+                    dq = self._obs[key] = deque(maxlen=self._window)
+            dq.append(value)
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._counts[key] = self._counts.get(key, 0) + 1
             self._sum += value
             self._count += 1
 
-    def quantile(self, q: float) -> float:
+    def quantile(self, q: float, **labels) -> float:
+        key = tuple(sorted(labels.items()))
         with self._lock:
-            if not self._obs:
+            dq = self._obs.get(key)
+            if not dq:
                 return 0.0
-            data = sorted(self._obs)
+            data = sorted(dq)
         idx = min(len(data) - 1, int(q * len(data)))
         return data[idx]
 
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} summary"]
-        for q in (0.5, 0.9, 0.99):
-            lines.append(f'{self.name}{{quantile="{q}"}} {self.quantile(q)}')
         with self._lock:
-            lines.append(f"{self.name}_sum {self._sum}")
-            lines.append(f"{self.name}_count {self._count}")
+            snap = {key: sorted(dq) for key, dq in self._obs.items()} \
+                or {(): []}
+            sums = dict(self._sums)
+            counts = dict(self._counts)
+        for key, data in snap.items():  # one sort per label set, 3 reads
+            for q in (0.5, 0.9, 0.99):
+                labels = _fmt_labels({**dict(key), "quantile": str(q)})
+                v = data[min(len(data) - 1, int(q * len(data)))] \
+                    if data else 0.0
+                lines.append(f"{self.name}{labels} {v}")
+        for key in snap:
+            labels = _fmt_labels(dict(key))
+            lines.append(f"{self.name}_sum{labels} {sums.get(key, 0.0)}")
+            lines.append(
+                f"{self.name}_count{labels} {counts.get(key, 0)}")
         return "\n".join(lines)
 
 
@@ -106,8 +151,11 @@ class MetricsRegistry:
     def gauge(self, name: str, help_: str = "") -> Gauge:
         return self._get_or_make(name, lambda: Gauge(name, help_), Gauge)
 
-    def summary(self, name: str, help_: str = "", window: int = 1024) -> Summary:
-        return self._get_or_make(name, lambda: Summary(name, help_, window), Summary)
+    def summary(self, name: str, help_: str = "", window: int = 1024,
+                max_label_sets: int = 64) -> Summary:
+        return self._get_or_make(
+            name, lambda: Summary(name, help_, window, max_label_sets),
+            Summary)
 
     def _get_or_make(self, name, factory, cls):
         with self._lock:

@@ -1,0 +1,424 @@
+// libflowdecode hostsketch: native host-resident sketch engine.
+//
+// The jitted sketch step (CMS scatter + heavy-hitter table merge) is the
+// dominant CPU cost once the host dataplane is pipelined (~66% of e2e
+// wall, BENCH_r06). Hardware offload is the established answer when the
+// general-purpose path saturates (FPGA sketch acceleration,
+// arXiv:2504.16896; in-dataplane heavy hitters, arXiv:1611.04825); the
+// CPU-host analogue is this engine: multi-threaded uint64 count-min
+// update (plain + conservative), CMS point query, and the space-saving
+// top-K admission merge, driven through the same group tables the XLA
+// step consumes (flow_pipeline_tpu/hostsketch/).
+//
+// Parity contract (tests/test_hostsketch.py): every routine reproduces
+// its ops/cms.py / ops/topk.py twin BIT-EXACTLY on the uint64-exact
+// envelope — counters are integer-valued and per-cell totals stay below
+// 2^24, where float32 arithmetic is exact, so the f32 (device) and u64
+// (host) monoids coincide. Concretely:
+//
+// - buckets use the identical murmur3_x86_32 word-lane hash
+//   (schema/keys.py hash_words), seed = depth row;
+// - conservative update computes every target against the PRE-update
+//   sketch then applies scatter-max — order-free, so threads need no
+//   ordering discipline to be deterministic;
+// - plain update adds uint64 addends — associative, so any thread
+//   interleaving over disjoint (plane, depth) rows is deterministic;
+// - the merge reproduces topk_merge_est's ranking exactly: groups form
+//   in lexicographic key order (sort_groupby_float's slot order) and
+//   rank by (primary desc, lex key asc) — jnp.argsort(-primary) stable
+//   tie behavior.
+//
+// Threading: parallel work is partitioned so no two threads ever write
+// the same cell — (plane, depth) rows own disjoint sketch cells, row
+// ranges own disjoint scratch — and joined before return. No locks, no
+// atomics beyond the work-stealing task counter.
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---- murmur3_x86_32 over uint32 word lanes (schema/keys.py twin) ----------
+
+inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+inline uint32_t hash_words(const uint32_t* w, long long kw, uint32_t seed) {
+  uint32_t h = seed;
+  for (long long i = 0; i < kw; ++i) {
+    uint32_t k = w[i];
+    k *= 0xCC9E2D51u;
+    k = rotl32(k, 15);
+    k *= 0x1B873593u;
+    h ^= k;
+    h = rotl32(h, 13);
+    h = h * 5u + 0xE6546B64u;
+  }
+  h ^= static_cast<uint32_t>(kw * 4);
+  h ^= h >> 16;
+  h *= 0x85EBCA6Bu;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35u;
+  h ^= h >> 16;
+  return h;
+}
+
+// f32 addend -> u64, matching what the f32 sketch accumulates on the
+// exact envelope: values are integer-valued and non-negative by
+// construction (group sums of saturated u32 counters x rate); clamp
+// anything outside that envelope instead of hitting UB in the cast.
+inline uint64_t addend_u64(float v) {
+  if (!(v > 0.0f)) return 0;  // negatives and NaN contribute nothing
+  if (v >= 18446744073709551615.0f) return UINT64_MAX;
+  return static_cast<uint64_t>(v);
+}
+
+// Work-stealing task loop: spawn-and-join per call keeps the engine
+// state-free (no persistent pool to leak or race); tasks must write
+// disjoint data.
+template <typename F>
+void parallel_tasks(long long n_tasks, int threads, F fn) {
+  if (threads <= 1 || n_tasks <= 1) {
+    for (long long t = 0; t < n_tasks; ++t) fn(t);
+    return;
+  }
+  int nt = static_cast<int>(
+      std::min<long long>(threads, n_tasks));
+  std::atomic<long long> next{0};
+  std::vector<std::thread> pool;
+  pool.reserve(nt);
+  for (int i = 0; i < nt; ++i) {
+    pool.emplace_back([&next, n_tasks, &fn] {
+      long long t;
+      while ((t = next.fetch_add(1, std::memory_order_relaxed)) < n_tasks) {
+        fn(t);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+// Row-range task shape for per-row work (bucket hashing, queries).
+constexpr long long kRowBlock = 2048;
+
+inline long long n_blocks(long long n) {
+  return (n + kRowBlock - 1) / kRowBlock;
+}
+
+// Per-depth bucket table [depth, n] — one hash pass, shared by update
+// and query.
+void fill_buckets(const uint32_t* keys, long long n, long long kw,
+                  long long depth, long long width, int threads,
+                  uint32_t* buckets) {
+  parallel_tasks(n_blocks(n) * depth, threads,
+                 [&](long long task) {
+    long long d = task % depth;
+    long long blk = task / depth;
+    long long lo = blk * kRowBlock;
+    long long hi = std::min(n, lo + kRowBlock);
+    uint32_t seed = static_cast<uint32_t>(d);
+    uint32_t w = static_cast<uint32_t>(width);
+    for (long long r = lo; r < hi; ++r) {
+      buckets[d * n + r] = hash_words(keys + r * kw, kw, seed) % w;
+    }
+  });
+}
+
+// h1 of ops.hostgroup.hash_u64 / ops.segment.hash_lanes: the 32-bit mix
+// the table prefilter's membership test rides (same constants as
+// flowdecode.cc's mix_lanes pair 0).
+inline uint32_t mix_h1(const uint32_t* row, long long w) {
+  uint32_t h = 0x2545F491u;
+  for (long long i = 0; i < w; ++i) {
+    h = (h ^ row[i]) * 0x9E3779B1u;
+    h = (h << 13) | (h >> 19);
+  }
+  h ^= h >> 16;
+  h *= 0x85EBCA6Bu;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35u;
+  h ^= h >> 16;
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Multi-threaded uint64 CMS update over pre-aggregated unique keys —
+// the native twin of ops.cms.cms_add / cms_add_conservative.
+//
+//   cms:    [planes, depth, width] uint64, updated in place
+//   keys:   [n, kw] uint32 unique key lanes
+//   vals:   [n, planes] float32 per-key addends (integer-valued)
+//   valid:  [n] uint8 mask (NULL = all valid)
+//   conservative: 0 = linear add, 1 = conservative (scatter-max to
+//                 pre-update estimate + addend)
+//
+// Returns 0, or -1 on degenerate shapes (width/depth/planes < 1, n < 0,
+// kw < 0). n == 0 is a clean no-op.
+long long hs_cms_update(uint64_t* cms, long long planes, long long depth,
+                        long long width, const uint32_t* keys, long long n,
+                        long long kw, const float* vals,
+                        const uint8_t* valid, int conservative,
+                        int threads) {
+  if (planes < 1 || depth < 1 || width < 1 || n < 0 || kw < 0) return -1;
+  if (n == 0) return 0;
+  std::vector<uint32_t> buckets(static_cast<size_t>(depth * n));
+  fill_buckets(keys, n, kw, depth, width, threads, buckets.data());
+
+  if (!conservative) {
+    // Linear add: each (plane, depth) row owns a disjoint cell range;
+    // u64 addition is associative so the task order is irrelevant.
+    parallel_tasks(planes * depth, threads, [&](long long task) {
+      long long p = task / depth, d = task % depth;
+      uint64_t* row = cms + (p * depth + d) * width;
+      const uint32_t* b = buckets.data() + d * n;
+      for (long long r = 0; r < n; ++r) {
+        if (valid && !valid[r]) continue;
+        row[b[r]] += addend_u64(vals[r * planes + p]);
+      }
+    });
+    return 0;
+  }
+
+  // Conservative update, two phases exactly like the XLA graph: every
+  // target reads the PRE-update sketch (cms_query before any write),
+  // then the scatter-max applies — max is order-free, so the result is
+  // independent of both key order and thread interleaving.
+  std::vector<uint64_t> target(static_cast<size_t>(n * planes));
+  parallel_tasks(n_blocks(n), threads, [&](long long blk) {
+    long long lo = blk * kRowBlock;
+    long long hi = std::min(n, lo + kRowBlock);
+    for (long long r = lo; r < hi; ++r) {
+      if (valid && !valid[r]) continue;
+      for (long long p = 0; p < planes; ++p) {
+        uint64_t est = UINT64_MAX;
+        for (long long d = 0; d < depth; ++d) {
+          uint64_t cell = cms[(p * depth + d) * width + buckets[d * n + r]];
+          if (cell < est) est = cell;
+        }
+        target[r * planes + p] = est + addend_u64(vals[r * planes + p]);
+      }
+    }
+  });
+  parallel_tasks(planes * depth, threads, [&](long long task) {
+    long long p = task / depth, d = task % depth;
+    uint64_t* row = cms + (p * depth + d) * width;
+    const uint32_t* b = buckets.data() + d * n;
+    for (long long r = 0; r < n; ++r) {
+      if (valid && !valid[r]) continue;
+      uint64_t t = target[r * planes + p];
+      if (t > row[b[r]]) row[b[r]] = t;
+    }
+  });
+  return 0;
+}
+
+// CMS point query: min over depth rows per plane, as float32 — the
+// native twin of ops.cms.cms_query. out: [n, planes] float32.
+long long hs_cms_query(const uint64_t* cms, long long planes,
+                       long long depth, long long width,
+                       const uint32_t* keys, long long n, long long kw,
+                       float* out, int threads) {
+  if (planes < 1 || depth < 1 || width < 1 || n < 0 || kw < 0) return -1;
+  if (n == 0) return 0;
+  std::vector<uint32_t> buckets(static_cast<size_t>(depth * n));
+  fill_buckets(keys, n, kw, depth, width, threads, buckets.data());
+  parallel_tasks(n_blocks(n), threads, [&](long long blk) {
+    long long lo = blk * kRowBlock;
+    long long hi = std::min(n, lo + kRowBlock);
+    for (long long r = lo; r < hi; ++r) {
+      for (long long p = 0; p < planes; ++p) {
+        uint64_t est = UINT64_MAX;
+        for (long long d = 0; d < depth; ++d) {
+          uint64_t cell = cms[(p * depth + d) * width + buckets[d * n + r]];
+          if (cell < est) est = cell;
+        }
+        out[r * planes + p] = static_cast<float>(est);
+      }
+    }
+  });
+  return 0;
+}
+
+// Table-aware candidate prefilter — the native twin of
+// _apply_grouped's prefilter block (models/heavy_hitter.py).
+//
+// Boosts groups whose key hash is already in the table's hash set
+// (residents are NEVER starved of their increments), then selects the
+// top 2*cap candidates by (metric desc, index asc) — lax.top_k's
+// lowest-index tie-break. Writes the selected row indices, in that
+// exact order, into sel_out (caller-allocated, 2*cap entries) and
+// returns how many were written (min(n, 2*cap)), or -1 on degenerate
+// shapes. Membership rides the same h1 hash lane as the jitted path:
+// one false positive per ~cap/2^32 groups merely spends a candidate
+// slot on a loser.
+long long hs_hh_prefilter(const uint32_t* table_keys, long long cap,
+                          long long kw, const uint32_t* uniq,
+                          const float* sums, long long n, long long planes,
+                          int32_t* sel_out, int threads) {
+  if (cap < 1 || kw < 1 || planes < 1 || n < 0) return -1;
+  if (n == 0) return 0;
+  std::vector<uint32_t> th(static_cast<size_t>(cap));
+  for (long long c = 0; c < cap; ++c) {
+    th[static_cast<size_t>(c)] = mix_h1(table_keys + c * kw, kw);
+  }
+  std::sort(th.begin(), th.end());
+  // metric: plane-0 sum, residents boosted to +inf (matches
+  // jnp.where(resident, inf, sums[:, 0]))
+  std::vector<float> metric(static_cast<size_t>(n));
+  parallel_tasks(n_blocks(n), threads, [&](long long blk) {
+    long long lo = blk * kRowBlock;
+    long long hi = std::min(n, lo + kRowBlock);
+    for (long long r = lo; r < hi; ++r) {
+      uint32_t gh = mix_h1(uniq + r * kw, kw);
+      bool resident = std::binary_search(th.begin(), th.end(), gh);
+      metric[static_cast<size_t>(r)] =
+          resident ? std::numeric_limits<float>::infinity()
+                   : sums[r * planes];
+    }
+  });
+  long long m = std::min(n, 2 * cap);
+  std::vector<int32_t> idx(static_cast<size_t>(n));
+  for (long long r = 0; r < n; ++r) idx[static_cast<size_t>(r)] = static_cast<int32_t>(r);
+  auto cmp = [&metric](int32_t a, int32_t b) {
+    float ma = metric[static_cast<size_t>(a)];
+    float mb = metric[static_cast<size_t>(b)];
+    if (ma != mb) return ma > mb;
+    return a < b;
+  };
+  std::partial_sort(idx.begin(), idx.begin() + m, idx.end(), cmp);
+  std::memcpy(sel_out, idx.data(), static_cast<size_t>(m) * sizeof(int32_t));
+  return m;
+}
+
+// Space-saving admission merge — the native twin of
+// ops.topk.topk_merge_est, in place on the table buffers.
+//
+//   table_keys: [cap, kw] uint32 (all-0xFFFFFFFF rows = empty slots)
+//   table_vals: [cap, planes] float32
+//   cand_keys:  [n, kw] uint32 unique candidate keys
+//   cand_sums:  [n, planes] float32 batch sums (resident increment)
+//   cand_est:   [n, planes] float32 CMS estimates (new-key entry value;
+//               pass cand_sums here for the "plain" batch-sum merge)
+//   cand_valid: [n] uint8
+//
+// A key already resident takes table + sums; a new key enters with est.
+// The rewritten table is ranked by vals[:, 0] descending with ties in
+// lexicographic key order — jnp.argsort(-primary)'s stable order over
+// sort_groupby_float's lex-ordered groups. Returns the number of real
+// rows, or -1 on degenerate shapes.
+long long hs_topk_merge(uint32_t* table_keys, float* table_vals,
+                        long long cap, long long kw, long long planes,
+                        const uint32_t* cand_keys, const float* cand_sums,
+                        const float* cand_est, const uint8_t* cand_valid,
+                        long long n) {
+  if (cap < 1 || kw < 1 || planes < 1 || n < 0) return -1;
+
+  // Snapshot the table first: the merge rewrites the buffers in place.
+  std::vector<uint32_t> old_keys(table_keys,
+                                 table_keys + cap * kw);
+  std::vector<float> old_vals(table_vals, table_vals + cap * planes);
+
+  auto is_sentinel = [kw](const uint32_t* key) {
+    for (long long i = 0; i < kw; ++i) {
+      if (key[i] != 0xFFFFFFFFu) return false;
+    }
+    return true;
+  };
+
+  struct Tagged {
+    const uint32_t* key;
+    long long table_row;  // -1 when candidate
+    long long cand_row;   // -1 when table
+  };
+  std::vector<Tagged> rows;
+  rows.reserve(static_cast<size_t>(cap + n));
+  for (long long c = 0; c < cap; ++c) {
+    const uint32_t* key = old_keys.data() + c * kw;
+    if (!is_sentinel(key)) rows.push_back({key, c, -1});
+  }
+  for (long long r = 0; r < n; ++r) {
+    if (cand_valid && !cand_valid[r]) continue;
+    const uint32_t* key = cand_keys + r * kw;
+    if (!is_sentinel(key)) rows.push_back({key, -1, r});
+  }
+  auto key_less = [kw](const uint32_t* a, const uint32_t* b) {
+    for (long long i = 0; i < kw; ++i) {
+      if (a[i] != b[i]) return a[i] < b[i];
+    }
+    return false;
+  };
+  std::sort(rows.begin(), rows.end(),
+            [&key_less](const Tagged& a, const Tagged& b) {
+              return key_less(a.key, b.key);
+            });
+
+  struct Group {
+    const uint32_t* key;
+    std::vector<float> vals;
+  };
+  std::vector<Group> groups;
+  groups.reserve(rows.size());
+  size_t i = 0;
+  while (i < rows.size()) {
+    size_t j = i + 1;
+    while (j < rows.size() &&
+           std::memcmp(rows[j].key, rows[i].key,
+                       static_cast<size_t>(kw) * sizeof(uint32_t)) == 0) {
+      ++j;
+    }
+    long long trow = -1, crow = -1;
+    for (size_t k = i; k < j; ++k) {
+      if (rows[k].table_row >= 0) trow = rows[k].table_row;
+      if (rows[k].cand_row >= 0) crow = rows[k].cand_row;
+    }
+    Group g;
+    g.key = rows[i].key;
+    g.vals.resize(static_cast<size_t>(planes));
+    bool resident = trow >= 0;
+    for (long long p = 0; p < planes; ++p) {
+      float t = resident ? old_vals[trow * planes + p] : 0.0f;
+      float c = 0.0f;
+      if (crow >= 0) {
+        c = resident ? cand_sums[crow * planes + p]
+                     : cand_est[crow * planes + p];
+      }
+      g.vals[static_cast<size_t>(p)] = t + c;  // one f32 add, like the jit
+    }
+    groups.push_back(std::move(g));
+    i = j;
+  }
+
+  // Rank: primary value descending; equal primaries keep lexicographic
+  // key order (groups are already lex-ordered, so a stable sort on the
+  // primary alone reproduces argsort(-primary)'s tie behavior).
+  std::stable_sort(groups.begin(), groups.end(),
+                   [](const Group& a, const Group& b) {
+                     return a.vals[0] > b.vals[0];
+                   });
+
+  long long real = static_cast<long long>(
+      std::min<size_t>(groups.size(), static_cast<size_t>(cap)));
+  for (long long c = 0; c < real; ++c) {
+    std::memcpy(table_keys + c * kw, groups[static_cast<size_t>(c)].key,
+                static_cast<size_t>(kw) * sizeof(uint32_t));
+    std::memcpy(table_vals + c * planes,
+                groups[static_cast<size_t>(c)].vals.data(),
+                static_cast<size_t>(planes) * sizeof(float));
+  }
+  for (long long c = real; c < cap; ++c) {
+    for (long long w = 0; w < kw; ++w) table_keys[c * kw + w] = 0xFFFFFFFFu;
+    for (long long p = 0; p < planes; ++p) table_vals[c * planes + p] = 0.0f;
+  }
+  return real;
+}
+
+}  // extern "C"

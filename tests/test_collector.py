@@ -446,6 +446,28 @@ class TestCollectorServer:
         assert server.m_flow_bytes.value(type="sFlow",
                                          remote_ip="10.0.0.2") > 0
 
+    def test_per_router_delay_and_decode_summaries(self):
+        """The delay summary is labeled per exporter and the decode
+        summary per protocol, so the dashboard's by-router delay
+        quantile panels resolve against real series (test_deploy
+        asserts the panel side of this contract)."""
+        bus, producer, server = self.make()
+        server.handle_netflow(v5_datagram(), "10.0.0.1:2055")
+        server.handle_netflow(v5_datagram(), "10.0.0.9:2055")
+        server.handle_sflow(sflow_datagram(), "10.0.0.2:6343")
+        # per-router windows are independent; both observed something
+        assert server.m_nf_delay.quantile(0.5, router="10.0.0.1") >= 0.0
+        assert server.m_nf_delay._counts[(("router", "10.0.0.1"),)] == 2
+        assert server.m_nf_delay._counts[(("router", "10.0.0.9"),)] == 2
+        rendered = server.m_nf_delay.render()
+        assert 'quantile="0.99",router="10.0.0.1"' in rendered
+        assert 'flow_process_nf_delay_summary_seconds_count' \
+            '{router="10.0.0.9"} 2' in rendered
+        decode = server.m_decode_us.render()
+        assert 'name="NetFlow"' in decode and 'name="sFlow"' in decode
+        # totals still aggregate across label sets (stage-budget contract)
+        assert server.m_decode_us._count == 3
+
     def test_struct_error_datagrams_survive(self):
         # crafted packets that trip fixed-layout unpacks (struct.error) must
         # be counted as errors, never propagate out of the handlers
@@ -487,7 +509,9 @@ class TestCollectorServer:
         struct.pack_into(">I", dgram, 8, int(time.time()) - 3)  # unix_secs
         assert server.handle_netflow(bytes(dgram)) == 2
         assert server.m_nf_delay._count == 2
-        p50 = server.m_nf_delay.quantile(0.5)
+        # observations carry the router label (empty for an unknown
+        # source), like every other per-exporter metric on this server
+        p50 = server.m_nf_delay.quantile(0.5, router="")
         assert 2.0 <= p50 <= 5.0
         rendered = server.m_nf_delay.render()
         assert "flow_process_nf_delay_summary_seconds{quantile=" in rendered

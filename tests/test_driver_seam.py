@@ -75,6 +75,18 @@ def test_bench_e2e_staging(tiny_bench, capsys):
     assert stats["value"] > 0
 
 
+def test_bench_hostsketch_staging(tiny_bench, capsys):
+    """`python bench.py hostsketch` — the r8 sketch-backend A/B artifact
+    (BENCH_r08.json's producer) at tiny shapes."""
+    bench.bench_hostsketch()
+    out = _last_json(capsys)
+    assert out["metric"].startswith("e2e sketch-backend A/B")
+    assert out["host_flows_per_sec"] > 0
+    assert out["device_flows_per_sec"] > 0
+    assert "device_apply_share_device_pct" in out
+    assert "host_note" in out
+
+
 def test_bench_sweep_staging(tiny_bench, capsys):
     bench.bench_sweep()
     out = _last_json(capsys)

@@ -104,6 +104,37 @@ class TestMetrics:
         text = reg.render()
         assert "latency_us_count 100" in text
 
+    def test_summary_labels(self):
+        """Labeled summaries: per-label-set windows/quantiles render as
+        their own series (the per-router delay panels), while _sum/_count
+        keep aggregating across labels (bench.py's stage budget reads
+        them)."""
+        reg = MetricsRegistry()
+        s = reg.summary("delay_s", "d")
+        for v in (1.0, 3.0):
+            s.observe(v, router="a")
+        s.observe(100.0, router="b")
+        assert s.quantile(0.99, router="a") <= 3.0
+        assert s.quantile(0.5, router="b") == 100.0
+        assert s.quantile(0.5) == 0.0  # unlabeled series: no observations
+        assert s._sum == 104.0 and s._count == 3
+        text = reg.render()
+        assert 'delay_s{quantile="0.5",router="a"}' in text
+        assert 'delay_s_count{router="b"} 1' in text
+
+    def test_summary_label_cardinality_capped(self):
+        """Label values can come from spoofable exporter addresses; past
+        the cap, unseen label sets fold into _other instead of pinning a
+        fresh sample window each (collector OOM guard)."""
+        reg = MetricsRegistry()
+        s = reg.summary("d_us", "d", max_label_sets=4)
+        for i in range(50):
+            s.observe(1.0, router=f"10.0.0.{i}")
+        assert len(s._obs) <= 5  # 4 real sets + the _other overflow
+        assert s._counts[(("router", "_other"),)] == 46
+        assert s._count == 50  # totals still see every observation
+        assert 'router="_other"' in s.render()
+
     def test_same_name_same_metric(self):
         reg = MetricsRegistry()
         assert reg.counter("x") is reg.counter("x")

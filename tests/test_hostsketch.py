@@ -1,0 +1,496 @@
+"""Host sketch dataplane parity (flow_pipeline_tpu.hostsketch).
+
+The `-sketch.backend=host` engine must be BIT-EXACT against the jitted
+path on the uint64-exact envelope (integer-valued counters, per-cell
+totals < 2^24 where f32 is exact): CMS counters, top-K tables, and
+flows_5m rows — enforced here, never eyeballed (`make
+hostsketch-parity` runs this file against a freshly built library).
+
+Layers:
+
+- op parity: the numpy twin AND the native kernels vs ops.cms /
+  ops.topk on random streams (hypothesis) and adversarial ones —
+  high-collision narrow-CMS (every key collides), eviction-boundary
+  ties at the table's capacity edge;
+- pipeline parity: HostSketchPipeline vs HostGroupPipeline on the
+  shared fused-test stream (window boundaries + late rows included);
+- worker integration: identical sink rows device vs host, checkpoint
+  round-trip with a backend SWITCH at restore in both directions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from flow_pipeline_tpu import native
+from flow_pipeline_tpu.engine import StreamWorker, WorkerConfig
+from flow_pipeline_tpu.engine.fused import FusedPipeline
+from flow_pipeline_tpu.engine.hostfused import HostGroupPipeline
+from flow_pipeline_tpu.hostsketch import HostSketchPipeline
+from flow_pipeline_tpu.hostsketch import engine as hs_engine
+from flow_pipeline_tpu.hostsketch.state import (
+    from_device_state,
+    to_device_state,
+)
+from flow_pipeline_tpu.models.heavy_hitter import (
+    HeavyHitterConfig,
+    _apply_grouped,
+    hh_init,
+)
+from flow_pipeline_tpu.ops import cms as cms_ops
+from flow_pipeline_tpu.ops import topk as topk_ops
+from flow_pipeline_tpu.schema import wire
+from flow_pipeline_tpu.transport import Consumer, InProcessBus
+
+from test_fused import (
+    BS,
+    WINDOW,
+    assert_same_windows,
+    canon_rows,
+    make_models,
+    make_stream,
+)
+
+try:  # hypothesis gates ONLY the property test — parity runs regardless
+    from hypothesis import given, settings, strategies as st
+    HAVE_HYPOTHESIS = True
+except ImportError:  # pragma: no cover - CI installs hypothesis
+    HAVE_HYPOTHESIS = False
+
+NATIVE = native.sketch_available()
+ENGINES = ["numpy"] + (["native"] if NATIVE else [])
+
+
+def cms_ref(keys, vals, valid, conservative, width, depth=2, rounds=1):
+    """Jitted reference: f32 CMS after `rounds` updates."""
+    planes = vals.shape[1]
+    c = cms_ops.cms_init(planes, depth, width)
+    fn = cms_ops.cms_add_conservative if conservative else cms_ops.cms_add
+    for r in range(1, rounds + 1):
+        c = fn(c, jnp.asarray(keys), jnp.asarray(vals * r),
+               jnp.asarray(valid))
+    return np.asarray(c)
+
+
+def cms_host(keys, vals, valid, conservative, width, engine, depth=2,
+             rounds=1):
+    planes = vals.shape[1]
+    c = np.zeros((planes, depth, width), np.uint64)
+    for r in range(1, rounds + 1):
+        if engine == "native":
+            native.hs_cms_update(c, keys, vals * r, valid, conservative,
+                                 threads=4)
+        else:
+            hs_engine.np_cms_update(c, keys[valid], (vals * r)[valid],
+                                    conservative)
+    return c
+
+
+class TestCMSParity:
+    @pytest.mark.parametrize("engine", ENGINES)
+    @pytest.mark.parametrize("conservative", [False, True])
+    def test_narrow_cms_forced_collisions(self, rng, engine, conservative):
+        """Adversarial: width 4 — every key collides with many others in
+        every depth row, the regime where plain-vs-conservative and
+        scatter ordering would diverge if anything were order-sensitive."""
+        n = 300
+        keys = rng.integers(0, 40, size=(n, 3), dtype=np.int64) \
+            .astype(np.uint32)
+        vals = rng.integers(0, 2000, size=(n, 2)).astype(np.float32)
+        valid = rng.random(n) > 0.15
+        # unique keys per call (the cms_add contract: pre-aggregated)
+        keys, idx = np.unique(keys, axis=0, return_index=True)
+        vals, valid = vals[idx], valid[idx]
+        ref = cms_ref(keys, vals, valid, conservative, width=4, rounds=3)
+        got = cms_host(keys, vals, valid, conservative, width=4,
+                       engine=engine, rounds=3)
+        np.testing.assert_array_equal(got.astype(np.float32), ref)
+        # query parity on the updated sketch
+        q_ref = np.asarray(cms_ops.cms_query(jnp.asarray(ref),
+                                             jnp.asarray(keys)))
+        if engine == "native":
+            q = native.hs_cms_query(got, keys, threads=2)
+        else:
+            q = hs_engine.np_cms_query(got, keys)
+        np.testing.assert_array_equal(q, q_ref)
+
+    def test_native_matches_numpy_at_every_thread_count(self, rng):
+        """Thread-count independence: the native engine's documented
+        determinism claim, checked directly."""
+        if not NATIVE:
+            pytest.skip("native hostsketch engine not built")
+        n = 500
+        keys = np.unique(rng.integers(0, 60, size=(n, 4), dtype=np.int64)
+                         .astype(np.uint32), axis=0)
+        vals = rng.integers(0, 999, size=(keys.shape[0], 3)) \
+            .astype(np.float32)
+        for conservative in (False, True):
+            want = None
+            for threads in (1, 2, 5, 8):
+                c = np.zeros((3, 4, 32), np.uint64)
+                native.hs_cms_update(c, keys, vals, None, conservative,
+                                     threads)
+                if want is None:
+                    want = c
+                else:
+                    np.testing.assert_array_equal(c, want)
+
+    def test_degenerate_shapes_rejected(self):
+        if not NATIVE:
+            pytest.skip("native hostsketch engine not built")
+        keys = np.zeros((1, 2), np.uint32)
+        vals = np.ones((1, 1), np.float32)
+        with pytest.raises(ValueError):  # zero-width sketch
+            native.hs_cms_update(np.zeros((1, 1, 0), np.uint64), keys,
+                                 vals, None, True, 1)
+        # n == 0 is a clean no-op, not an error
+        c = np.zeros((1, 2, 8), np.uint64)
+        native.hs_cms_update(c, np.zeros((0, 2), np.uint32),
+                             np.zeros((0, 1), np.float32), None, True, 1)
+        assert c.sum() == 0
+
+
+if HAVE_HYPOTHESIS:
+
+    class TestRandomStreamProperty:
+        @pytest.mark.parametrize("engine", ENGINES)
+        @given(data=st.data())
+        @settings(max_examples=30, deadline=None)
+        def test_random_streams(self, engine, data):
+            """Hypothesis: random key/value/validity streams, both update
+            rules, random narrow widths — host CMS == device CMS
+            bit-exactly (the satellite's random leg; the adversarial legs
+            above run everywhere)."""
+            rng = np.random.default_rng(
+                data.draw(st.integers(0, 2**32 - 1)))
+            n = data.draw(st.integers(1, 120))
+            kw = data.draw(st.integers(1, 5))
+            width = data.draw(st.sampled_from([2, 8, 64, 256]))
+            conservative = data.draw(st.booleans())
+            keys = rng.integers(0, 30, size=(n, kw), dtype=np.int64) \
+                .astype(np.uint32)
+            keys = np.unique(keys, axis=0)
+            m = keys.shape[0]
+            vals = rng.integers(0, 4000, size=(m, 2)).astype(np.float32)
+            valid = rng.random(m) > 0.2
+            ref = cms_ref(keys, vals, valid, conservative, width=width)
+            got = cms_host(keys, vals, valid, conservative, width=width,
+                           engine=engine)
+            np.testing.assert_array_equal(got.astype(np.float32), ref)
+
+
+def merge_ref(tk, tv, ck, cs, ce, cv):
+    nk, nv = topk_ops.topk_merge_est(
+        jnp.asarray(tk), jnp.asarray(tv), jnp.asarray(ck),
+        jnp.asarray(cs), jnp.asarray(ce), jnp.asarray(cv))
+    return np.asarray(nk), np.asarray(nv)
+
+
+class TestTopKMergeParity:
+    def _roundtrip(self, rng, engine, cap, kw, rounds, key_lo, key_hi,
+                   tie_values=False):
+        planes = 3
+        tk0, tv0 = topk_ops.topk_init(cap, kw, planes)
+        rk, rv = np.asarray(tk0), np.asarray(tv0)
+        hk = rk.copy()
+        hv = rv.copy()
+        for _ in range(rounds):
+            m = rng.integers(1, 3 * cap + 2)
+            ck = rng.integers(key_lo, key_hi, size=(m, kw),
+                              dtype=np.int64).astype(np.uint32)
+            ck = np.unique(ck, axis=0)
+            m = ck.shape[0]
+            if tie_values:
+                # eviction-boundary adversary: many equal primaries so
+                # survival at rank C is decided purely by the tie-break
+                cs = np.full((m, planes), 7.0, np.float32)
+                ce = np.full((m, planes), 7.0, np.float32)
+            else:
+                cs = rng.integers(0, 500, size=(m, planes)) \
+                    .astype(np.float32)
+                ce = cs + rng.integers(0, 90, size=(m, planes)) \
+                    .astype(np.float32)
+            cv = rng.random(m) > 0.2
+            rk, rv = merge_ref(rk, rv, ck, cs, ce, cv)
+            if engine == "native":
+                native.hs_topk_merge(hk, hv, ck, cs, ce, cv)
+            else:
+                hk, hv = hs_engine.np_topk_merge(hk, hv, ck[cv], cs[cv],
+                                                 ce[cv])
+        np.testing.assert_array_equal(hk, rk)
+        np.testing.assert_array_equal(hv, rv)
+
+    @pytest.mark.parametrize("engine", ENGINES)
+    def test_random_rounds(self, rng, engine):
+        self._roundtrip(rng, engine, cap=16, kw=3, rounds=8,
+                        key_lo=0, key_hi=10)
+
+    @pytest.mark.parametrize("engine", ENGINES)
+    def test_eviction_boundary_ties(self, rng, engine):
+        """All-equal primaries: which keys hold the last table slots is
+        pure tie-break (lex order through the stable rank) — the case a
+        sloppy reimplementation gets wrong first."""
+        self._roundtrip(rng, engine, cap=8, kw=2, rounds=6,
+                        key_lo=0, key_hi=6, tie_values=True)
+
+    @pytest.mark.parametrize("engine", ENGINES)
+    def test_capacity_one_table(self, rng, engine):
+        self._roundtrip(rng, engine, cap=1, kw=2, rounds=5,
+                        key_lo=0, key_hi=4)
+
+    @pytest.mark.parametrize("engine", ENGINES)
+    def test_all_sentinel_key_dropped(self, engine):
+        """The all-1s key tuple marks empty slots and is unrepresentable;
+        both backends must drop it from candidates identically."""
+        cap, kw, planes = 4, 2, 2
+        tk, tv = (np.asarray(a) for a in topk_ops.topk_init(cap, kw,
+                                                            planes))
+        ck = np.array([[0xFFFFFFFF, 0xFFFFFFFF], [1, 2]], np.uint32)
+        cs = np.array([[9.0, 1.0], [5.0, 1.0]], np.float32)
+        cv = np.ones(2, bool)
+        rk, rv = merge_ref(tk, tv, ck, cs, cs, cv)
+        hk, hv = tk.copy(), tv.copy()
+        if engine == "native":
+            native.hs_topk_merge(hk, hv, ck, cs, cs, cv)
+        else:
+            hk, hv = hs_engine.np_topk_merge(hk, hv, ck, cs, cs)
+        np.testing.assert_array_equal(hk, rk)
+        np.testing.assert_array_equal(hv, rv)
+
+
+class TestApplyGroupedParity:
+    """The full per-family step (CMS -> prefilter -> admission merge)
+    vs models.heavy_hitter._apply_grouped, padded shapes included."""
+
+    @pytest.mark.parametrize("engine", ENGINES)
+    @pytest.mark.parametrize("admission", ["est", "plain"])
+    @pytest.mark.parametrize("prefilter", [True, False])
+    def test_grouped_step(self, rng, engine, admission, prefilter):
+        cfg = HeavyHitterConfig(
+            key_cols=("src_addr", "dst_addr"), width=256, depth=3,
+            capacity=8, batch_size=BS, table_prefilter=prefilter,
+            table_admission=admission)
+        eng = hs_engine.HostSketchEngine(
+            [cfg], use_native=engine)
+        state = hh_init(cfg)
+        for _ in range(4):
+            b = 64  # padded group-table size > 2*capacity: prefilter arms
+            g = int(rng.integers(1, b + 1))
+            uniq = np.zeros((b, 8), np.uint32)
+            uniq[:g] = np.unique(
+                rng.integers(0, 9, size=(b, 8), dtype=np.int64),
+                axis=0)[:g].astype(np.uint32)
+            g = len(np.unique(uniq[:g], axis=0))
+            uniq[:g] = np.unique(uniq[:g], axis=0)
+            sums = np.zeros((b, 3), np.float32)
+            sums[:g] = rng.integers(0, 300, size=(g, 3))
+            valid = np.zeros(b, bool)
+            valid[:g] = True
+            state = _apply_grouped(state, jnp.asarray(uniq),
+                                   jnp.asarray(sums), jnp.asarray(valid),
+                                   cfg)
+            eng.update(0, uniq, sums, g)
+        host = eng.export_state(0)
+        np.testing.assert_array_equal(host.cms, np.asarray(state.cms))
+        np.testing.assert_array_equal(host.table_keys,
+                                      np.asarray(state.table_keys))
+        np.testing.assert_array_equal(host.table_vals,
+                                      np.asarray(state.table_vals))
+
+
+class TestStateRoundTrip:
+    def test_device_host_device_lossless(self, rng):
+        cfg = HeavyHitterConfig(key_cols=("src_addr",), width=64,
+                                capacity=4, batch_size=BS)
+        state = hh_init(cfg)
+        uniq = rng.integers(0, 50, size=(16, 4), dtype=np.int64) \
+            .astype(np.uint32)
+        uniq = np.unique(uniq, axis=0)
+        sums = rng.integers(1, 100, size=(uniq.shape[0], 3)) \
+            .astype(np.float32)
+        state = _apply_grouped(state, jnp.asarray(uniq),
+                               jnp.asarray(sums),
+                               jnp.ones(uniq.shape[0], bool), cfg)
+        back = to_device_state(from_device_state(state))
+        np.testing.assert_array_equal(back.cms, np.asarray(state.cms))
+        np.testing.assert_array_equal(back.table_keys,
+                                      np.asarray(state.table_keys))
+        np.testing.assert_array_equal(back.table_vals,
+                                      np.asarray(state.table_vals))
+
+    def test_import_clamps_out_of_envelope(self):
+        st_dict = {
+            "cms": np.array([[[np.inf, -3.0, np.nan, 5.0]]], np.float32),
+            "table_keys": np.zeros((1, 1), np.uint32),
+            "table_vals": np.zeros((1, 1), np.float32),
+        }
+        host = from_device_state(st_dict)
+        assert host.cms[0, 0, 1] == 0 and host.cms[0, 0, 2] == 0
+        assert host.cms[0, 0, 3] == 5
+        assert host.cms[0, 0, 0] > np.uint64(1) << np.uint64(60)
+
+
+def drive(pipeline_cls, models, batches, **kw):
+    pipe = pipeline_cls(models, **kw)
+    for b in batches:
+        pipe.update(b)
+    if hasattr(pipe, "sync_states"):
+        pipe.sync_states()
+    return models
+
+
+class TestPipelineParity:
+    @pytest.mark.parametrize("engine", ENGINES)
+    def test_bit_exact_vs_hostgrouped(self, engine):
+        """The full model family on the shared fused-test stream (window
+        rolls + late rows): flows_5m, every sketch family, dense ports,
+        DDoS — all bit-identical to the device-backend pipeline."""
+        batches = make_stream()
+        dev = drive(HostGroupPipeline, make_models(WINDOW, 100), batches)
+        host = drive(HostSketchPipeline, make_models(WINDOW, 100),
+                     batches, sketch_native=engine)
+        assert canon_rows(dev["flows_5m"].flush(True)) == \
+            canon_rows(host["flows_5m"].flush(True))
+        for name in ("top_talkers", "top_src_ips", "top_dst_ips",
+                     "top_src_ports"):
+            assert_same_windows(dev[name].flush(True),
+                                host[name].flush(True))
+            assert dev[name].late_flows_dropped == \
+                host[name].late_flows_dropped
+        fa, ha = dev["ddos_alerts"], host["ddos_alerts"]
+        assert fa.late_flows_dropped == ha.late_flows_dropped
+        assert len(fa.alerts) == len(ha.alerts)
+        for x, y in zip(fa.alerts, ha.alerts):
+            assert x.keys() == y.keys()
+            for k in x:
+                np.testing.assert_array_equal(np.asarray(x[k]),
+                                              np.asarray(y[k]))
+
+    def test_engine_rejects_unknown_mode(self):
+        with pytest.raises(ValueError, match="use_native"):
+            hs_engine.HostSketchEngine([], use_native="fast")
+
+
+class CollectSink:
+    def __init__(self):
+        self.rows: dict[str, list] = {}
+
+    def write(self, table, rows):
+        self.rows.setdefault(table, []).append(rows)
+
+
+def _canon_table(chunks) -> list:
+    out = []
+    for rows in chunks:
+        if isinstance(rows, dict):
+            out.extend(canon_rows(rows))
+        else:  # list of alert dicts
+            out.extend(tuple(sorted((k, str(v)) for k, v in r.items()))
+                       for r in rows)
+    return sorted(out)
+
+
+def _run_worker(backend, batches, ckpt=None, snapshot_every=0,
+                restore=False):
+    bus = InProcessBus()
+    bus.create_topic("flows", 1)
+    for b in batches:
+        for frame in wire.iter_raw_frames(b.to_wire()):
+            bus.produce("flows", frame)
+    sink = CollectSink()
+    worker = StreamWorker(
+        Consumer(bus, fixedlen=True), make_models(WINDOW, 100), [sink],
+        WorkerConfig(poll_max=BS, snapshot_every=snapshot_every,
+                     checkpoint_path=ckpt, sketch_backend=backend),
+    )
+    if restore:
+        assert worker.restore()
+    worker.run(stop_when_idle=True)
+    return worker, sink
+
+
+class TestWorkerIntegration:
+    def test_worker_sink_rows_device_vs_host(self):
+        batches = make_stream()
+        _, dev = _run_worker("device", batches)
+        worker, host = _run_worker("host", batches)
+        assert isinstance(worker.fused, HostSketchPipeline)
+        assert set(dev.rows) == set(host.rows)
+        for table in dev.rows:
+            assert _canon_table(dev.rows[table]) == \
+                _canon_table(host.rows[table]), f"table {table} diverged"
+
+    def test_host_backend_needs_host_grouping(self):
+        """host_assist off -> the host engine has no group tables to
+        consume; the worker must fall back to the device step loudly,
+        not crash or silently change semantics."""
+        worker = StreamWorker(
+            None, make_models(WINDOW, 100), [],
+            WorkerConfig(sketch_backend="host", host_assist="off"))
+        assert isinstance(worker.fused, FusedPipeline)
+        assert not isinstance(worker.fused, HostGroupPipeline)
+
+    def test_bad_backend_rejected(self):
+        with pytest.raises(ValueError, match="sketch_backend"):
+            StreamWorker(None, {}, [],
+                         WorkerConfig(sketch_backend="gpu"))
+
+    def test_open_window_topk_after_sync(self):
+        """The live query path: mid-window (nothing closed or finalized
+        yet) the host backend's model state is engine-resident; after
+        sync_sketch_states() the open-window top-K must equal the device
+        backend's bit-for-bit (what /topk serves)."""
+        batches = make_stream()[:3]  # one open slot, no closes
+        tops = {}
+        for backend in ("device", "host"):
+            bus = InProcessBus()
+            bus.create_topic("flows", 1)
+            for b in batches:
+                for frame in wire.iter_raw_frames(b.to_wire()):
+                    bus.produce("flows", frame)
+            worker = StreamWorker(
+                Consumer(bus, fixedlen=True), make_models(WINDOW, 100),
+                [],
+                WorkerConfig(poll_max=BS, snapshot_every=0,
+                             sketch_backend=backend,
+                             ingest_mode="serial", prefetch=0),
+            )
+            while worker.run_once():  # drive WITHOUT finalize: the
+                pass                  # window stays open, sketch live
+            with worker.lock:
+                worker.sync_sketch_states()
+                tops[backend] = worker.models["top_talkers"].model.top(20)
+        for k in tops["device"]:
+            np.testing.assert_array_equal(
+                np.asarray(tops["device"][k]), np.asarray(tops["host"][k]),
+                err_msg=f"topk column {k!r}")
+
+    @pytest.mark.parametrize("first,second", [("device", "host"),
+                                              ("host", "device")])
+    def test_checkpoint_backend_switch(self, tmp_path, first, second):
+        """Snapshot under one backend, restore under the other, finish
+        the stream: final sink rows must equal an unswitched run — the
+        state conversions are lossless, so a backend switch at restore
+        is invisible downstream."""
+        batches = make_stream()
+        ck = str(tmp_path / "ck")
+        # reference: the whole stream under the FIRST backend, split into
+        # the same two worker lifetimes (finalize force-flushes tails, so
+        # the split itself must match — only the backend may differ)
+        _, ref1 = _run_worker(first, batches[:4], ckpt=str(
+            tmp_path / "ck_ref"), snapshot_every=1)
+        _, ref2 = _run_worker(first, batches[4:], ckpt=str(
+            tmp_path / "ck_ref"), restore=True)
+        # switched: same split, second half under the OTHER backend
+        _, got1 = _run_worker(first, batches[:4], ckpt=ck,
+                              snapshot_every=1)
+        _, got2 = _run_worker(second, batches[4:], ckpt=ck, restore=True)
+        for ref, got in ((ref1, got1), (ref2, got2)):
+            assert set(ref.rows) == set(got.rows)
+            for table in ref.rows:
+                assert _canon_table(ref.rows[table]) == \
+                    _canon_table(got.rows[table]), \
+                    f"{first}->{second}: table {table} diverged"

@@ -1,8 +1,10 @@
 """Multithreaded sanitizer stress driver for libflowdecode.
 
-Hammers ``flow_decode_stream`` + ``flow_hash_group`` (and the encoder)
-from N threads with valid, truncated, and adversarial buffers, intended
-to run against the ASan+UBSan and TSan builds:
+Hammers ``flow_decode_stream`` + ``flow_hash_group`` (and the encoder),
+plus the hostsketch engine (``hs_cms_update`` / ``hs_cms_query`` /
+``hs_hh_prefilter`` / ``hs_topk_merge``), from N threads with valid,
+truncated, and adversarial buffers, intended to run against the
+ASan+UBSan and TSan builds:
 
     make -C native san
     python tools/flowlint/native_stress.py --mode san
@@ -28,7 +30,12 @@ Workload per thread and why:
   types: the -1-errpos paths must fail cleanly, never read past ``len``;
 - addresses longer than 16 bytes (the trailing-16 clamp in put_addr);
 - flow_hash_group over random/duplicate/empty lanes at several widths,
-  checked against a numpy reference permutation-sum invariant.
+  checked against a numpy reference permutation-sum invariant;
+- hostsketch: per-thread sketches updated at several internal thread
+  counts (the engine spawns its own workers — sanitizers see nested
+  threading), degenerate shapes (zero-width CMS rejected cleanly, n=0
+  no-ops, 1-lane and 11-lane keys, capacity-1 tables), results checked
+  against the single-threaded numpy twin every iteration.
 
 Exit 0 = clean run; prints one JSON summary line.
 """
@@ -156,8 +163,85 @@ def _thread_work(native, tid: int, iters: int, batch, data: bytes,
             enc = native.encode_stream(sl)
             back = native.decode_stream(enc)
             assert len(back) == len(sl)
+            # 6) hostsketch engine (its kernels spawn their OWN worker
+            #    threads — nested threading under the sanitizer)
+            if native.sketch_available():
+                _sketch_work(native, rng, it)
     except Exception as e:  # noqa: BLE001 — collected for the exit code
         errors.append(f"thread {tid}: {type(e).__name__}: {e}")
+
+
+def _sketch_work(native, rng, it: int) -> None:
+    """One hostsketch stress round on thread-private state.
+
+    Determinism is the oracle: every call is repeated at several internal
+    thread counts and must produce identical bytes (u64 addition is
+    associative; conservative targets read the pre-update sketch), plus
+    mass/shape invariants that catch out-of-bounds writes the sanitizers
+    might attribute elsewhere. Degenerate shapes (zero-width CMS, n=0,
+    capacity-1 tables, 1- and 11-lane keys) ride every iteration."""
+    import numpy as np
+
+    planes, depth = 3, 4
+    kw = (1, 4, 11)[it % 3]
+    width = (1, 8, 4096)[it % 3]  # width 1: every key collides
+    n = int(rng.integers(0, 700))
+    keys = np.unique(
+        rng.integers(0, 1 << 12, size=(n, kw), dtype=np.uint32), axis=0)
+    m = keys.shape[0]
+    vals = rng.integers(0, 1500, size=(m, planes)).astype(np.float32)
+    valid = rng.random(m) > 0.2
+    for conservative in (False, True):
+        sketches = []
+        for threads in (1, 2, 8):
+            cms = np.zeros((planes, depth, width), np.uint64)
+            native.hs_cms_update(cms, keys, vals, valid, conservative,
+                                 threads)
+            sketches.append(cms)
+        assert all(np.array_equal(s, sketches[0]) for s in sketches[1:]), \
+            f"thread-count nondeterminism (conservative={conservative})"
+        if not conservative:
+            # linear update: each (plane, depth) row holds exactly the
+            # total addend mass — any lost/duplicated scatter shows here
+            want = vals[valid].astype(np.uint64).sum(axis=0)
+            got = sketches[0].sum(axis=2)
+            assert np.array_equal(got, np.broadcast_to(
+                want[:, None], (planes, depth))), "linear mass mismatch"
+        est = [native.hs_cms_query(sketches[0], keys, threads=t)
+               for t in (1, 8)]
+        assert np.array_equal(est[0], est[1]), "query nondeterminism"
+    # zero-width sketch must be REJECTED, never written
+    try:
+        native.hs_cms_update(np.zeros((1, 1, 0), np.uint64),
+                             np.zeros((1, 1), np.uint32),
+                             np.ones((1, 1), np.float32), None, True, 2)
+        raise AssertionError("zero-width CMS accepted")
+    except ValueError:
+        pass
+    # prefilter: selection must be unique in-range indices, stable
+    # across internal thread counts
+    cap = (1, 8)[it % 2]
+    table_keys = np.full((cap, kw), 0xFFFFFFFF, np.uint32)
+    table_vals = np.zeros((cap, planes), np.float32)
+    if m:
+        sel1 = native.hs_hh_prefilter(table_keys, keys, vals, threads=1)
+        sel8 = native.hs_hh_prefilter(table_keys, keys, vals, threads=8)
+        assert np.array_equal(sel1, sel8), "prefilter nondeterminism"
+        assert len(sel1) == min(m, 2 * cap)
+        assert len(np.unique(sel1)) == len(sel1)
+        assert sel1.min() >= 0 and sel1.max() < m
+    # admission merges into a capacity-`cap` table: ranked descending,
+    # no duplicate real keys, sentinel padding after `real` rows
+    for _ in range(3):
+        real = native.hs_topk_merge(table_keys, table_vals, keys, vals,
+                                    vals, valid)
+        assert 0 <= real <= cap
+        assert (table_vals[:max(real - 1, 0), 0]
+                >= table_vals[1:real, 0]).all(), "table not ranked"
+        if real:
+            rows = table_keys[:real]
+            assert len(np.unique(rows, axis=0)) == real, "dup table keys"
+        assert (table_keys[real:] == 0xFFFFFFFF).all()
 
 
 def main(argv=None) -> int:
