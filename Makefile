@@ -17,9 +17,11 @@ test:
 bench:
 	python bench.py
 
-# Static analysis (tools/flowlint): jit-purity, uint64 discipline, lock
-# annotations, flag registry. Dependency-free (stdlib ast only); exits
-# nonzero on any finding. docs/STATIC_ANALYSIS.md describes the rules.
+# Static analysis (tools/flowlint): jit-purity, uint64 dtype-flow, lock
+# annotations, lock-order cycles, flag registry, ctypes<->C ABI
+# contract. Dependency-free (stdlib ast + a tiny C declaration parser);
+# exits nonzero on any finding. docs/STATIC_ANALYSIS.md has the rules;
+# `python -m tools.flowlint --json` for machine-readable output.
 lint:
 	python -m tools.flowlint
 

@@ -23,6 +23,13 @@ from __future__ import annotations
 # flowlint: uint64-exact
 # (counter arithmetic must stay exact unsigned; the f32 casts below are
 # the DEVICE layout's own value planes, mirrored bit-for-bit)
+# flowlint: lock-checked
+# (the engine has no lock of its own BY CONTRACT: every mutation —
+# reset/import/export/update, including the per-family `states[i]`
+# stores — runs on the worker thread under worker.lock, driven by
+# HostSketchPipeline. The annotations below make that single-writer
+# story machine-checked; the native kernels join before returning, so
+# no engine state is visible to their worker threads)
 
 import os
 
@@ -194,6 +201,7 @@ class HostSketchEngine:
         # cores, capped at 4, floor 1; operators with wide hosts can
         # pass an explicit count.
         self.threads = threads or max(1, min(4, (os.cpu_count() or 1) // 2))
+        # flowlint: unguarded -- worker thread only (pipeline drives reset/import/update/export under worker.lock)
         self.states: list[HostHHState | None] = [None] * len(self.configs)
         for cfg in self.configs:
             if cfg.table_admission not in ("est", "plain"):

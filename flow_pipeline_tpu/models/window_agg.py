@@ -311,7 +311,8 @@ class WindowAggregator:
         nvals = len(self.config.value_cols)
         vals = np.empty((n, nvals + 1), dtype=np.uint64)
         for j in range(nvals):
-            vals[:, j] = plane_sums[:, 2 * j] + (plane_sums[:, 2 * j + 1] << 16)
+            vals[:, j] = plane_sums[:, 2 * j] + (
+                plane_sums[:, 2 * j + 1] << np.uint64(16))
         vals[:, nvals] = counts
         self._fold_rows(keys, vals)
 

@@ -16,7 +16,9 @@ from tools.flowlint.runner import run_lint  # noqa: E402
 
 
 def _lint(tmp_path, source: str, name: str = "fix.py", rules=None):
-    (tmp_path / name).write_text(textwrap.dedent(source))
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
     return run_lint(str(tmp_path), [name], rules)
 
 
@@ -199,6 +201,26 @@ class TestLockDiscipline:
 
                 def bad(self):
                     self._n += 1
+        """)
+        assert _rules(out) == ["lock-discipline"]
+        assert "outside" in out[0].message
+
+    def test_guarded_write_in_match_case_enforced(self, tmp_path):
+        # `match` case bodies are walked like `if` branches
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import threading
+
+            class Box:
+                def __init__(self):
+                    # flowlint: unguarded -- the lock itself
+                    self._lock = threading.Lock()
+                    self._n = 0  # guarded-by: _lock
+
+                def bad(self, mode):
+                    match mode:
+                        case "bump":
+                            self._n += 1
         """)
         assert _rules(out) == ["lock-discipline"]
         assert "outside" in out[0].message
@@ -407,6 +429,1064 @@ class TestFlagRegistry:
         out = run_lint(str(tmp_path), [reg])
         assert any("secret.knob" in f.message and "not mentioned" in f.message
                    for f in out)
+
+
+class TestDtypeFlow:
+    """v2 uint64-discipline: the flow-sensitive dtype interpreter."""
+
+    def test_uint64_pyint_promotion_flagged(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f():
+                c = np.zeros(4, np.uint64)
+                total = c.sum()
+                return total + 1
+        """, rules=("uint64-discipline",))
+        assert len(out) == 1
+        assert "promote to float64" in out[0].message
+        assert "np.uint64(" in out[0].message
+        # the finding carries the inferred dtype chain as evidence
+        assert "dtype chain" in out[0].message
+        assert "np.zeros" in out[0].message or "total" in out[0].message
+
+    def test_wrapped_constant_clean(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f():
+                c = np.zeros(4, np.uint64)
+                shifted = (c >> np.uint64(16)) | (c << np.uint64(48))
+                return c.sum() + np.uint64(1) + shifted[0]
+        """, rules=("uint64-discipline",))
+        assert _rules(out) == []
+
+    def test_true_division_flagged(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f():
+                c = np.zeros(4, np.uint64)
+                return c / np.uint64(2)
+        """, rules=("uint64-discipline",))
+        assert len(out) == 1
+        assert "division" in out[0].message
+
+    def test_float_mixing_flagged(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f():
+                c = np.zeros(4, np.uint64)
+                scale = np.float32(0.5)
+                return c * scale
+        """, rules=("uint64-discipline",))
+        assert len(out) == 1
+        assert "promotion out of the unsigned envelope" in out[0].message
+
+    def test_uint32_pyint_leaves_wraparound_envelope(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f():
+                h = np.full(8, 7, np.uint32)
+                return h * 5
+        """, rules=("uint64-discipline",))
+        assert len(out) == 1
+        assert "wraparound envelope" in out[0].message
+
+    def test_ops_scope_checked_without_marker(self, tmp_path):
+        # ops/ and hostsketch/ modules get promotion checks even
+        # unmarked — but NOT the strict dtype-less-constructor checks
+        out = _lint(tmp_path, """
+            import numpy as np
+
+            def f():
+                lax = np.zeros(4)          # dtype-less: ok here
+                c = np.zeros(4, np.uint64)
+                return c + 1, lax
+        """, name="flow_pipeline_tpu/ops/fix.py",
+            rules=("uint64-discipline",))
+        assert len(out) == 1
+        assert "promote to float64" in out[0].message
+
+    def test_jnp_weak_typing_exempt(self, tmp_path):
+        # JAX keeps the array dtype for python-int operands (weak
+        # typing); only numpy's scalar rules promote
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import jax.numpy as jnp
+
+            def f(x):
+                h = x.astype(jnp.uint32)
+                return h ^ (h >> 16)
+        """, rules=("uint64-discipline",))
+        assert _rules(out) == []
+
+    def test_param_shadowing_module_global_not_guessed(self, tmp_path):
+        # a parameter shadows a module-level uint64 constant: callers
+        # may pass anything, so the interpreter must not inherit the
+        # global's dtype — under-approximate, never guess
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            MASK = np.uint64(0xFF)
+
+            def f(MASK):
+                return MASK + 1
+        """, rules=("uint64-discipline",))
+        assert _rules(out) == []
+
+    def test_class_level_dtypeless_constructor_flagged(self, tmp_path):
+        # class-body statements execute at definition time; a platform-
+        # default-dtype table at class scope is still a finding
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            class C:
+                TABLE = np.array([1, 2, 3])
+        """, rules=("uint64-discipline",))
+        assert len(out) == 1
+        assert "without an explicit dtype" in out[0].message
+
+    def test_yield_fstring_and_subscript_index_scanned(self, tmp_path):
+        # expressions the statement driver reaches only through yield,
+        # f-strings, or an assignment target's index are still scanned
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def gen():
+                yield np.zeros(3)
+
+            def fmt():
+                return f"{np.zeros(4)}"
+
+            def store(d, v):
+                d[np.int64(v)] = 0
+        """, rules=("uint64-discipline",))
+        assert len(out) == 3
+        msgs = " ".join(f.message for f in out)
+        assert "without an explicit dtype" in msgs
+        assert "signed scalar constructor" in msgs
+
+    def test_walrus_assignment_tracked(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f():
+                c = np.zeros(4, np.uint64)
+                if (total := c.sum() + 1) > 0:
+                    return total
+                return None
+        """, rules=("uint64-discipline",))
+        assert len(out) == 1
+        assert "uint64 +" in out[0].message
+
+    def test_match_case_bodies_interpreted(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f(mode):
+                c = np.zeros(4, np.uint64)
+                match mode:
+                    case "bump":
+                        return c + 1
+                    case _:
+                        return c
+        """, rules=("uint64-discipline",))
+        assert len(out) == 1
+        assert "uint64 +" in out[0].message
+
+    def test_decorator_expressions_scanned(self, tmp_path):
+        # decorators evaluate at definition time in the enclosing scope
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def deco(table):
+                def wrap(fn):
+                    return fn
+                return wrap
+
+            @deco(np.zeros(3))
+            def f():
+                return 0
+        """, rules=("uint64-discipline",))
+        assert len(out) == 1
+        assert "without an explicit dtype" in out[0].message
+
+    def test_propagation_through_branches_and_calls(self, tmp_path):
+        # dtype survives if/else when both branches agree; np.where and
+        # astype propagate; the flag fires far from the construction
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f(cond, raw):
+                if cond:
+                    c = np.asarray(raw, dtype=np.uint64)
+                else:
+                    c = np.zeros(3, np.uint64)
+                picked = np.where(cond, c, np.uint64(0))
+                return picked - 1
+        """, rules=("uint64-discipline",))
+        assert len(out) == 1
+        assert out[0].line == 11  # the `return picked - 1` line
+        assert "np.asarray" in out[0].message  # chain reaches back
+
+    def test_comprehension_lambda_and_default_bodies_scanned(self, tmp_path):
+        # the v1 ast.walk checks must survive the move to an
+        # interpreter: constructors inside comprehensions, lambdas, and
+        # default-arg expressions are still findings
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f(vals, fill=np.zeros(2)):
+                planes = [np.zeros(4) for _ in range(3)]
+                sig = [np.int64(v) for v in vals]
+                g = lambda v: np.array([v])
+                return planes, sig, g, fill
+        """, rules=("uint64-discipline",))
+        assert _rules(out) == ["uint64-discipline"] * 4
+
+    def test_suppression_still_works(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f():
+                c = np.zeros(4, np.uint64)
+                # flowlint: disable=uint64-discipline -- bounded by caller, exact below 2^53
+                return c.sum() + 1
+        """, rules=("uint64-discipline",))
+        assert _rules(out) == []
+
+
+class TestLockOrder:
+    def test_two_lock_cycle_flagged(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """, rules=("lock-order",))
+        assert len(out) == 1
+        assert "lock-order cycle" in out[0].message
+        assert "Box._a" in out[0].message and "Box._b" in out[0].message
+
+    def test_multi_item_with_cycle_flagged(self, tmp_path):
+        # `with a, b:` acquires left to right — the same deadlock as
+        # nested withs, and the same finding
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a, self._b:
+                        pass
+
+                def two(self):
+                    with self._b, self._a:
+                        pass
+        """, rules=("lock-order",))
+        assert len(out) == 1
+        assert "lock-order cycle" in out[0].message
+
+    def test_consistent_order_clean(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """, rules=("lock-order",))
+        assert _rules(out) == []
+
+    def test_nested_def_not_attributed_to_encloser(self, tmp_path):
+        # defining a callback is not running it: schedule() never
+        # sleeps, so calling it under a lock is not blocking-while-
+        # holding (same for lambda bodies)
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import time
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def schedule(self):
+                    def cb():
+                        time.sleep(1)
+                    slow = lambda: time.sleep(2)
+                    return cb, slow
+
+                def outer(self):
+                    with self._lock:
+                        return self.schedule()
+        """, rules=("lock-order", "lock-discipline"))
+        assert _rules(out) == []
+
+    def test_same_named_classes_not_unified(self, tmp_path):
+        # two unrelated classes that happen to share a name must not
+        # have their locks merged into a phantom deadlock cycle
+        m1 = textwrap.dedent("""
+            # flowlint: lock-checked
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def go(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """)
+        m2 = m1.replace("with self._a:", "with self._X:").replace(
+            "with self._b:", "with self._a:").replace(
+            "with self._X:", "with self._b:")
+        (tmp_path / "m1.py").write_text(m1)
+        (tmp_path / "m2.py").write_text(m2)
+        out = run_lint(str(tmp_path), ["m1.py", "m2.py"],
+                       rules=("lock-order",))
+        assert out == []
+
+    def test_cycle_witness_reports_only_real_edges(self, tmp_path):
+        # a<->b and b<->c form one SCC, but there is NO c -> a edge:
+        # the reported witness path must not fabricate one (it would
+        # send the maintainer to reorder an acquisition no code does)
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self._c = threading.Lock()
+
+                def ab(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def ba(self):
+                    with self._b:
+                        with self._a:
+                            pass
+
+                def bc(self):
+                    with self._b:
+                        with self._c:
+                            pass
+
+                def cb(self):
+                    with self._c:
+                        with self._b:
+                            pass
+        """, rules=("lock-order",))
+        assert len(out) == 1
+        assert "fix.Box._a -> fix.Box._b -> fix.Box._a" in out[0].message
+        assert "_c -> fix.Box._a" not in out[0].message
+
+    def test_match_case_bodies_walked(self, tmp_path):
+        # acquisitions and blocking calls inside `match` case bodies
+        # must be as visible as inside `if` branches
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import time
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self, mode):
+                    with self._a:
+                        match mode:
+                            case "x":
+                                with self._b:
+                                    self.slow()
+
+                def slow(self):
+                    time.sleep(1)
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """, rules=("lock-order",))
+        msgs = " ".join(f.message for f in out)
+        assert "lock-order cycle" in msgs
+        assert "slow()" in msgs and "time.sleep" in msgs
+
+    def test_interprocedural_cycle_through_calls(self, tmp_path):
+        # the cycle only exists composed with the call graph: each
+        # method nests ONE with, the second lock comes from the callee
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        self.grab_b()
+
+                def grab_b(self):
+                    with self._b:
+                        pass
+
+                def two(self):
+                    with self._b:
+                        self.grab_a()
+
+                def grab_a(self):
+                    with self._a:
+                        pass
+        """, rules=("lock-order",))
+        assert any("lock-order cycle" in f.message for f in out)
+
+    def test_interprocedural_blocking_while_holding(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import threading, time
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def helper(self):
+                    time.sleep(1)
+
+                def outer(self):
+                    with self._lock:
+                        self.helper()
+        """, rules=("lock-order",))
+        assert len(out) == 1
+        assert "eventually blocks" in out[0].message
+        assert "time.sleep" in out[0].message
+
+    def test_cv_wait_exemption_kept(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def drain(self):
+                    with self._cv:
+                        self._cv.wait_for(lambda: True, 5)
+
+                def caller(self):
+                    self.drain()
+        """, rules=("lock-order",))
+        assert _rules(out) == []
+
+    def test_plain_lock_self_deadlock_flagged(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._m = threading.Lock()
+
+                def a(self):
+                    with self._m:
+                        self.b()
+
+                def b(self):
+                    with self._m:
+                        pass
+        """, rules=("lock-order",))
+        assert len(out) == 1
+        assert "fix.Box._m -> fix.Box._m" in out[0].message
+
+    def test_reentrant_lock_self_reentry_allowed(self, tmp_path):
+        # bus.InProcessBus.produce -> create_topic under the same RLock
+        # is the sanctioned pattern; Condition wraps an RLock too
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._m = threading.RLock()
+
+                def a(self):
+                    with self._m:
+                        self.b()
+
+                def b(self):
+                    with self._m:
+                        pass
+        """, rules=("lock-order",))
+        assert _rules(out) == []
+
+    def test_cross_class_edge_via_constructed_attr(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import threading, time
+
+            class Inner:
+                def __init__(self):
+                    self._il = threading.Lock()
+
+                def poke(self):
+                    with self._il:
+                        time.sleep(0.1)
+
+            class Outer:
+                def __init__(self):
+                    self._ol = threading.Lock()
+                    self._inner = Inner()
+
+                def a(self):
+                    with self._ol:
+                        self._inner.poke()
+        """, rules=("lock-order",))
+        # no cycle — but the blocking call inside Inner.poke is seen
+        # from Outer.a through the constructor-typed attribute
+        assert len(out) == 1
+        assert "eventually blocks" in out[0].message
+
+
+class TestLockDisciplineSubscript:
+    def test_subscript_store_needs_annotation(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            class Box:
+                def __init__(self):
+                    self._states = [None]
+
+                def reset(self, i):
+                    self._states[i] = object()
+        """, rules=("lock-discipline",))
+        assert len(out) == 1
+        assert "undeclared attribute" in out[0].message
+
+    def test_annotated_subscript_store_passes(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            class Box:
+                def __init__(self):
+                    # flowlint: unguarded -- worker thread only
+                    self._states = [None]
+
+                def reset(self, i):
+                    self._states[i] = object()
+        """, rules=("lock-discipline",))
+        assert _rules(out) == []
+
+    def test_guarded_subscript_store_enforced(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import threading
+
+            class Box:
+                def __init__(self):
+                    # flowlint: unguarded -- the lock itself
+                    self._lock = threading.Lock()
+                    self._commits = {}  # guarded-by: _lock
+
+                def good(self, k, v):
+                    with self._lock:
+                        self._commits[k] = v
+
+                def bad(self, k, v):
+                    self._commits[k] = v
+        """, rules=("lock-discipline",))
+        assert _rules(out) == ["lock-discipline"]
+        assert "outside" in out[0].message
+
+
+_ABI_CC = """
+#include <stdint.h>
+
+extern "C" {
+
+// sums n uint32s, scaled
+long long fd_sum(const uint32_t* data, long long n, int scale) {
+  long long out = 0;
+  for (long long i = 0; i < n; ++i) { out += data[i] * scale; }
+  return out;
+}
+
+long long fd_scan(const uint8_t* buf, long long n, float* out) {
+  if (n > 0) { out[0] = 1.0f; }
+  return n;
+}
+
+}  // extern "C"
+"""
+
+_ABI_BINDER_OK = """
+import ctypes
+import numpy as np
+
+
+def _c_arr(a):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def _bind(lib):
+    lib.fd_sum.restype = ctypes.c_longlong
+    lib.fd_sum.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_longlong,
+        ctypes.c_int,
+    ]
+    lib.fd_scan.restype = ctypes.c_longlong
+    lib.fd_scan.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    return lib
+
+
+def call(lib, xs):
+    xs = np.ascontiguousarray(xs, dtype=np.uint32)
+    return lib.fd_sum(_c_arr(xs), len(xs), 1)
+"""
+
+
+class TestAbiContract:
+    def _setup(self, tmp_path, cc=_ABI_CC, binder=_ABI_BINDER_OK):
+        (tmp_path / "native").mkdir(exist_ok=True)
+        (tmp_path / "native" / "fake.cc").write_text(cc)
+        (tmp_path / "binder.py").write_text(textwrap.dedent(binder))
+        return run_lint(str(tmp_path), ["binder.py"],
+                        rules=("abi-contract",))
+
+    def test_matching_binder_clean(self, tmp_path):
+        assert self._setup(tmp_path) == []
+
+    def test_arity_mismatch_flagged(self, tmp_path):
+        out = self._setup(tmp_path, binder=_ABI_BINDER_OK.replace(
+            "        ctypes.c_int,\n", ""))
+        assert len(out) == 1
+        assert "declares 2 parameter(s)" in out[0].message
+        assert "fd_sum" in out[0].message
+
+    def test_ctype_mapping_mismatch_flagged(self, tmp_path):
+        out = self._setup(tmp_path, binder=_ABI_BINDER_OK.replace(
+            "        ctypes.c_longlong,\n        ctypes.c_int,",
+            "        ctypes.c_int,\n        ctypes.c_int,"))
+        assert len(out) == 1
+        assert "argtypes[1]" in out[0].message
+        assert "long long" in out[0].message
+
+    def test_unbound_export_flagged_and_allowlisted(self, tmp_path):
+        binder_partial = _ABI_BINDER_OK.replace(
+            "    lib.fd_scan.restype = ctypes.c_longlong\n"
+            "    lib.fd_scan.argtypes = [\n"
+            "        ctypes.c_char_p,\n"
+            "        ctypes.c_longlong,\n"
+            "        ctypes.POINTER(ctypes.c_float),\n"
+            "    ]\n", "")
+        out = self._setup(tmp_path, binder=binder_partial)
+        assert len(out) == 1
+        assert "fd_scan" in out[0].message and "no ctypes binding" \
+            in out[0].message
+        assert out[0].path.endswith("fake.cc")
+        # the explicit allowlist silences it
+        out = self._setup(tmp_path, binder=binder_partial +
+                          "\n# flowlint: abi-unbound: fd_scan -- "
+                          "bound lazily by the stress driver only\n")
+        assert out == []
+
+    def test_binding_nonexistent_symbol_flagged(self, tmp_path):
+        out = self._setup(tmp_path, binder=_ABI_BINDER_OK.replace(
+            "fd_scan", "fd_scam"))
+        msgs = " ".join(f.message for f in out)
+        assert "fd_scam" in msgs and "no extern" in msgs
+        # and fd_scan is now unbound on the C side
+        assert "fd_scan" in msgs
+
+    def test_missing_restype_flagged(self, tmp_path):
+        out = self._setup(tmp_path, binder=_ABI_BINDER_OK.replace(
+            "    lib.fd_scan.restype = ctypes.c_longlong\n", ""))
+        assert len(out) == 1
+        assert "no restype" in out[0].message
+
+    def test_ctypes_alias_treated_as_unknown(self, tmp_path):
+        # a local alias (`_LL = ctypes.c_longlong`) is opaque to the
+        # parser: skip the comparison, don't report the alias's
+        # spelling as an ABI mismatch
+        binder = _ABI_BINDER_OK.replace(
+            "import ctypes\n",
+            "import ctypes\n\n_LL = ctypes.c_longlong\n").replace(
+            "    lib.fd_sum.restype = ctypes.c_longlong\n",
+            "    lib.fd_sum.restype = _LL\n").replace(
+            "        ctypes.c_longlong,\n        ctypes.c_int,\n",
+            "        _LL,\n        ctypes.c_int,\n")
+        assert "_LL = ctypes.c_longlong" in binder
+        out = self._setup(tmp_path, binder=binder)
+        assert out == []
+
+    def test_argtypes_via_shared_name_not_misreported(self, tmp_path):
+        # argtypes assigned a module-level name is unparseable for the
+        # rule: treat it as unknown and skip the arity/type checks —
+        # never claim the argtypes assignment is missing
+        binder = _ABI_BINDER_OK.replace(
+            "    lib.fd_scan.argtypes = [\n"
+            "        ctypes.c_char_p,\n"
+            "        ctypes.c_longlong,\n"
+            "        ctypes.POINTER(ctypes.c_float),\n"
+            "    ]\n",
+            "    lib.fd_scan.argtypes = _SCAN_ARGS\n")
+        assert binder != _ABI_BINDER_OK
+        out = self._setup(tmp_path, binder=binder)
+        assert out == []
+
+    def test_callsite_dtype_mismatch_flagged(self, tmp_path):
+        out = self._setup(tmp_path, binder=_ABI_BINDER_OK.replace(
+            "dtype=np.uint32", "dtype=np.float32"))
+        assert len(out) == 1
+        assert "float32 buffer" in out[0].message
+        assert "uint32_t*" in out[0].message
+
+    def test_callsite_dtype_via_assert_and_empty(self, tmp_path):
+        binder = _ABI_BINDER_OK + textwrap.dedent("""
+            def scan(lib, buf):
+                assert buf.dtype == np.uint8
+                out = np.empty(4, np.float64)
+                return lib.fd_scan(buf, len(buf), _c_arr(out))
+        """)
+        out = self._setup(tmp_path, binder=binder)
+        assert len(out) == 1
+        assert "float64 buffer" in out[0].message
+        assert "'out'" in out[0].message
+
+    def test_rule_skipped_without_binder_in_scope(self, tmp_path):
+        (tmp_path / "native").mkdir()
+        (tmp_path / "native" / "fake.cc").write_text(_ABI_CC)
+        (tmp_path / "other.py").write_text("x = 1\n")
+        out = run_lint(str(tmp_path), ["other.py"],
+                       rules=("abi-contract",))
+        assert out == []
+
+    def test_repo_abi_covers_all_native_symbols(self):
+        # the acceptance criterion: the rule parses and checks every
+        # bound symbol of the real library (8 as of r08 — decode/count/
+        # encode/hash_group + the 4 hs_* sketch kernels)
+        from tools.flowlint import rules_abi
+
+        exports = {f.name for f in rules_abi.parse_exports(REPO)}
+        assert exports == {
+            "flow_decode_stream", "flow_count_frames",
+            "flow_encode_stream", "flow_hash_group",
+            "hs_cms_update", "hs_cms_query", "hs_hh_prefilter",
+            "hs_topk_merge",
+        }
+        bound = rules_abi.parse_bound_symbols(os.path.join(
+            REPO, "flow_pipeline_tpu", "native", "__init__.py"))
+        assert bound == exports
+
+
+class TestJsonOutput:
+    def test_json_findings_machine_readable(self, tmp_path, capsys):
+        import json
+
+        from tools.flowlint.runner import main
+
+        (tmp_path / "fix.py").write_text(textwrap.dedent("""
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f():
+                return np.zeros(3)
+        """))
+        rc = main(["--root", str(tmp_path), "--json", "fix.py"])
+        assert rc == 1
+        data = json.loads(capsys.readouterr().out)
+        assert data["count"] == 1
+        (f,) = data["findings"]
+        assert f["file"] == "fix.py" and f["rule"] == "uint64-discipline"
+        assert isinstance(f["line"], int) and f["message"]
+
+    def test_json_clean_run(self, tmp_path, capsys):
+        import json
+
+        from tools.flowlint.runner import main
+
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        rc = main(["--root", str(tmp_path), "--json", "ok.py"])
+        assert rc == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["count"] == 0 and data["findings"] == []
+
+
+class TestDtypeSignedMix:
+    """unsigned op signed — the headline promotion, both dtypes inferred."""
+
+    def test_uint64_int64_promotion_flagged(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f():
+                a = np.zeros(3, dtype=np.uint64)
+                b = np.ones(3, dtype=np.int64)
+                return a + b
+        """, rules=("uint64-discipline",))
+        assert len(out) == 1
+        assert "promotes to float64" in out[0].message
+        assert "dtype chain" in out[0].message
+
+    def test_smaller_unsigned_signed_mix_flagged(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f():
+                a = np.zeros(3, dtype=np.uint32)
+                b = np.ones(3, dtype=np.int32)
+                return a ^ b
+        """, rules=("uint64-discipline",))
+        assert len(out) == 1
+        assert "wraparound envelope" in out[0].message
+
+    def test_starred_unpack_clears_tracked_dtype(self, tmp_path):
+        # `a, *rest = vals` rebinds rest to a plain list — a stale
+        # tracked uint64 here was a false positive on correct code
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f(vals):
+                rest = np.zeros(3, dtype=np.uint64)
+                a, *rest = vals
+                return rest + [1]
+        """, rules=("uint64-discipline",))
+        assert _rules(out) == []
+
+    def test_class_bases_and_keywords_scanned(self, tmp_path):
+        # base/metaclass expressions run at class-definition time just
+        # like decorators; v1 (ast.walk) saw them, so must v2
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            class C(make_base(np.zeros(3)), metaclass=pick(np.array([1]))):
+                pass
+        """, rules=("uint64-discipline",))
+        assert len(out) == 2
+        assert all("without an explicit dtype" in f.message for f in out)
+
+
+class TestAsyncCoverage:
+    """async def / async with / async for bodies get the same analysis
+    as their sync twins in every rule."""
+
+    def test_dtype_interpreter_enters_async_with(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            async def g(lock, it):
+                async with lock:
+                    bad = np.zeros(3)
+                async for _ in it:
+                    d = np.zeros(3, dtype=np.uint64)
+                    return d / 2
+        """, rules=("uint64-discipline",))
+        assert len(out) == 2
+        assert any("without an explicit dtype" in f.message for f in out)
+        assert any("true division" in f.message for f in out)
+
+    def test_lock_discipline_covers_async_methods(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import threading
+            import time
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0  # guarded-by: _lock
+
+                async def ok(self):
+                    async with self._lock:
+                        self.n = 1
+
+                async def bad(self):
+                    self.n = 2
+
+                async def blocky(self):
+                    async with self._lock:
+                        time.sleep(1)
+        """, rules=("lock-discipline",))
+        msgs = sorted(f.message for f in out)
+        assert len(out) == 2
+        assert any("outside `with self._lock:`" in m for m in msgs)
+        assert any("blocking call" in m for m in msgs)
+
+    def test_lock_order_cycle_through_async_with(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: lock-checked
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                async def ab(self):
+                    async with self._a:
+                        async with self._b:
+                            pass
+
+                async def ba(self):
+                    async with self._b:
+                        async with self._a:
+                            pass
+        """, rules=("lock-order",))
+        assert len(out) == 1
+        assert "cycle" in out[0].message
+
+
+class TestCrossFileLockCycle:
+    def _write_pkg(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "a_mod.py").write_text(textwrap.dedent("""
+            # flowlint: lock-checked
+            import threading
+            from pkg.z_mod import Worker
+
+            class A:
+                def __init__(self):
+                    self.l1 = threading.Lock()
+                    self.w = Worker()
+
+                def go(self):
+                    with self.l1:
+                        self.w.go()
+
+                def reenter(self):
+                    with self.l1:
+                        pass
+        """))
+        (pkg / "z_mod.py").write_text(textwrap.dedent("""
+            # flowlint: lock-checked
+            import threading
+            from pkg.a_mod import A
+
+            class Worker:
+                def __init__(self):
+                    self.l2 = threading.Lock()
+                    self.back = A()
+
+                def go(self):
+                    with self.l2:
+                        self.back.reenter()
+        """))
+
+    def test_cycle_found_in_both_file_orders(self, tmp_path):
+        # constructor-typed attrs must resolve against classes indexed
+        # LATER in the file list too — a one-pass index dropped
+        # whichever direction of the cycle was scanned first
+        self._write_pkg(tmp_path)
+        for order in (["pkg/a_mod.py", "pkg/z_mod.py"],
+                      ["pkg/z_mod.py", "pkg/a_mod.py"]):
+            out = run_lint(str(tmp_path), order, ("lock-order",))
+            assert any("pkg.a_mod.A.l1 -> pkg.z_mod.Worker.l2" in f.message
+                       for f in out), order
+
+
+class TestDtypePositionalCast:
+    def test_asarray_positional_dtype_retypes(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f(x):
+                y = np.asarray(x, np.uint64)
+                return y + 1
+        """, rules=("uint64-discipline",))
+        # the cast target (uint64), not the input's dtype, flows on
+        assert len(out) == 1
+        assert "uint64 + python int" in out[0].message
+
+    def test_sort_positional_axis_not_a_dtype(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def g():
+                a = np.zeros(3, dtype=np.uint32)
+                s = np.sort(a, 0)
+                return s + np.uint32(1)
+        """, rules=("uint64-discipline",))
+        assert _rules(out) == []
+
+
+class TestJsonRuleNarrowing:
+    def test_json_rules_reflect_selection(self, tmp_path, capsys):
+        import json
+
+        from tools.flowlint.runner import main
+
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        rc = main(["--root", str(tmp_path), "--json",
+                   "--rule", "lock-order", "ok.py"])
+        assert rc == 0
+        data = json.loads(capsys.readouterr().out)
+        # a narrowed run must not claim all six rules ran
+        assert data["rules"] == ["lock-order"]
 
 
 class TestRepoRegression:

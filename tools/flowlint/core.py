@@ -163,6 +163,43 @@ def dotted_name(node: ast.AST) -> str | None:
     return None
 
 
+def self_attr(node: ast.AST) -> str | None:
+    """'x' for a ``self.x`` attribute node, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def own_exprs(node: ast.AST):
+    """The expression nodes belonging to ONE statement: recurse through
+    child nodes but stop at nested statements (their bodies are scanned
+    separately, under their own context) and at lambda bodies (they run
+    when called, not where written)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.stmt, ast.Lambda)):
+            continue
+        yield child
+        yield from own_exprs(child)
+
+
+# What counts as a blocking call while holding a lock — shared by
+# lock-discipline (lexical) and lock-order (interprocedural) so the two
+# rules can never disagree on what blocks.
+BLOCKING_PREFIXES = ("time.sleep", "subprocess.", "socket.", "requests.")
+BLOCKING_METHODS = {"result", "communicate", "acquire", "drain"}
+
+
+def dtype_arg(call: ast.Call, pos: int | None) -> ast.AST | None:
+    """The ``dtype=`` keyword of a call, or its positional slot."""
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return kw.value
+    if pos is not None and len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
 @dataclass
 class LintResult:
     findings: list[Finding] = field(default_factory=list)

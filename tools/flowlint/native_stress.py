@@ -20,6 +20,11 @@ python without its runtime — re-execs itself once with the matching
 itself "leaks" by ASan's definition); everything else aborts the
 process, so a nonzero exit IS the finding.
 
+Startup cross-check: the flowlint abi-contract parser's ``extern "C"``
+symbol table must agree with what ``dlsym`` resolves from the loaded
+build (and with the ctypes binder's declarations) — static and dynamic
+views of the ABI verified against each other before any stress runs.
+
 Workload per thread and why:
 
 - decode of a shared valid stream into per-thread buffers: the
@@ -85,6 +90,37 @@ def _reexec_with_runtime(mode: str) -> None:
         "ASAN_OPTIONS", "detect_leaks=0:abort_on_error=1")
     env["TSAN_OPTIONS"] = env.get("TSAN_OPTIONS", "halt_on_error=1")
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _abi_crosscheck(native) -> dict:
+    """Static vs dynamic views of the ABI must agree, under sanitizer
+    builds too: every ``extern "C"`` symbol the flowlint abi-contract
+    parser reads out of ``native/*.cc`` must dlsym-resolve from the
+    LOADED library (ctypes attribute access is a dlsym), and every
+    symbol the ctypes binder declares must be among the parsed exports.
+    A mismatch means the parser, the binder, or the build drifted —
+    exactly the gap that turns a signature change into silent memory
+    corruption instead of a loud failure here."""
+    from tools.flowlint import rules_abi
+
+    root = _repo_root()
+    exports = rules_abi.parse_exports(root)
+    assert exports, 'abi-contract parser found no extern "C" symbols'
+    lib = native._load()
+    missing = [f.name for f in exports if not hasattr(lib, f.name)]
+    assert not missing, (
+        f"exported in native/*.cc but not dlsym-resolvable from "
+        f"{os.environ.get('FLOWDECODE_LIB', 'libflowdecode.so')}: "
+        f"{missing}")
+    binder = os.path.join(root, "flow_pipeline_tpu", "native",
+                          "__init__.py")
+    bound = rules_abi.parse_bound_symbols(binder)
+    unparsed = sorted(bound - {f.name for f in exports})
+    assert not unparsed, (
+        f"bound via ctypes but not parsed from native/*.cc (parser "
+        f"drift?): {unparsed}")
+    return {"abi_symbols_parsed": len(exports),
+            "abi_symbols_bound": len(bound)}
 
 
 def _build_valid_stream(native, n_rows: int):
@@ -267,6 +303,7 @@ def main(argv=None) -> int:
     from flow_pipeline_tpu import native
 
     assert native.available() and native.group_available()
+    abi = _abi_crosscheck(native)
     batch, data, _ = _build_valid_stream(native, args.rows)
     adversarial = _adversarial_buffers(data)
 
@@ -290,6 +327,7 @@ def main(argv=None) -> int:
         "threads": args.threads,
         "iters_per_thread": args.iters,
         "adversarial_buffers": len(adversarial),
+        **abi,
         "seconds": round(dt, 2),
         "errors": errors,
         "clean": not errors,

@@ -1,0 +1,556 @@
+"""abi-contract: the ctypes bindings must match the C ABI they load.
+
+``flow_pipeline_tpu/native/__init__.py`` hand-declares the
+``argtypes``/``restype`` of every symbol in ``libflowdecode.so``; the
+truth lives in the ``extern "C"`` blocks of ``native/*.cc``. Nothing
+checked that the two agree — a dropped parameter after a kernel grows
+one, a ``c_long`` where the C side reads ``long long``, or a float32
+buffer passed where the kernel scatters uint64s is silent memory
+corruption, not an exception. This rule closes the boundary with three
+checks, all dependency-free (a ~100-line C declaration scanner — no
+libclang — plus ``ast`` on the binder):
+
+1. **Coverage** — every function exported from an ``extern "C"`` block
+   is bound (has an ``argtypes`` assignment) or explicitly allowlisted
+   in the binder with ``# flowlint: abi-unbound: <sym> -- <why>``; every
+   bound symbol exists on the C side (typo catch).
+2. **Signature** — per-symbol arity, plus a C-type <-> ctypes mapping at
+   every position (``const uint8_t*`` <-> ``c_char_p``/
+   ``POINTER(c_uint8)``/``c_void_p``, ``long long`` <-> ``c_longlong``,
+   ``int`` <-> ``c_int``, ...) and for the return type.
+3. **Call-site dtypes** — inside the binder's wrapper functions, every
+   numpy buffer handed to ``lib.<sym>(...)`` (via ``arr.ctypes.data_as``
+   or the ``_c_arr`` helper) must carry the dtype the C pointer type
+   declares, traced through ``np.ascontiguousarray(..., dtype=...)``,
+   typed ``np.empty``/``np.zeros``, and ``assert x.dtype == np.X``
+   guards. Untraceable arguments are skipped — the rule never guesses.
+
+The same parsed symbol table backs ``tools/flowlint/native_stress.py``'s
+startup cross-check that every statically declared symbol actually
+``dlsym``-resolves from the built library (static and dynamic views of
+the ABI must agree, under sanitizer builds too).
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+from dataclasses import dataclass
+
+from .core import Finding, SourceFile, dotted_name, dtype_arg as _dtype_kwarg
+
+RULE = "abi-contract"
+
+# ---- C side: a small extern "C" declaration scanner ------------------------
+
+
+@dataclass(frozen=True)
+class CParam:
+    ctype: str  # normalized: const dropped, '*' glued ("uint32_t*")
+    name: str
+
+
+@dataclass(frozen=True)
+class CFunc:
+    name: str
+    ret: str
+    params: tuple[CParam, ...]
+    rel: str
+    line: int
+
+    def signature(self) -> str:
+        args = ", ".join(p.ctype for p in self.params)
+        return f"{self.ret} {self.name}({args})"
+
+
+def _strip_comments(src: str) -> str:
+    """Blank comments AND string/char literal contents, preserving line
+    numbers. One state machine, not regexes: a `{` or `//` inside a C
+    string must not desync the brace tracker (it would silently drop
+    every later export and produce false coverage findings), and a
+    quote inside a comment must not open a string."""
+    out = list(src)
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        nxt = src[i + 1] if i + 1 < n else ""
+        if c == "/" and nxt == "/":
+            while i < n and src[i] != "\n":
+                out[i] = " "
+                i += 1
+        elif c == "/" and nxt == "*":
+            out[i] = out[i + 1] = " "
+            i += 2
+            while i < n:
+                if src[i] == "*" and i + 1 < n and src[i + 1] == "/":
+                    out[i] = out[i + 1] = " "
+                    i += 2
+                    break
+                if src[i] != "\n":
+                    out[i] = " "
+                i += 1
+        elif c in "\"'":
+            quote = c
+            i += 1
+            while i < n and src[i] != quote and src[i] != "\n":
+                if src[i] != "\\":
+                    out[i] = " "
+                    i += 1
+                    continue
+                out[i] = " "  # escape: blank it and the escaped char
+                i += 1
+                if i < n and src[i] != "\n":
+                    out[i] = " "
+                    i += 1
+            i += 1
+        else:
+            i += 1
+    return "".join(out)
+
+
+def _norm_ctype(text: str) -> str:
+    stars = text.count("*")
+    words = [w for w in text.replace("*", " ").split() if w != "const"]
+    return " ".join(words) + "*" * stars
+
+
+_DECL_RE = re.compile(r"([\w\s\*]+?)\s*\b(\w+)\s*\(\s*(.*)\)\s*$", re.S)
+
+
+def _parse_decl(decl: str, rel: str, line: int) -> CFunc | None:
+    m = _DECL_RE.match(decl.strip())
+    if not m:
+        return None
+    ret, name, params_text = m.groups()
+    params: list[CParam] = []
+    if params_text.strip() not in ("", "void"):
+        for p in params_text.split(","):
+            pm = re.match(r"^(.*?)(\w+)\s*$", p.strip(), re.S)
+            if not pm:
+                return None
+            params.append(CParam(_norm_ctype(pm.group(1)), pm.group(2)))
+    return CFunc(name, _norm_ctype(ret), tuple(params), rel, line)
+
+
+def parse_exports(root: str) -> list[CFunc]:
+    """Every function defined inside an ``extern "C" { ... }`` block of
+    ``native/*.cc`` under ``root`` (sorted by file, then line)."""
+    funcs: list[CFunc] = []
+    for path in sorted(glob.glob(os.path.join(root, "native", "*.cc"))):
+        rel = os.path.relpath(path, root)
+        with open(path, "r", encoding="utf-8") as f:
+            src = _strip_comments(f.read())
+        # _strip_comments blanks string-literal contents, so the "C" in
+        # `extern "C"` reads back as " " here; `extern` itself survives
+        # only in real code (comments are fully blanked), so matching
+        # the blanked form is still precise
+        for m in re.finditer(r'extern\s+"[C ]"\s*\{', src):
+            i = m.end()
+            depth = 1  # the extern block's own brace
+            seg_start = i
+            while i < len(src) and depth > 0:
+                c = src[i]
+                if c == "{":
+                    if depth == 1:  # a function body opens: the text
+                        # since the last reset is its declaration
+                        decl = src[seg_start:i]
+                        line = 1 + src[:seg_start].count("\n") + \
+                            decl[: len(decl) - len(decl.lstrip())].count("\n")
+                        fn = _parse_decl(decl, rel, line)
+                        if fn:
+                            funcs.append(fn)
+                    depth += 1
+                elif c == "}":
+                    depth -= 1
+                    if depth == 1:
+                        seg_start = i + 1
+                elif c == ";" and depth == 1:
+                    seg_start = i + 1
+                i += 1
+    return funcs
+
+
+# ---- C type <-> ctypes / numpy mappings ------------------------------------
+
+# C parameter/return type -> acceptable ctypes expressions (normalized
+# with the "ctypes." prefix stripped). Data pointers may ride as the
+# typed POINTER or as c_void_p (the buffer-address idiom) — the
+# call-site dtype check below covers what c_void_p erases.
+_CTYPE_MAP: dict[str, set[str]] = {
+    "long long": {"c_longlong"},
+    "int64_t": {"c_longlong", "c_int64"},
+    "int": {"c_int"},
+    "unsigned": {"c_uint"},
+    "char*": {"c_char_p", "POINTER(c_char)", "POINTER(c_uint8)"},
+    "void*": {"c_void_p"},
+    "void**": {"POINTER(c_void_p)"},
+    "uint64_t*": {"c_void_p", "POINTER(c_uint64)"},
+    "uint32_t*": {"c_void_p", "POINTER(c_uint32)"},
+    "int32_t*": {"c_void_p", "POINTER(c_int32)"},
+    "int64_t*": {"c_void_p", "POINTER(c_int64)"},
+    "float*": {"c_void_p", "POINTER(c_float)"},
+    "double*": {"c_void_p", "POINTER(c_double)"},
+    "uint8_t*": {"c_void_p", "POINTER(c_uint8)", "c_char_p"},
+}
+
+# C data-pointer base type -> the numpy dtype a passed buffer must carry
+# (char*/void* buffers are raw bytes / opaque and are skipped).
+_C_BASE_TO_NP = {
+    "uint64_t": "uint64", "uint32_t": "uint32", "uint16_t": "uint16",
+    "uint8_t": "uint8", "int64_t": "int64", "int32_t": "int32",
+    "int16_t": "int16", "int8_t": "int8", "float": "float32",
+    "double": "float64",
+}
+
+# ctypes scalar constructors -> numpy dtype (for byref'd out-params)
+_CTYPES_SCALAR_TO_NP = {
+    "c_int32": "int32", "c_uint32": "uint32", "c_int64": "int64",
+    "c_uint64": "uint64", "c_float": "float32", "c_double": "float64",
+    "c_longlong": "int64", "c_int": "int32", "c_uint8": "uint8",
+}
+
+_ALLOW_RE = re.compile(r"#\s*flowlint:\s*abi-unbound:\s*(\w+)\s*--\s*\S")
+
+
+def _ctypes_expr(node: ast.AST) -> str | None:
+    """Render a ctypes type expression ('c_longlong',
+    'POINTER(c_uint8)'), stripping any 'ctypes.' prefix. Names that
+    don't look like ctypes types (a local alias `_LL = c_longlong`)
+    return None — the caller must treat them as unknown, not compare
+    the alias's spelling against the C type and report a mismatch."""
+    d = dotted_name(node)
+    if d is not None:
+        name = d.removeprefix("ctypes.")
+        return name if name.startswith("c_") else None
+    if isinstance(node, ast.Call):
+        fd = (dotted_name(node.func) or "").split(".")[-1]
+        if fd == "POINTER" and node.args:
+            inner = (dotted_name(node.args[0]) or "").removeprefix("ctypes.")
+            return f"POINTER({inner})" if inner.startswith("c_") else None
+    return None
+
+
+# ---- Python side: binder parsing -------------------------------------------
+
+
+@dataclass
+class Binding:
+    argtypes: list[str] | None = None
+    argtypes_line: int = 0
+    argtypes_unknown: bool = False  # assigned, but not a literal list
+    restype: str | None = None
+    restype_unknown: bool = False   # assigned, but not a ctypes name
+    restype_line: int = 0
+
+
+_BIND_TARGET_RE = re.compile(r"^lib\.(\w+)\.(argtypes|restype)$")
+
+
+def _parse_bindings(sf: SourceFile) -> dict[str, Binding]:
+    """``lib.<sym>.argtypes/.restype`` assignments in one file."""
+    out: dict[str, Binding] = {}
+    if sf.tree is None:
+        return out
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        d = dotted_name(node.targets[0]) or ""
+        m = _BIND_TARGET_RE.match(d)
+        if not m:
+            continue
+        sym, what = m.groups()
+        b = out.setdefault(sym, Binding())
+        if what == "argtypes":
+            if isinstance(node.value, (ast.List, ast.Tuple)):
+                b.argtypes = [_ctypes_expr(e) or "?" for e in node.value.elts]
+            else:
+                # assigned a name/expression the parser can't see into:
+                # treat as unknown and skip arity/type checks (never
+                # guess), rather than claiming the assignment is missing
+                b.argtypes_unknown = True
+            b.argtypes_line = node.lineno
+        else:
+            b.restype = _ctypes_expr(node.value)
+            if b.restype is None and not (
+                    isinstance(node.value, ast.Constant)
+                    and node.value.value is None):
+                # `restype = None` deliberately declares void; anything
+                # else the parser can't read is unknown, not missing
+                b.restype_unknown = True
+            b.restype_line = node.lineno
+    return out
+
+
+def parse_bound_symbols(path: str) -> set[str]:
+    """Symbols the binder at ``path`` declares argtypes for — shared with
+    native_stress.py's dlsym cross-check."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    sf = SourceFile(path, os.path.basename(path), text)
+    return set(_parse_bindings(sf))
+
+
+# ---- call-site dtype tracing -----------------------------------------------
+
+_NP_DTYPE_RE = re.compile(r"^(?:np|numpy)\.(\w+)$")
+
+_CONTIG_FUNCS = {"np.ascontiguousarray", "numpy.ascontiguousarray",
+                 "np.asarray", "numpy.asarray", "np.require",
+                 "numpy.require"}
+_ALLOC_FUNCS = {"np.empty": 1, "numpy.empty": 1, "np.zeros": 1,
+                "numpy.zeros": 1, "np.ones": 1, "numpy.ones": 1,
+                "np.full": 2, "numpy.full": 2}
+
+
+def _np_dtype_name(node: ast.AST | None) -> str | None:
+    if node is None:
+        return None
+    d = dotted_name(node) or ""
+    m = _NP_DTYPE_RE.match(d)
+    if m and m.group(1) in set(_C_BASE_TO_NP.values()) | {"uint16", "int16"}:
+        return m.group(1)
+    return None
+
+
+class _WrapperScan:
+    """Best-effort dtype environment for one binder function: tracks
+    numpy locals with known dtypes and pointer locals derived from them,
+    then checks each ``lib.<sym>(...)`` call's data-pointer positions."""
+
+    def __init__(self, sf: SourceFile, cfuncs: dict[str, CFunc]):
+        self.sf = sf
+        self.cfuncs = cfuncs
+        self.arr: dict[str, str] = {}   # numpy var -> dtype name
+        self.ptr: dict[str, str] = {}   # pointer var -> source dtype name
+        self.cvar: dict[str, str] = {}  # ctypes scalar var -> dtype name
+        self.findings: list[Finding] = []
+
+    def run(self, fn: ast.FunctionDef) -> list[Finding]:
+        # two passes: the env first (conversions precede the lib call in
+        # every wrapper; a same-name re-typing AFTER the call would
+        # misattribute, which the binder style never does), then each
+        # call checked exactly once. Nested defs are excluded on both
+        # passes: check() scans every FunctionDef separately, so a
+        # nested def's calls are checked against ITS env, not the
+        # enclosing function's (and not twice)
+        self._stmts(fn.body)
+        for node in self._own_nodes(fn):
+            if isinstance(node, ast.Call):
+                self._check_lib_call(node)
+        return self.findings
+
+    @staticmethod
+    def _own_nodes(fn: ast.AST):
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _stmts(self, stmts) -> None:
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                self._assign(node.targets[0].id, node.value)
+            elif isinstance(node, ast.Assert):
+                self._assert(node.test)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(node, attr, None)
+                if sub:
+                    self._stmts(sub)
+            for h in getattr(node, "handlers", []):
+                self._stmts(h.body)
+
+    def _assign(self, name: str, value: ast.AST) -> None:
+        if isinstance(value, ast.Call):
+            d = dotted_name(value.func) or ""
+            if d in _CONTIG_FUNCS:
+                dt = _np_dtype_name(_dtype_kwarg(value, 1))
+                if dt is None and value.args and \
+                        isinstance(value.args[0], ast.Name):
+                    dt = self.arr.get(value.args[0].id)
+                if dt:
+                    self.arr[name] = dt
+                return
+            if d in _ALLOC_FUNCS:
+                dt = _np_dtype_name(_dtype_kwarg(value, _ALLOC_FUNCS[d]))
+                if dt:
+                    self.arr[name] = dt
+                return
+            src = self._pointer_source(value)
+            if src:
+                self.ptr[name] = src
+                return
+            base = d.removeprefix("ctypes.")
+            if base in _CTYPES_SCALAR_TO_NP:
+                self.cvar[name] = _CTYPES_SCALAR_TO_NP[base]
+                return
+        if isinstance(value, ast.Name):
+            for env in (self.arr, self.ptr, self.cvar):
+                if value.id in env:
+                    env[name] = env[value.id]
+
+    def _assert(self, test: ast.AST) -> None:
+        """``assert x.dtype == np.uint64`` (possibly inside and-chains)
+        pins x's dtype."""
+        for node in ast.walk(test):
+            if not (isinstance(node, ast.Compare) and len(node.ops) == 1
+                    and isinstance(node.ops[0], ast.Eq)):
+                continue
+            left = dotted_name(node.left) or ""
+            dt = _np_dtype_name(node.comparators[0])
+            if left.endswith(".dtype") and dt:
+                self.arr[left[: -len(".dtype")]] = dt
+
+    def _pointer_source(self, call: ast.Call) -> str | None:
+        """dtype behind `_c_arr(x)` / `x.ctypes.data_as(...)` /
+        `ctypes.byref(cvar)`, if traceable."""
+        d = dotted_name(call.func) or ""
+        if d.split(".")[-1] == "_c_arr" and call.args and \
+                isinstance(call.args[0], ast.Name):
+            return self.arr.get(call.args[0].id)
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "data_as":
+            recv = dotted_name(call.func.value) or ""
+            if recv.endswith(".ctypes"):
+                return self.arr.get(recv[: -len(".ctypes")])
+        if d.split(".")[-1] == "byref" and call.args and \
+                isinstance(call.args[0], ast.Name):
+            return self.cvar.get(call.args[0].id)
+        return None
+
+    def _check_lib_call(self, call: ast.Call) -> None:
+        d = dotted_name(call.func) or ""
+        m = re.match(r"^lib\.(\w+)$", d)
+        if not m or m.group(1) not in self.cfuncs:
+            return
+        cf = self.cfuncs[m.group(1)]
+        for i, arg in enumerate(call.args):
+            if i >= len(cf.params):
+                break
+            ctype = cf.params[i].ctype
+            base = ctype.rstrip("*")
+            if not ctype.endswith("*") or ctype.count("*") != 1 \
+                    or base not in _C_BASE_TO_NP:
+                continue  # scalars, char*/void* buffers: not numpy-typed
+            expected = _C_BASE_TO_NP[base]
+            got: str | None = None
+            if isinstance(arg, ast.Call):
+                got = self._pointer_source(arg)
+            elif isinstance(arg, ast.Name):
+                got = self.ptr.get(arg.id)
+            if got is not None and got != expected:
+                self.findings.append(Finding(
+                    RULE, self.sf.rel, arg.lineno,
+                    f"lib.{cf.name}() argument {i} ('{cf.params[i].name}') "
+                    f"is a {got} buffer but the C ABI declares `{ctype}` "
+                    f"(expects {expected})"))
+
+
+# ---- the rule --------------------------------------------------------------
+
+
+def check(files: list[SourceFile], root: str) -> list[Finding]:
+    parsed = {sf: b for sf in files if sf.tree is not None
+              and (b := _parse_bindings(sf))}
+    binders = list(parsed)
+    if not binders:
+        # narrowed run without the binder in scope: coverage/arity checks
+        # would be all noise, so the rule only runs with its subject
+        return []
+    exports = parse_exports(root)
+    cfuncs = {f.name: f for f in exports}
+    findings: list[Finding] = []
+
+    bound: dict[str, tuple[SourceFile, Binding]] = {}
+    allowlisted: dict[str, tuple[SourceFile, int]] = {}
+    for sf in binders:
+        for sym, b in parsed[sf].items():
+            bound[sym] = (sf, b)
+        for i, line in enumerate(sf.lines, start=1):
+            m = _ALLOW_RE.search(line)
+            if m:
+                allowlisted[m.group(1)] = (sf, i)
+
+    # 1) coverage, both directions + allowlist hygiene
+    for cf in exports:
+        if cf.name not in bound and cf.name not in allowlisted:
+            findings.append(Finding(
+                RULE, cf.rel, cf.line,
+                f"exported symbol `{cf.name}` has no ctypes binding in "
+                f"{binders[0].rel} (bind argtypes/restype or allowlist "
+                f"with `# flowlint: abi-unbound: {cf.name} -- <why>`)"))
+    for sym, (sf, b) in sorted(bound.items()):
+        if sym not in cfuncs:
+            findings.append(Finding(
+                RULE, sf.rel, b.argtypes_line or b.restype_line,
+                f"`lib.{sym}` is bound but no extern \"C\" function of "
+                f"that name exists in native/*.cc (known: "
+                f"{', '.join(sorted(cfuncs)) or 'none'})"))
+    for sym, (sf, line) in sorted(allowlisted.items()):
+        if sym in bound:
+            findings.append(Finding(
+                RULE, sf.rel, line,
+                f"`{sym}` is allowlisted as abi-unbound but IS bound — "
+                "remove the stale allowlist entry"))
+        elif sym not in cfuncs:
+            findings.append(Finding(
+                RULE, sf.rel, line,
+                f"`{sym}` is allowlisted as abi-unbound but no extern "
+                "\"C\" function of that name exists in native/*.cc"))
+
+    # 2) arity + per-position ctypes mapping + restype
+    for sym, (sf, b) in sorted(bound.items()):
+        cf = cfuncs.get(sym)
+        if cf is None:
+            continue
+        if b.argtypes is None:
+            if not b.argtypes_unknown:
+                findings.append(Finding(
+                    RULE, sf.rel, b.restype_line,
+                    f"`lib.{sym}` has a restype but no argtypes list"))
+        else:
+            if len(b.argtypes) != len(cf.params):
+                findings.append(Finding(
+                    RULE, sf.rel, b.argtypes_line,
+                    f"`lib.{sym}.argtypes` declares {len(b.argtypes)} "
+                    f"parameter(s) but the C signature has "
+                    f"{len(cf.params)}: {cf.signature()}"))
+            else:
+                for i, (ct, param) in enumerate(zip(b.argtypes, cf.params)):
+                    allowed = _CTYPE_MAP.get(param.ctype)
+                    if ct == "?" or allowed is None or ct in allowed:
+                        continue
+                    findings.append(Finding(
+                        RULE, sf.rel, b.argtypes_line,
+                        f"`lib.{sym}.argtypes[{i}]` is {ct} but C "
+                        f"parameter '{param.name}' is `{param.ctype}` "
+                        f"(accepts: {', '.join(sorted(allowed))})"))
+        if b.restype is not None:
+            allowed = _CTYPE_MAP.get(cf.ret)
+            if allowed is not None and b.restype not in allowed:
+                findings.append(Finding(
+                    RULE, sf.rel, b.restype_line,
+                    f"`lib.{sym}.restype` is {b.restype} but the C "
+                    f"return type is `{cf.ret}` (accepts: "
+                    f"{', '.join(sorted(allowed))})"))
+        elif not b.restype_unknown and cf.ret != "void":
+            findings.append(Finding(
+                RULE, sf.rel, b.argtypes_line,
+                f"`lib.{sym}` has argtypes but no restype (C returns "
+                f"`{cf.ret}`; ctypes would default to c_int)"))
+
+    # 3) call-site numpy dtype tracing in the binder's wrappers
+    for sf in binders:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.FunctionDef):
+                findings.extend(_WrapperScan(sf, cfuncs).run(node))
+
+    return sorted(findings, key=lambda f: (f.path, f.line))

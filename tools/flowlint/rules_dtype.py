@@ -1,0 +1,645 @@
+"""uint64-discipline v2: a flow-sensitive dtype interpreter for the
+exact-counter envelope.
+
+The v1 rule was syntactic (flag ``astype(int64)``, dtype-less
+constructors, ``np.int64()`` value constructors in marked modules). It
+could not see the bug class the hostsketch parity contract actually
+fears: a value KNOWN to be uint64 silently leaving the envelope through
+an implicit promotion. The worst case is numpy-version-dependent:
+under legacy NumPy (<2.0) value-based scalar rules, a ``np.uint64``
+SCALAR mixed with a plain python int promotes the whole expression to
+**float64** (no signed integer type holds 2^64), rounding above 2^53;
+smaller unsigned scalars promote to int64, abandoning the wraparound
+arithmetic the murmur3 hash lanes depend on. NEP 50 (numpy >= 2.0)
+keeps the unsigned dtype but turns out-of-range ints into runtime
+OverflowErrors. numpy is unpinned here, so the envelope discipline is
+the explicit wrap — ``np.uint64(...)`` — which behaves identically on
+every numpy and on the jitted/native twins. The heavy-hitter
+literature's counter sketches assume exact integer counters (arxiv
+1611.04825, 1910.10441) — one promotion breaks the bit-exact triple
+(jitted / numpy-twin / native).
+
+So v2 interprets: it propagates numpy/jnp dtypes through assignments,
+binops, subscripts, and calls with known signatures (constructors,
+``astype``/``view``, dtype-preserving ufuncs, a small table of project
+hash/addend helpers), flow-sensitively per function, and flags:
+
+- ``<np unsigned> op <python int>`` — numpy-version-dependent (legacy
+  scalar promotion to float64/int64 vs NEP 50's keep-dtype-or-raise).
+  Wrap the constant (``np.uint64(32)``). jnp values are exempt: JAX's
+  weak typing keeps the array dtype.
+- ``<unsigned> op <float>`` — implicit promotion out of the integer
+  envelope (an explicit ``astype`` is the sanctioned exit).
+- ``<unsigned> / x`` — true division always produces float64.
+- in ``# flowlint: uint64-exact`` modules additionally the v1 checks:
+  signed ``astype`` targets, ``np.int64()``-style value constructors,
+  and dtype-less array constructors.
+
+Findings carry the inferred dtype chain (where the value got its dtype)
+so the report reads as evidence, not accusation.
+
+Scope: modules marked ``# flowlint: uint64-exact`` get everything;
+``ops/`` and ``hostsketch/`` modules get the promotion checks even
+unmarked (the sketch dataplane must not regress by forgetting a
+marker). Values with unknown dtypes are never flagged — the
+interpreter under-approximates rather than guess.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .core import Finding, SourceFile, dotted_name, dtype_arg as _dtype_arg
+
+RULE = "uint64-discipline"
+MARKER = "uint64-exact"
+
+# unmarked modules under these path fragments still get promotion checks
+SCOPE_DIRS = ("flow_pipeline_tpu/ops/", "flow_pipeline_tpu/hostsketch/")
+
+_UNSIGNED = {"uint8", "uint16", "uint32", "uint64"}
+_SIGNED = {"int8", "int16", "int32", "int64"}
+_FLOATS = {"float16", "float32", "float64", "pyfloat"}
+_DTYPE_WORDS = _UNSIGNED | _SIGNED | _FLOATS | {
+    "bool_", "bool", "intp", "int_", "float_"}
+_CANON = {"bool_": "bool", "intp": "int64", "int_": "int64",
+          "float_": "float64"}
+
+# v1 checks (marked modules only)
+_SIGNED_CONSTRUCTORS = {
+    "np.int32", "np.int64", "numpy.int32", "numpy.int64",
+    "jnp.int32", "jnp.int64", "np.intp", "np.int_",
+}
+# constructor -> positional index of its dtype slot
+_NEED_DTYPE = {
+    "np.array": 1, "numpy.array": 1, "jnp.array": 1,
+    "np.empty": 1, "numpy.empty": 1, "jnp.empty": 1,
+    "np.zeros": 1, "numpy.zeros": 1, "jnp.zeros": 1,
+    "np.ones": 1, "numpy.ones": 1, "jnp.ones": 1,
+    "np.full": 2, "numpy.full": 2, "jnp.full": 2,
+    "np.fromiter": 1, "numpy.fromiter": 1,
+}
+# dtype-preserving: np.asarray without dtype keeps the input's dtype,
+# which is exactly the discipline — allowed, and propagated. Value is
+# the positional slot of an optional dtype arg (asarray(x, np.uint64)
+# re-types the result), None where position 1 means something else
+# (sort's axis, clip's bound)
+_PRESERVING_FUNCS = {"np.asarray": 1, "numpy.asarray": 1,
+                     "jnp.asarray": 1, "np.ascontiguousarray": 1,
+                     "numpy.ascontiguousarray": 1,
+                     "np.sort": None, "numpy.sort": None,
+                     "np.copy": None, "numpy.copy": None,
+                     "np.squeeze": None, "numpy.squeeze": None,
+                     "np.ravel": None, "numpy.ravel": None,
+                     "np.flip": None, "numpy.flip": None,
+                     "np.nan_to_num": None, "numpy.nan_to_num": None,
+                     "np.clip": None, "numpy.clip": None,
+                     "jnp.clip": None}
+# 2-arg combiners: result follows the non-constant side; constants used
+# as fill/bounds don't promote in practice (np.where/minimum pick, they
+# don't mix arithmetic), so these propagate without flagging
+_COMBINING_FUNCS = {"np.where", "numpy.where", "jnp.where",
+                    "np.minimum", "numpy.minimum", "jnp.minimum",
+                    "np.maximum", "numpy.maximum", "jnp.maximum"}
+_CONCAT_FUNCS = {"np.concatenate", "numpy.concatenate",
+                 "jnp.concatenate", "np.stack", "numpy.stack",
+                 "jnp.stack", "np.vstack", "np.hstack"}
+# dtype-preserving methods on arrays
+_PRESERVING_METHODS = {"copy", "reshape", "ravel", "flatten", "transpose",
+                       "squeeze", "sum", "min", "max", "cumsum", "clip"}
+# project helpers with known return dtypes (resolved by bare call name)
+_KNOWN_CALLS: dict[str, tuple[str, str]] = {
+    "hash_u64": ("uint64", "np"),
+    "hash_words_np": ("uint32", "np"),
+    "hash_words": ("uint32", "jnp"),
+    "_addend_u64": ("uint64", "np"),
+    "np_cms_query_u64": ("uint64", "np"),
+}
+
+
+@dataclass(frozen=True)
+class AV:
+    """Abstract value: an inferred dtype + where it came from."""
+
+    dtype: str | None = None
+    lib: str | None = None          # "np" | "jnp" | None
+    chain: tuple[str, ...] = ()     # provenance, newest last
+
+    def with_step(self, step: str) -> "AV":
+        chain = (self.chain + (step,))[-4:]
+        return AV(self.dtype, self.lib, chain)
+
+
+_UNKNOWN = AV()
+
+# ast.Match is 3.10+; isinstance against () is simply False earlier
+_MATCH_STMT = getattr(ast, "Match", ())
+
+
+def _canon(name: str) -> str:
+    return _CANON.get(name, name)
+
+
+def _dtype_of_expr(node: ast.AST | None) -> tuple[str, str | None] | None:
+    """(dtype, lib) named by a dtype expression: np.uint64, jnp.int32,
+    'uint64', np.dtype(np.uint64)."""
+    if node is None:
+        return None
+    d = dotted_name(node)
+    if d:
+        parts = d.split(".")
+        if len(parts) == 2 and parts[0] in ("np", "numpy", "jnp") \
+                and parts[1] in _DTYPE_WORDS:
+            lib = "jnp" if parts[0] == "jnp" else "np"
+            return _canon(parts[1]), lib
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value in _DTYPE_WORDS:
+        return _canon(node.value), None
+    if isinstance(node, ast.Call):
+        fd = dotted_name(node.func) or ""
+        if fd.split(".")[-1] == "dtype" and node.args:
+            return _dtype_of_expr(node.args[0])
+    return None
+
+
+class _Interp:
+    """Flow-sensitive dtype interpreter for one module."""
+
+    def __init__(self, sf: SourceFile, strict: bool):
+        self.sf = sf
+        self.strict = strict  # marked module: v1 syntactic checks too
+        self.module_env: dict[str, AV] = {}
+        self.findings: list[Finding] = []
+        self._seen: set[tuple[int, str]] = set()
+
+    # ---- driving -----------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        self._exec_block(self.sf.tree.body, self.module_env)
+        for node in ast.walk(self.sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # default-arg and decorator expressions evaluate in the
+                # enclosing scope — a dtype-less constructor there is
+                # still a bug
+                a = node.args
+                for d in (list(a.defaults)
+                          + [k for k in a.kw_defaults if k is not None]
+                          + list(node.decorator_list)):
+                    self._eval(d, dict(self.module_env))
+                # parameters shadow module globals and may be passed
+                # anything: bind them unknown so the module_env
+                # fallback can't guess a dtype for them
+                env: dict[str, AV] = {}
+                for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                            + ([a.vararg] if a.vararg else [])
+                            + ([a.kwarg] if a.kwarg else [])):
+                    env[arg.arg] = _UNKNOWN
+                self._exec_block(node.body, env)
+        return self.findings
+
+    def _flag(self, node: ast.AST, msg: str) -> None:
+        key = (node.lineno, msg)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(Finding(RULE, self.sf.rel, node.lineno, msg))
+
+    # ---- statements --------------------------------------------------------
+
+    def _exec_block(self, stmts, env: dict[str, AV]) -> None:
+        for node in stmts:
+            self._exec_stmt(node, env)
+
+    def _exec_stmt(self, node: ast.stmt, env: dict[str, AV]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # functions run from their own entry (run())
+        if isinstance(node, ast.ClassDef):
+            # class decorators and class-body statements execute at
+            # definition time: a dtype-less constructor building a
+            # class-level table is no less a finding than one at module
+            # scope (methods inside still run from run()'s own entry)
+            for dec in node.decorator_list:
+                self._eval(dec, env)
+            for b in node.bases:
+                self._eval(b, env)
+            for kw in node.keywords:
+                self._eval(kw.value, env)
+            self._exec_block(node.body, dict(env))
+            return
+        if isinstance(node, ast.Assign):
+            val = self._eval(node.value, env)
+            for t in node.targets:
+                self._bind(t, val, node, env)
+            return
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            self._bind(node.target, self._eval(node.value, env), node, env)
+            return
+        if isinstance(node, ast.AugAssign):
+            lav = self._eval(node.target, env)
+            rav = self._eval(node.value, env)
+            res = self._combine(lav, rav, node.op, node)
+            if isinstance(node.target, ast.Name):
+                env[node.target.id] = res.with_step(
+                    f"{node.target.id} @ line {node.lineno}")
+            return
+        if isinstance(node, ast.If):
+            self._eval(node.test, env)
+            then_env = dict(env)
+            self._exec_block(node.body, then_env)
+            else_env = dict(env)
+            self._exec_block(node.orelse, else_env)
+            for k in set(then_env) | set(else_env):
+                a, b = then_env.get(k, _UNKNOWN), else_env.get(k, _UNKNOWN)
+                env[k] = a if a.dtype == b.dtype else _UNKNOWN
+            return
+        if isinstance(node, _MATCH_STMT):
+            self._eval(node.subject, env)
+            branch_envs = [dict(env)]  # no case may match: fall through
+            for case in node.cases:
+                cenv = dict(env)
+                # capture patterns bind names to whatever matched —
+                # unknown, exactly like function parameters
+                for p in ast.walk(case.pattern):
+                    for f in ("name", "rest"):
+                        n = getattr(p, f, None)
+                        if isinstance(n, str):
+                            cenv[n] = _UNKNOWN
+                if case.guard is not None:
+                    self._eval(case.guard, cenv)
+                self._exec_block(case.body, cenv)
+                branch_envs.append(cenv)
+            for k in set().union(*branch_envs):
+                vals = [be.get(k, _UNKNOWN) for be in branch_envs]
+                env[k] = vals[0] if all(
+                    v.dtype == vals[0].dtype for v in vals) else _UNKNOWN
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                self._eval(node.iter, env)
+                self._bind(node.target, _UNKNOWN, node, env)
+            else:
+                self._eval(node.test, env)
+            self._exec_block(node.body, env)  # single pass, no fixpoint
+            self._exec_block(node.orelse, env)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self._eval(item.context_expr, env)
+            self._exec_block(node.body, env)
+            return
+        if isinstance(node, ast.Try):
+            self._exec_block(node.body, env)
+            for h in node.handlers:
+                self._exec_block(h.body, env)
+            self._exec_block(node.orelse, env)
+            self._exec_block(node.finalbody, env)
+            return
+        if isinstance(node, ast.Return) and node.value is not None:
+            self._eval(node.value, env)
+            return
+        if isinstance(node, ast.Expr):
+            self._eval(node.value, env)
+            return
+        # anything else: evaluate hanging expressions for findings
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._eval(child, env)
+
+    def _bind_unknown(self, target: ast.AST, env: dict[str, AV]) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = _UNKNOWN
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind_unknown(el, env)
+        elif isinstance(target, ast.Starred):
+            self._bind_unknown(target.value, env)
+
+    def _bind(self, target: ast.AST, val: AV, node: ast.stmt,
+              env: dict[str, AV]) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = val.with_step(
+                f"{target.id} @ line {node.lineno}") \
+                if val.dtype else _UNKNOWN
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind(el, _UNKNOWN, node, env)
+        elif isinstance(target, ast.Starred):
+            # `a, *rest = vals` makes rest a plain list whatever vals'
+            # dtype was — a stale tracked dtype here is a false positive
+            self._bind(target.value, _UNKNOWN, node, env)
+        elif isinstance(target, ast.Subscript):
+            # d[np.int64(v)] = x doesn't rebind a tracked name, but its
+            # index expression still evaluates — scan it for findings
+            self._eval(target.value, env)
+            self._eval(target.slice, env)
+        elif isinstance(target, ast.Attribute):
+            self._eval(target.value, env)
+
+    # ---- expressions -------------------------------------------------------
+
+    def _eval(self, node: ast.AST, env: dict[str, AV]) -> AV:
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return AV("bool")
+            if isinstance(node.value, int):
+                return AV("pyint", chain=(f"int literal {node.value} @ "
+                                          f"line {node.lineno}",))
+            if isinstance(node.value, float):
+                return AV("pyfloat", chain=(f"float literal @ line "
+                                            f"{node.lineno}",))
+            return _UNKNOWN
+        if isinstance(node, ast.Name):
+            return env.get(node.id) or self.module_env.get(node.id) \
+                or _UNKNOWN
+        if isinstance(node, ast.NamedExpr):
+            val = self._eval(node.value, env)
+            if isinstance(node.target, ast.Name):
+                env[node.target.id] = val.with_step(
+                    f"{node.target.id} @ line {node.lineno}") \
+                    if val.dtype else _UNKNOWN
+            return val
+        if isinstance(node, ast.BinOp):
+            lav = self._eval(node.left, env)
+            rav = self._eval(node.right, env)
+            return self._combine(lav, rav, node.op, node)
+        if isinstance(node, ast.UnaryOp):
+            return self._eval(node.operand, env)
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self._eval(v, env)
+            return _UNKNOWN
+        if isinstance(node, ast.Compare):
+            self._eval(node.left, env)
+            for c in node.comparators:
+                self._eval(c, env)
+            return AV("bool")
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, env)
+            a = self._eval(node.body, env)
+            b = self._eval(node.orelse, env)
+            return a if a.dtype == b.dtype else _UNKNOWN
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env)
+        if isinstance(node, ast.Attribute):
+            base = self._eval(node.value, env)
+            if node.attr == "T":
+                return base
+            if node.attr == "shape":
+                return AV("pyshape")
+            return _UNKNOWN
+        if isinstance(node, ast.Subscript):
+            base = self._eval(node.value, env)
+            self._eval(node.slice, env)
+            if base.dtype == "pyshape":
+                return AV("pyint")
+            if base.dtype in _UNSIGNED | _SIGNED | _FLOATS:
+                return base  # array indexing/slicing preserves dtype
+            return _UNKNOWN
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for el in node.elts:
+                self._eval(el, env)
+            return _UNKNOWN
+        if isinstance(node, ast.Dict):
+            for v in list(node.keys) + list(node.values):
+                if v is not None:
+                    self._eval(v, env)
+            return _UNKNOWN
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            # own scope: iteration targets are unknown, but the body
+            # expressions are still scanned (a float64 plane built in a
+            # comprehension is no less a bug than one built in a loop)
+            cenv = dict(env)
+            for gen in node.generators:
+                self._eval(gen.iter, cenv)
+                self._bind_unknown(gen.target, cenv)
+                for cond in gen.ifs:
+                    self._eval(cond, cenv)
+            if isinstance(node, ast.DictComp):
+                self._eval(node.key, cenv)
+                self._eval(node.value, cenv)
+            else:
+                self._eval(node.elt, cenv)
+            return _UNKNOWN
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value, env)
+        if isinstance(node, (ast.Yield, ast.YieldFrom, ast.Await)):
+            if getattr(node, "value", None) is not None:
+                self._eval(node.value, env)
+            return _UNKNOWN
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                self._eval(v, env)
+            return _UNKNOWN
+        if isinstance(node, ast.FormattedValue):
+            self._eval(node.value, env)
+            return _UNKNOWN
+        if isinstance(node, ast.Lambda):
+            lenv = dict(env)
+            a = node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])):
+                lenv[arg.arg] = _UNKNOWN
+            for d in list(a.defaults) + [k for k in a.kw_defaults
+                                         if k is not None]:
+                self._eval(d, env)
+            self._eval(node.body, lenv)
+            return _UNKNOWN
+        if isinstance(node, ast.Slice):
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    self._eval(part, env)
+            return _UNKNOWN
+        return _UNKNOWN
+
+    def _eval_call(self, node: ast.Call, env: dict[str, AV]) -> AV:
+        d = dotted_name(node.func) or ""
+        args = [self._eval(a, env) for a in node.args]
+        for kw in node.keywords:
+            self._eval(kw.value, env)
+
+        # dtype scalar constructors: np.uint64(x) etc.
+        named = _dtype_of_expr(node.func)
+        if named is not None:
+            dt, lib = named
+            if self.strict and d in _SIGNED_CONSTRUCTORS and node.args:
+                self._flag(node, f"signed scalar constructor `{d}(...)` in "
+                                 "a uint64-exact module (mixes to float64 "
+                                 "against uint64)")
+            return AV(dt, lib, (f"{d}() @ line {node.lineno}",))
+
+        # array constructors needing an explicit dtype
+        if d in _NEED_DTYPE:
+            spec = _dtype_of_expr(_dtype_arg(node, _NEED_DTYPE[d]))
+            if spec is None and _dtype_arg(node, _NEED_DTYPE[d]) is None:
+                if self.strict:
+                    self._flag(node, f"`{d}(...)` without an explicit dtype "
+                                     "in a uint64-exact module")
+                return _UNKNOWN
+            if spec is None:
+                return _UNKNOWN  # dynamic dtype expression: don't guess
+            lib = "jnp" if d.startswith("jnp") else "np"
+            return AV(spec[0], lib, (f"{d}(..., {spec[0]}) @ line "
+                                     f"{node.lineno}",))
+
+        # astype / view
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("astype", "view") and node.args:
+            recv = self._eval(node.func.value, env)
+            spec = _dtype_of_expr(node.args[0])
+            if node.func.attr == "astype" and self.strict:
+                target = dotted_name(node.args[0]) or ""
+                tname = target.split(".")[-1] if target else (
+                    node.args[0].value
+                    if isinstance(node.args[0], ast.Constant) else "")
+                if target == "int" or tname in _SIGNED | {"intp", "int_"}:
+                    self._flag(node, f"signed narrowing cast `.astype("
+                                     f"{target or tname})` in a "
+                                     "uint64-exact module")
+            if spec is None:
+                return _UNKNOWN
+            lib = "jnp" if (dotted_name(node.args[0]) or "").startswith(
+                "jnp") else (recv.lib or "np")
+            return AV(spec[0], lib,
+                      recv.chain + (f".{node.func.attr}({spec[0]}) @ line "
+                                    f"{node.lineno}",))
+
+        # dtype-preserving functions / combiners / concatenation
+        if d in _PRESERVING_FUNCS:
+            spec = _dtype_of_expr(_dtype_arg(node, _PRESERVING_FUNCS[d]))
+            if spec is not None:
+                lib = "jnp" if d.startswith("jnp") else "np"
+                return AV(spec[0], lib, (f"{d}(..., dtype={spec[0]}) @ "
+                                         f"line {node.lineno}",))
+            return args[0] if args else _UNKNOWN
+        if d in _COMBINING_FUNCS:
+            cands = args[1:] if d.split(".")[-1] == "where" else args
+            known = [a for a in cands
+                     if a.dtype in _UNSIGNED | _SIGNED | _FLOATS]
+            if known and all(a.dtype == known[0].dtype for a in known):
+                return known[0]
+            return _UNKNOWN
+        if d in _CONCAT_FUNCS and node.args and \
+                isinstance(node.args[0], (ast.List, ast.Tuple)):
+            parts = [self._eval(e, env) for e in node.args[0].elts]
+            if parts and parts[0].dtype and \
+                    all(p.dtype == parts[0].dtype for p in parts):
+                return parts[0]
+            return _UNKNOWN
+
+        # dtype-preserving methods (x.sum() keeps the envelope; numpy
+        # widens small ints to the platform accumulator, still integer)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _PRESERVING_METHODS:
+            recv = self._eval(node.func.value, env)
+            if recv.dtype in _UNSIGNED | _SIGNED | _FLOATS:
+                return recv.with_step(f".{node.func.attr}() @ line "
+                                      f"{node.lineno}")
+            return _UNKNOWN
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            return AV("pyint")
+
+        # project helpers with known return dtypes
+        bare = d.split(".")[-1] if d else ""
+        if bare in _KNOWN_CALLS:
+            dt, lib = _KNOWN_CALLS[bare]
+            return AV(dt, lib, (f"{bare}() @ line {node.lineno}",))
+        return _UNKNOWN
+
+    # ---- promotion checks --------------------------------------------------
+
+    def _combine(self, lav: AV, rav: AV, op: ast.operator,
+                 node: ast.AST) -> AV:
+        uns, other = (lav, rav) if lav.dtype in _UNSIGNED else (rav, lav)
+        if uns.dtype not in _UNSIGNED:
+            return self._plain_result(lav, rav)
+        opname = _OP_SYMBOL.get(type(op).__name__, type(op).__name__)
+        chain = "; ".join(uns.chain) or "inferred"
+
+        if isinstance(op, ast.Div):
+            self._flag(node, f"true division on {uns.dtype} produces "
+                             f"float64 — exactness leaves the integer "
+                             f"envelope (dtype chain: {chain}); use // or "
+                             "an explicit astype")
+            return AV("float64", uns.lib)
+        if other.dtype in _FLOATS:
+            ochain = "; ".join(other.chain) or "inferred"
+            self._flag(node, f"implicit promotion out of the unsigned "
+                             f"envelope: {uns.dtype} {opname} "
+                             f"{other.dtype} -> float (dtype chain: "
+                             f"{chain} | {ochain}); cast explicitly if "
+                             "intended")
+            return AV("float64", uns.lib)
+        if other.dtype in _SIGNED:
+            ochain = "; ".join(other.chain) or "inferred"
+            if uns.dtype == "uint64":
+                # version-independent, arrays and scalars alike: no
+                # signed integer type holds 2^64, so numpy resolves
+                # uint64 x int64 to float64 — the exact promotion this
+                # rule exists to catch
+                self._flag(node, f"uint64 {opname} {other.dtype} "
+                                 "promotes to float64 (no signed integer "
+                                 "type holds 2^64) — exactness lost above "
+                                 f"2^53 (dtype chain: {chain} | {ochain}); "
+                                 "cast one side explicitly")
+            else:
+                self._flag(node, f"{uns.dtype} {opname} {other.dtype} "
+                                 "promotes to a signed dtype, leaving the "
+                                 f"{uns.dtype} wraparound envelope (dtype "
+                                 f"chain: {chain} | {ochain}); cast one "
+                                 "side explicitly")
+            return uns  # assume the fix: don't cascade the promotion
+        if other.dtype == "pyint" and uns.lib == "np":
+            if uns.dtype == "uint64":
+                self._flag(node, f"uint64 {opname} python int is numpy-"
+                                 "version-dependent: legacy NumPy (<2.0) "
+                                 "scalar rules promote to float64, losing "
+                                 "exactness above 2^53; NEP 50 keeps "
+                                 "uint64 but overflows raise (dtype chain: "
+                                 f"{chain}); wrap the int in np.uint64(...)"
+                                 " so every numpy agrees with the jitted/"
+                                 "native twins")
+            else:
+                self._flag(node, f"{uns.dtype} {opname} python int is "
+                                 "numpy-version-dependent: legacy NumPy "
+                                 "(<2.0) scalar rules promote to a signed "
+                                 f"dtype, leaving the {uns.dtype} "
+                                 "wraparound envelope; NEP 50 keeps "
+                                 f"{uns.dtype} (dtype chain: {chain}); "
+                                 f"wrap the int in np.{uns.dtype}(...)")
+            return uns  # assume the fix: don't cascade the promotion
+        return self._plain_result(lav, rav)
+
+    @staticmethod
+    def _plain_result(lav: AV, rav: AV) -> AV:
+        concrete = _UNSIGNED | _SIGNED | {"float16", "float32", "float64"}
+        if lav.dtype == rav.dtype:
+            return lav
+        if lav.dtype in concrete and rav.dtype == "pyint":
+            return lav  # jnp weak typing / in-range int: dtype survives
+        if rav.dtype in concrete and lav.dtype == "pyint":
+            return rav
+        return _UNKNOWN
+
+
+_OP_SYMBOL = {
+    "Add": "+", "Sub": "-", "Mult": "*", "Div": "/", "FloorDiv": "//",
+    "Mod": "%", "Pow": "**", "LShift": "<<", "RShift": ">>",
+    "BitOr": "|", "BitXor": "^", "BitAnd": "&", "MatMult": "@",
+}
+
+
+def in_scope(sf: SourceFile) -> bool:
+    rel = sf.rel.replace("\\", "/")
+    return MARKER in sf.markers or any(s in rel for s in SCOPE_DIRS)
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        if sf.tree is None or not in_scope(sf):
+            continue
+        findings.extend(_Interp(sf, strict=MARKER in sf.markers).run())
+    return sorted(findings, key=lambda f: (f.path, f.line))

@@ -1,0 +1,568 @@
+"""lock-order: a static lock-acquisition graph over the concurrency layer.
+
+v1's lock-discipline checks *placement* (guarded writes sit inside the
+right ``with``); it says nothing about *ordering*. A deadlock needs two
+locks taken in opposite orders on two threads — e.g. the flush CV held
+while waiting on the executor queue lock on one thread, the queue lock
+held while signalling the CV on another. Both sides pass v1.
+
+This rule builds the acquisition graph and reports cycles:
+
+- **Locks** are attributes assigned ``threading.Lock()`` / ``RLock()`` /
+  ``Condition()`` / ``Semaphore()`` in ``__init__`` (identified as
+  ``module.Class._name``, module-qualified so unrelated same-named
+  classes never unify) and module globals assigned the same
+  constructors.
+- **Edges**: ``with A: ... with B:`` adds A -> B; composing with the
+  call graph, ``with A: self.m()`` where ``m`` (transitively) acquires B
+  also adds A -> B. Calls resolve through module-local defs, project
+  imports, ``self.``-methods (including resolvable base classes), and
+  attributes typed by their ``__init__`` constructor call
+  (``self._pool = ShardPool(...)`` makes ``self._pool.submit`` resolve).
+- **Cycles** (potential deadlock) are reported once per strongly
+  connected component with the witnessing source lines. A self-edge on
+  a reentrant lock (RLock, Condition — which wraps an RLock) is legal
+  re-entry and exempt; on a plain Lock it is a guaranteed self-deadlock.
+- **Interprocedural blocking-while-holding**: a call made while holding
+  a lock to a function that (transitively) blocks — ``time.sleep``,
+  ``subprocess.*``, ``socket.*``, thread ``.join()``, future
+  ``.result()``, foreign ``.wait()`` — is reported with the chain.
+  v1 already flags the lexical case; this closes the call-graph hole.
+  The CV-wait exemption carries over: ``wait``/``wait_for`` on a lock
+  the function itself holds is the condition-variable pattern, never
+  flagged (the ordering consequences are covered by the cycle check,
+  which still sees the CV's acquisition edges).
+
+Findings are only attributed to ``# flowlint: lock-checked`` modules;
+unmarked modules still contribute call-graph summaries so a blocking
+helper in a plain module is seen from its locked caller.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import (BLOCKING_METHODS as _BLOCKING_METHODS,
+                   BLOCKING_PREFIXES as _BLOCKING_PREFIXES,
+                   Finding, SourceFile, dotted_name, own_exprs,
+                   self_attr as _self_attr)
+
+RULE = "lock-order"
+MARKER = "lock-checked"
+
+_LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "reentrant",
+    "threading.Condition": "reentrant",  # default lock is an RLock
+    "threading.Semaphore": "lock",
+    "threading.BoundedSemaphore": "lock",
+    "Lock": "lock", "RLock": "reentrant", "Condition": "reentrant",
+}
+
+def _module_name(rel: str) -> str:
+    return rel[:-3].replace("/", ".").replace("\\", ".")
+
+
+@dataclass
+class _Func:
+    key: tuple[str, str | None, str]  # (module, class, name)
+    node: ast.FunctionDef
+    sf: SourceFile
+    marked: bool
+    # summaries (filled by _analyze, closed transitively afterwards)
+    acquires: set[str] = field(default_factory=set)
+    blocks: tuple[str, int, str] | None = None  # (what, line, rel)
+    calls: list[tuple[tuple, tuple[str, ...], int]] = \
+        field(default_factory=list)  # (callee key, held locks, line)
+    edges: list[tuple[str, str, int]] = field(default_factory=list)
+
+
+class _Index:
+    """Modules, classes, functions, imports, locks, attr types."""
+
+    def __init__(self, files: list[SourceFile]):
+        self.files = files
+        self.funcs: dict[tuple, _Func] = {}
+        self.classes: dict[str, list[tuple[str, ast.ClassDef]]] = {}
+        self.import_from: dict[str, dict[str, tuple[str, str]]] = {}
+        self.import_mod: dict[str, dict[str, str]] = {}
+        self.locks: dict[str, str] = {}  # lock id -> kind
+        self.class_locks: dict[tuple[str, str], dict[str, str]] = {}
+        self.module_locks: dict[str, dict[str, str]] = {}
+        self.attr_types: dict[tuple[str, str], dict[str, str]] = {}
+        self.class_bases: dict[tuple[str, str], list[str]] = {}
+        self.marked_mods: set[str] = set()
+
+        # pass 1: register every class NAME first — _index_class resolves
+        # constructor-typed attrs (`self.w = Worker()`) against
+        # self.classes, and a one-pass build would drop whichever
+        # direction of a cross-file cycle is indexed first
+        for sf in files:
+            if sf.tree is None:
+                continue
+            mod = _module_name(sf.rel)
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self.classes.setdefault(node.name, []).append(
+                        (mod, node))
+
+        for sf in files:
+            if sf.tree is None:
+                continue
+            mod = _module_name(sf.rel)
+            if MARKER in sf.markers:
+                self.marked_mods.add(mod)
+            self.import_from[mod] = {}
+            self.import_mod[mod] = {}
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        self.import_mod[mod][
+                            a.asname or a.name.split(".")[0]] = a.name
+                elif isinstance(node, ast.ImportFrom):
+                    base = self._resolve_relative(mod, node)
+                    for a in node.names:
+                        if a.name != "*":
+                            self.import_from[mod][a.asname or a.name] = \
+                                (base, a.name)
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._index_class(sf, mod, node)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    key = (mod, None, node.name)
+                    self.funcs[key] = _Func(key, node, sf,
+                                            mod in self.marked_mods)
+            self._index_module_locks(sf, mod)
+
+    @staticmethod
+    def _resolve_relative(mod: str, node: ast.ImportFrom) -> str:
+        if node.level == 0:
+            return node.module or ""
+        parts = mod.split(".")
+        base = parts[: len(parts) - node.level]
+        if node.module:
+            base.append(node.module)
+        return ".".join(base)
+
+    def _index_class(self, sf: SourceFile, mod: str,
+                     cls: ast.ClassDef) -> None:
+        # (cls itself was registered in self.classes by pass 1)
+        self.class_bases[(mod, cls.name)] = [
+            dotted_name(b) or "" for b in cls.bases]
+        locks: dict[str, str] = {}
+        attr_types: dict[str, str] = {}
+        for meth in cls.body:
+            if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = (mod, cls.name, meth.name)
+                self.funcs[key] = _Func(key, meth, sf,
+                                        mod in self.marked_mods)
+                if meth.name != "__init__":
+                    continue
+                for node in ast.walk(meth):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr is None or \
+                                not isinstance(node.value, ast.Call):
+                            continue
+                        ctor = dotted_name(node.value.func) or ""
+                        if ctor in _LOCK_CTORS:
+                            locks[attr] = _LOCK_CTORS[ctor]
+                        elif ctor and ctor.split(".")[-1] in self.classes:
+                            attr_types[attr] = ctor.split(".")[-1]
+        self.class_locks[(mod, cls.name)] = locks
+        self.attr_types[(mod, cls.name)] = attr_types
+        for attr, kind in locks.items():
+            # ids carry the module so an unrelated same-named class in
+            # another file can't unify into a phantom cycle
+            self.locks[f"{mod}.{cls.name}.{attr}"] = kind
+
+    def _index_module_locks(self, sf: SourceFile, mod: str) -> None:
+        locks: dict[str, str] = {}
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                ctor = dotted_name(node.value.func) or ""
+                if ctor in _LOCK_CTORS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            locks[t.id] = _LOCK_CTORS[ctor]
+        self.module_locks[mod] = locks
+        for name, kind in locks.items():
+            self.locks[f"{mod}.{name}"] = kind
+
+    # ---- resolution --------------------------------------------------------
+
+    def resolve_class(self, mod: str, name: str) -> tuple[str, str] | None:
+        """(module, classname) for a class reference seen in ``mod``."""
+        cands = self.classes.get(name.split(".")[-1], [])
+        if not cands:
+            return None
+        imp = self.import_from.get(mod, {}).get(name)
+        if imp:
+            for cmod, _ in cands:
+                if cmod == imp[0] or cmod.endswith("." + imp[1]):
+                    return cmod, name
+        for cmod, _ in cands:
+            if cmod == mod:
+                return cmod, name
+        if len(cands) == 1:
+            return cands[0][0], name.split(".")[-1]
+        return None
+
+    def method(self, mod: str, cls: str, name: str) -> tuple | None:
+        """(module, class, name) walking resolvable base classes."""
+        seen = set()
+        stack = [(mod, cls)]
+        while stack:
+            cmod, cname = stack.pop()
+            if (cmod, cname) in seen:
+                continue
+            seen.add((cmod, cname))
+            if (cmod, cname, name) in self.funcs:
+                return (cmod, cname, name)
+            for base in self.class_bases.get((cmod, cname), []):
+                r = self.resolve_class(cmod, base)
+                if r:
+                    stack.append(r)
+        return None
+
+    def all_class_locks(self, mod: str, cls: str) -> dict[str, str]:
+        """Own + inherited lock attributes, ids keyed by DECLARING class
+        so base-held locks unify across subclasses."""
+        out: dict[str, str] = {}
+        seen = set()
+        stack = [(mod, cls)]
+        while stack:
+            cmod, cname = stack.pop()
+            if (cmod, cname) in seen:
+                continue
+            seen.add((cmod, cname))
+            for attr in self.class_locks.get((cmod, cname), {}):
+                out.setdefault(attr, f"{cmod}.{cname}.{attr}")
+            for base in self.class_bases.get((cmod, cname), []):
+                r = self.resolve_class(cmod, base)
+                if r:
+                    stack.append(r)
+        return out
+
+
+class _FuncAnalyzer:
+    """One function: direct acquisitions, nesting edges, calls made under
+    held locks, direct (non-exempt) blocking primitives."""
+
+    def __init__(self, idx: _Index, fn: _Func):
+        self.idx = idx
+        self.fn = fn
+        mod, cls, _ = fn.key
+        self.mod, self.cls = mod, cls
+        self.self_locks = idx.all_class_locks(mod, cls) if cls else {}
+        self.mod_locks = idx.module_locks.get(mod, {})
+
+    def run(self) -> None:
+        self._walk(self.fn.node.body, ())
+
+    def _lock_of(self, expr: ast.AST) -> str | None:
+        d = dotted_name(expr)
+        if d is None:
+            return None
+        if d.startswith("self."):
+            attr = d[len("self."):]
+            if attr in self.self_locks:
+                return self.self_locks[attr]
+            return None
+        if d in self.mod_locks:
+            return f"{self.mod}.{d}"
+        # `from m1 import LOCK` — the cross-module opposite-order
+        # deadlock on a shared module-global lock is exactly the
+        # rule's target class, so resolve imports like calls do
+        imp = self.idx.import_from.get(self.mod, {}).get(d)
+        if imp and imp[1] in self.idx.module_locks.get(imp[0], {}):
+            return f"{imp[0]}.{imp[1]}"
+        if "." in d:
+            # `import m1` then `with m1.LOCK:`
+            head, _, rest = d.partition(".")
+            src = self.idx.import_mod.get(self.mod, {}).get(head)
+            if src and rest in self.idx.module_locks.get(src, {}):
+                return f"{src}.{rest}"
+        return None
+
+    def _walk(self, stmts, held: tuple[str, ...]) -> None:
+        for node in stmts:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                # items acquire LEFT TO RIGHT: `with a, b:` orders a
+                # before b exactly like nested withs, so each new lock
+                # gets edges from the outer held set AND from earlier
+                # items of the same statement
+                newly: list[str] = []
+                for item in node.items:
+                    lk = self._lock_of(item.context_expr)
+                    if lk:
+                        self.fn.acquires.add(lk)
+                        for h in list(held) + newly:
+                            self.fn.edges.append((h, lk, node.lineno))
+                        newly.append(lk)
+                self._scan_exprs(node, held)
+                self._walk(node.body, held + tuple(newly))
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested defs run when CALLED, not here: their
+                # acquisitions/blocking belong to the callback, not
+                # this function's summary (they are not separately
+                # indexed — under-approximate, never guess)
+                continue
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(node, attr, None)
+                if sub:
+                    self._walk(sub, held)
+            for h in getattr(node, "handlers", []):
+                self._walk(h.body, held)
+            for c in getattr(node, "cases", []):  # match statements
+                self._walk(c.body, held)
+            self._scan_exprs(node, held)
+
+    def _scan_exprs(self, stmt: ast.AST, held: tuple[str, ...]) -> None:
+        for sub in own_exprs(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            self._note_blocking(sub, held)
+            callee = self._resolve_call(sub)
+            if callee is not None:
+                self.fn.calls.append((callee, held, sub.lineno))
+
+    def _note_blocking(self, call: ast.Call, held: tuple[str, ...]) -> None:
+        if self.fn.blocks is not None:
+            return
+        d = dotted_name(call.func) or ""
+        what = None
+        if any(d == p or d.startswith(p) for p in _BLOCKING_PREFIXES):
+            what = d
+        elif isinstance(call.func, ast.Attribute):
+            m = call.func.attr
+            recv = dotted_name(call.func.value) or ""
+            if m in _BLOCKING_METHODS:
+                what = d
+            elif m in ("wait", "wait_for"):
+                # CV pattern: waiting on a lock this function holds at
+                # this point is exempt (its edges are still in the graph)
+                recv_lock = self._lock_of(call.func.value)
+                if recv_lock is None or recv_lock not in held:
+                    what = d
+            elif m == "join" and "thread" in recv.lower():
+                what = d
+        if what:
+            self.fn.blocks = (f"{what}()", call.lineno, self.fn.sf.rel)
+
+    def _resolve_call(self, call: ast.Call) -> tuple | None:
+        f = call.func
+        if isinstance(f, ast.Name):
+            key = (self.mod, None, f.id)
+            if key in self.idx.funcs:
+                return key
+            imp = self.idx.import_from.get(self.mod, {}).get(f.id)
+            if imp:
+                key = (imp[0], None, imp[1])
+                if key in self.idx.funcs:
+                    return key
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        recv = f.value
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and self.cls:
+                return self.idx.method(self.mod, self.cls, f.attr)
+            mod = self.idx.import_mod.get(self.mod, {}).get(recv.id)
+            if mod and (mod, None, f.attr) in self.idx.funcs:
+                return (mod, None, f.attr)
+            imp = self.idx.import_from.get(self.mod, {}).get(recv.id)
+            if imp:
+                full = f"{imp[0]}.{imp[1]}"
+                if (full, None, f.attr) in self.idx.funcs:
+                    return (full, None, f.attr)
+            return None
+        attr = _self_attr(recv)
+        if attr is not None and self.cls:
+            tname = self.idx.attr_types.get((self.mod, self.cls),
+                                            {}).get(attr)
+            if tname:
+                r = self.idx.resolve_class(self.mod, tname)
+                if r:
+                    return self.idx.method(r[0], r[1], f.attr)
+        return None
+
+
+def _close_summaries(idx: _Index) -> None:
+    """Fixpoint: propagate acquires/blocks through the call graph."""
+    changed = True
+    while changed:
+        changed = False
+        for fn in idx.funcs.values():
+            for callee_key, _, line in fn.calls:
+                callee = idx.funcs.get(callee_key)
+                if callee is None:
+                    continue
+                before = len(fn.acquires)
+                fn.acquires |= callee.acquires
+                if len(fn.acquires) != before:
+                    changed = True
+                if fn.blocks is None and callee.blocks is not None:
+                    what, bline, brel = callee.blocks
+                    fn.blocks = (
+                        f"{callee_key[2]}() -> {what}"
+                        if "->" not in what
+                        else f"{callee_key[2]}() -> {what.split(' -> ')[-1]}",
+                        bline, brel)
+                    changed = True
+
+
+def _find_cycles(edges: dict[str, dict[str, tuple[str, int]]],
+                 kinds: dict[str, str]) -> list[tuple[list[str], str, int]]:
+    """Cycles in the lock graph: one witness per SCC (plus non-reentrant
+    self-loops). Returns (cycle node path, witness rel, witness line)."""
+    # Tarjan SCC, iterative
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(v0: str) -> None:
+        work = [(v0, iter(sorted(edges.get(v0, {}))))]
+        index[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        on_stack.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(edges.get(w, {})))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                sccs.append(scc)
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+
+    for v in sorted(set(edges) | {t for m in edges.values() for t in m}):
+        if v not in index:
+            strongconnect(v)
+
+    out: list[tuple[list[str], str, int]] = []
+    for scc in sccs:
+        if len(scc) <= 1:
+            continue
+        members = set(scc)
+        start = min(scc)
+        # BFS within the SCC (self-edges aside) for the shortest real
+        # cycle through `start`: every consecutive pair in the reported
+        # path is an edge that actually exists in the lock graph — a
+        # fabricated closing edge would send the maintainer to reorder
+        # an acquisition no code performs
+        parent: dict[str, str] = {}
+        queue = [start]
+        cycle: list[str] | None = None
+        while queue and cycle is None:
+            cur = queue.pop(0)
+            for t in sorted(edges.get(cur, {})):
+                if t == cur or t not in members:
+                    continue
+                if t == start:
+                    path = [cur]
+                    while path[-1] != start:
+                        path.append(parent[path[-1]])
+                    cycle = list(reversed(path)) + [start]
+                    break
+                if t not in parent and t != start:
+                    parent[t] = cur
+                    queue.append(t)
+        if cycle:  # always found: an SCC is strongly connected
+            rel, line = edges[cycle[0]][cycle[1]]
+            out.append((cycle, rel, line))
+    # self-deadlocks: a non-reentrant lock nested under itself, whatever
+    # the size of its SCC
+    for v in sorted(edges):
+        if v in edges.get(v, {}) and kinds.get(v) != "reentrant":
+            rel, line = edges[v][v]
+            out.append(([v, v], rel, line))
+    return out
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    idx = _Index([sf for sf in files if sf.tree is not None])
+    if not idx.marked_mods:
+        return []
+    for fn in idx.funcs.values():
+        _FuncAnalyzer(idx, fn).run()
+    _close_summaries(idx)
+
+    findings: list[Finding] = []
+
+    # ---- interprocedural blocking-while-holding ----------------------------
+    for fn in idx.funcs.values():
+        if not fn.marked:
+            continue
+        reported: set[tuple[int, tuple]] = set()
+        for callee_key, held, line in fn.calls:
+            if not held:
+                continue
+            callee = idx.funcs.get(callee_key)
+            if callee is None or callee.blocks is None:
+                continue
+            key = (line, callee_key)
+            if key in reported:
+                continue
+            reported.add(key)
+            what, bline, brel = callee.blocks
+            findings.append(Finding(
+                RULE, fn.sf.rel, line,
+                f"call to {callee_key[2]}() while holding "
+                f"{', '.join(held)} eventually blocks: {what} "
+                f"({brel}:{bline})"))
+
+    # ---- acquisition-order cycles ------------------------------------------
+    edges: dict[str, dict[str, tuple[str, int]]] = {}
+    for fn in idx.funcs.values():
+        witness_ok = fn.marked
+        for src, dst, line in fn.edges:
+            if witness_ok:
+                edges.setdefault(src, {}).setdefault(
+                    dst, (fn.sf.rel, line))
+        for callee_key, held, line in fn.calls:
+            callee = idx.funcs.get(callee_key)
+            if callee is None:
+                continue
+            for h in held:
+                for a in sorted(callee.acquires):
+                    if witness_ok:
+                        edges.setdefault(h, {}).setdefault(
+                            a, (fn.sf.rel, line))
+    for path, rel, line in _find_cycles(edges, idx.locks):
+        findings.append(Finding(
+            RULE, rel, line,
+            "lock-order cycle (potential deadlock): "
+            + " -> ".join(path)
+            + " — acquire these locks in one global order"))
+    return sorted(findings, key=lambda f: (f.path, f.line))

@@ -25,6 +25,8 @@ and the checker enforces three things:
 Module globals support the same annotation (``X = None  # guarded-by:
 _X_LOCK``), enforced against ``with _X_LOCK:``.
 
+Subscript stores (``self.states[i] = x``, ``self._commits[key] = v``)
+count as writes to the container attribute and obey its annotation.
 Lexical limits (documented in docs/STATIC_ANALYSIS.md): container
 mutation through method calls (``self._topics[t].append``) and writes
 through aliases are invisible to this rule — the annotation convention
@@ -36,29 +38,16 @@ from __future__ import annotations
 import ast
 import re
 
-from .core import Finding, SourceFile, dotted_name
+from .core import (BLOCKING_METHODS as _BLOCKING_METHODS,
+                   BLOCKING_PREFIXES as _BLOCKING_PREFIXES,
+                   Finding, SourceFile, dotted_name, own_exprs as
+                   _own_exprs, self_attr as _self_attr)
 
 RULE = "lock-discipline"
 MARKER = "lock-checked"
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([\w.]+)")
 _UNGUARDED_RE = re.compile(r"#\s*flowlint:\s*unguarded\s*--\s*(\S.*)")
-
-_BLOCKING_PREFIXES = ("time.sleep", "subprocess.", "socket.", "requests.")
-_BLOCKING_METHODS = {"result", "communicate", "acquire", "drain"}
-
-
-def _own_exprs(node: ast.AST):
-    """The expression nodes belonging to ONE statement: recurse through
-    child nodes but stop at nested statements (their bodies are scanned
-    separately, under their own held-lock set). Expressions never contain
-    statements, so stopping at ast.stmt is exact."""
-    for child in ast.iter_child_nodes(node):
-        if isinstance(child, ast.stmt):
-            continue
-        yield child
-        yield from _own_exprs(child)
-
 
 def _line_annotation(sf: SourceFile, lineno: int):
     """(kind, value) from the guarded-by / unguarded comment on a line, or
@@ -79,11 +68,14 @@ def _line_annotation(sf: SourceFile, lineno: int):
     return None, None
 
 
-def _self_attr(node: ast.AST) -> str | None:
-    if isinstance(node, ast.Attribute) and \
-            isinstance(node.value, ast.Name) and node.value.id == "self":
-        return node.attr
-    return None
+def _self_attr_store(node: ast.AST) -> str | None:
+    """Like _self_attr but also unwraps subscript stores: a write to
+    ``self.X[i]`` (or ``self.X[i][j]``) mutates the shared container X
+    and must obey X's annotation just like a rebind (the hostsketch
+    engine's per-family state lists are exactly this shape)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return _self_attr(node)
 
 
 def _write_targets(node: ast.AST):
@@ -128,7 +120,9 @@ class _ClassChecker:
     def check(self) -> list[Finding]:
         out: list[Finding] = []
         for meth in self.cls.body:
-            if not isinstance(meth, ast.FunctionDef) or meth is self.init:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                    or meth is self.init:
                 continue
             out.extend(self._check_body(meth.body, held=[]))
         return out
@@ -148,7 +142,7 @@ class _ClassChecker:
     def _check_body(self, stmts, held: list[str]) -> list[Finding]:
         out: list[Finding] = []
         for node in stmts:
-            if isinstance(node, ast.With):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
                 newly = []
                 for item in node.items:
                     lk = self._lock_of(item.context_expr)
@@ -169,13 +163,15 @@ class _ClassChecker:
                     out.extend(self._check_body(sub, held))
             for h in getattr(node, "handlers", []):
                 out.extend(self._check_body(h.body, held))
+            for c in getattr(node, "cases", []):  # match statements
+                out.extend(self._check_body(c.body, held))
             out.extend(self._check_stmt(node, held))
         return out
 
     def _check_stmt(self, node: ast.AST, held: list[str]) -> list[Finding]:
         out: list[Finding] = []
         for t in _write_targets(node):
-            attr = _self_attr(t)
+            attr = _self_attr_store(t)
             if attr is None:
                 continue
             if attr in self.guarded:
@@ -249,7 +245,7 @@ def _check_module_globals(sf: SourceFile) -> list[Finding]:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef)):
                 continue  # each def's body is walked from its own entry
-            if isinstance(node, ast.With):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
                 newly = {dotted_name(i.context_expr)
                          for i in node.items if dotted_name(i.context_expr)}
                 walk(node.body, held | newly)
@@ -260,7 +256,11 @@ def _check_module_globals(sf: SourceFile) -> list[Finding]:
                     walk(sub, held)
             for h in getattr(node, "handlers", []):
                 walk(h.body, held)
+            for c in getattr(node, "cases", []):  # match statements
+                walk(c.body, held)
             for t in _write_targets(node):
+                while isinstance(t, ast.Subscript):  # G[k] = v mutates G
+                    t = t.value
                 if isinstance(t, ast.Name) and t.id in guarded \
                         and guarded[t.id] not in held:
                     out.append(Finding(
@@ -269,7 +269,7 @@ def _check_module_globals(sf: SourceFile) -> list[Finding]:
                         f"{guarded[t.id]}) outside `with {guarded[t.id]}:`"))
 
     for node in ast.walk(sf.tree):
-        if isinstance(node, ast.FunctionDef):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             walk(node.body, set())
     return out
 

@@ -1,16 +1,25 @@
 """flowlint runner: rule orchestration + reporting.
 
 Scope: the whole ``flow_pipeline_tpu`` package plus ``bench.py`` and
-``tests/`` (flag tokens in tests must be real flags too). Exit status:
-0 = clean, 1 = findings (printed one per line), so ``make lint`` and CI
-gate on it directly.
+``tests/`` (flag tokens in tests must be real flags too); the
+abi-contract rule additionally reads ``native/*.cc``. Exit status: 0 =
+clean, 1 = findings, so ``make lint`` and CI gate on it directly.
+``--json`` emits one machine-readable document (file/line/rule/message
+per finding) — the CI lint job turns that into per-line annotations.
 """
 
 from __future__ import annotations
 
 import sys
 
-from . import rules_flags, rules_locks, rules_purity, rules_uint64
+from . import (
+    rules_abi,
+    rules_dtype,
+    rules_flags,
+    rules_lockorder,
+    rules_locks,
+    rules_purity,
+)
 from .core import (
     Finding,
     LintResult,
@@ -21,7 +30,7 @@ from .core import (
 
 DEFAULT_SUBDIRS = ("flow_pipeline_tpu", "bench.py", "tests")
 ALL_RULES = ("jit-purity", "uint64-discipline", "lock-discipline",
-             "flag-registry")
+             "lock-order", "flag-registry", "abi-contract")
 
 
 def run_lint(root: str, rel_paths: list[str] | None = None,
@@ -46,11 +55,15 @@ def run_lint(root: str, rel_paths: list[str] | None = None,
     if "jit-purity" in selected:
         result.extend_filtered(by_rel, rules_purity.check(files))
     if "uint64-discipline" in selected:
-        result.extend_filtered(by_rel, rules_uint64.check(files))
+        result.extend_filtered(by_rel, rules_dtype.check(files))
     if "lock-discipline" in selected:
         result.extend_filtered(by_rel, rules_locks.check(files))
+    if "lock-order" in selected:
+        result.extend_filtered(by_rel, rules_lockorder.check(files))
     if "flag-registry" in selected:
         result.extend_filtered(by_rel, rules_flags.check(files, root))
+    if "abi-contract" in selected:
+        result.extend_filtered(by_rel, rules_abi.check(files, root))
     # suppressions themselves must be justified + must still bite;
     # unused-reporting is only sound when every rule actually ran
     result.findings.extend(suppression_findings(
@@ -61,27 +74,43 @@ def run_lint(root: str, rel_paths: list[str] | None = None,
 
 def main(argv: list[str]) -> int:
     import argparse
+    import json
     import os
 
     p = argparse.ArgumentParser(
         prog="flowlint",
         description="project static analysis: jit-purity, uint64 "
-                    "discipline, lock annotations, flag registry")
+                    "dtype-flow, lock annotations, lock ordering, flag "
+                    "registry, ctypes<->C ABI contract")
     p.add_argument("paths", nargs="*",
                    help="repo-relative files/dirs (default: full scope)")
     p.add_argument("--root", default=os.getcwd(),
                    help="repo root (default: cwd)")
     p.add_argument("--rule", action="append",
                    help="run only this rule (repeatable)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output: one JSON document with "
+                        "file/line/rule/message per finding")
     args = p.parse_args(argv)
 
     rels = None
     if args.paths:
         rels = discover(args.root, tuple(args.paths))
-    findings = run_lint(args.root, rels,
-                        tuple(args.rule) if args.rule else None)
-    for f in findings:
-        print(f.render())
+    selected = tuple(args.rule) if args.rule else None
+    findings = run_lint(args.root, rels, selected)
+    if args.json:
+        print(json.dumps({
+            "findings": [
+                {"file": f.path, "line": f.line, "rule": f.rule,
+                 "message": f.message}
+                for f in findings
+            ],
+            "count": len(findings),
+            "rules": list(selected or ALL_RULES),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
     if findings:
         print(f"flowlint: {len(findings)} finding(s)", file=sys.stderr)
         return 1
