@@ -4,7 +4,7 @@
 # additionally builds the native host-path library and runs the suite.
 
 .PHONY: all native test bench proto clean services-test lint native-san \
-	hostsketch-parity
+	hostsketch-parity fused-parity
 
 all: native
 
@@ -41,6 +41,15 @@ native-san:
 hostsketch-parity:
 	$(MAKE) -C native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_hostsketch.py -v
+
+# Bit-exact parity of the fused native dataplane (-ingest.fused) against
+# the staged group->sketch path, run against a FRESHLY BUILT library —
+# one C pass (group + cascade + sketch) must reproduce the staged
+# pipeline's flows_5m rows, CMS counters and top-K tables exactly
+# (docs/ARCHITECTURE.md "fused dataplane" states the contract).
+fused-parity:
+	$(MAKE) -C native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fusedplane.py -v
 
 # Real-broker/-database integration proof (VERDICT r3/r4/r5): compose up
 # Kafka (KRaft) + Postgres + ClickHouse, run the service-integration

@@ -22,6 +22,7 @@ Modes (default ``hh`` is what the driver records):
     python bench.py cms          # XLA scatter vs Pallas CMS updates (x4)
     python bench.py e2e          # full in-process pipeline flows/sec
     python bench.py hostsketch   # sketch.backend=device|host e2e A/B
+    python bench.py fused        # ingest.fused=off|on host-backend A/B
     python bench.py sharded [n]  # n-device mesh rate + merge cost
     python bench.py sweep        # batch x width x impl tuning sweep
     python bench.py trace [dir]  # jax.profiler device trace of the step
@@ -375,7 +376,8 @@ def _stage_sums() -> dict:
 
 def _run_e2e(n_flows: int, samples: int = 5,
              ingest_mode: str = "pipelined",
-             sketch_backend: str = "device") -> dict:
+             sketch_backend: str = "device",
+             ingest_fused: str = "off") -> dict:
     """Shared e2e measurement: stats + per-stage budget (VERDICT r3 #1).
 
     The budget diffs the stage summaries across the timed samples and
@@ -387,7 +389,12 @@ def _run_e2e(n_flows: int, samples: int = 5,
     single-threaded path, the A/B baseline the artifact records;
     sketch_backend="host" swaps the jitted CMS/top-K apply for the
     native hostsketch engine (the r8 A/B — device_apply share is the
-    number that leg exists to shrink)."""
+    number that leg exists to shrink); ingest_fused="on" additionally
+    collapses grouping + cascade + sketch into the single-pass native
+    dataplane (the r10 A/B — host_group + host_sketch shares are what
+    it exists to shrink). The default here is "off" so pre-r10 modes
+    (e2e, hostsketch) keep measuring the staged legs they always did —
+    bench_fused passes both settings explicitly."""
     from flow_pipeline_tpu.cli import (
         _batch_frames, _build_models, _make_generator, _processor_flags,
         _common_flags, _gen_flags,
@@ -417,7 +424,8 @@ def _run_e2e(n_flows: int, samples: int = 5,
             WorkerConfig(poll_max=vals["processor.batch"], snapshot_every=0,
                          ingest_mode=ingest_mode,
                          sketch_backend=sketch_backend,
-                         ingest_native_group=True),
+                         ingest_native_group=True,
+                         ingest_fused=ingest_fused),
         )
         t0 = time.perf_counter()
         worker.run(stop_when_idle=True)  # incl. finalize: closes + flushes
@@ -456,6 +464,7 @@ def _run_e2e(n_flows: int, samples: int = 5,
     stats["ingest_mode"] = ingest_mode
     stats["ingest_native_group"] = True  # both A/B legs (see run_stream)
     stats["sketch_backend"] = sketch_backend
+    stats["ingest_fused"] = ingest_fused
     stats["host_group_share_pct"] = stages.get(
         "host_group", {}).get("share_pct", 0.0)
     stats["flushing_share_pct"] = stages.get(
@@ -464,6 +473,31 @@ def _run_e2e(n_flows: int, samples: int = 5,
     # host leg cuts it >=2x vs the device leg on the same box)
     stats["device_apply_share_pct"] = stages.get(
         "device_apply", {}).get("share_pct", 0.0)
+    # the r10 fused-dataplane seam: host_sketch is the staged engine,
+    # host_fused the single-pass group+cascade+sketch kernel
+    stats["host_sketch_share_pct"] = stages.get(
+        "host_sketch", {}).get("share_pct", 0.0)
+    stats["host_fused_share_pct"] = stages.get(
+        "host_fused", {}).get("share_pct", 0.0)
+    # benchmarks must never quietly measure a fallback: record the
+    # loaded library's capability surface in the artifact and name any
+    # missing feature up front (a stale .so shows up here before its
+    # numbers can masquerade as the native path's)
+    from flow_pipeline_tpu import native as native_lib
+
+    stats["native_capabilities"] = native_lib.capabilities()
+    # only features this leg actually drives; stderr keeps redirected
+    # artifacts (bench.py ... > BENCH.json) parseable
+    used = {"decode", "group"}
+    if sketch_backend == "host":
+        used.add("sketch")
+    if ingest_fused == "on":
+        used.add("fused")
+    missing = sorted(used & set(native_lib.missing_features()))
+    if missing:
+        print(f"WARNING: native library cannot serve {missing} — "
+              "this leg measures fallback paths (run `make native`)",
+              file=sys.stderr)
     return stats
 
 
@@ -509,6 +543,66 @@ def bench_hostsketch() -> None:
             "hours (r06 caveat); a 2-core throttled box cannot sustain "
             "the 1M flows/s target — the portable numbers are the "
             "same-box host_speedup and the device_apply share cut"),
+        **_host_conditions(),
+    }))
+
+
+def bench_fused() -> None:
+    """Same-box fused-dataplane A/B (the BENCH_r10 artifact): the full
+    e2e pipeline on the host sketch backend with the staged
+    group->cascade->sketch path vs the single-pass native dataplane
+    (-ingest.fused). Same stream, same process; the portable numbers
+    are the same-box speedup and the host_group/host_sketch/host_fused
+    share deltas — never absolute rates across boxes or rounds (r06
+    host-variance caveat)."""
+    global _NATIVE
+    _NATIVE = _ensure_native()
+    from flow_pipeline_tpu import native as native_lib
+
+    if not native_lib.fused_available():
+        print(json.dumps({"error": "libflowdecode lacks the fused "
+                          "dataplane", "hint": "make native"}))
+        return
+    staged = _run_e2e(E2E_FLOWS, samples=3, sketch_backend="host",
+                      ingest_fused="off")
+    fused = _run_e2e(E2E_FLOWS, samples=3, sketch_backend="host",
+                     ingest_fused="on")
+    group_shares = {
+        "host_group_share_staged_pct": staged["host_group_share_pct"],
+        "host_group_share_fused_pct": fused["host_group_share_pct"],
+        "host_sketch_share_staged_pct": staged["host_sketch_share_pct"],
+        "host_sketch_share_fused_pct": fused["host_sketch_share_pct"],
+        "host_fused_share_pct": fused["host_fused_share_pct"],
+    }
+    print(json.dumps({
+        "metric": "e2e fused-dataplane A/B (single-pass group+sketch)",
+        "unit": "flows/sec",
+        "value": fused["value"],
+        "staged_flows_per_sec": staged["value"],
+        "fused_flows_per_sec": fused["value"],
+        "fused_speedup": round(fused["value"] / staged["value"], 3)
+        if staged["value"] else 0.0,
+        **group_shares,
+        # the r10 acceptance number: everything the staged path spent
+        # between decode and the jitted rest-step, vs the fused pass
+        "staged_group_plus_sketch_pct": round(
+            staged["host_group_share_pct"]
+            + staged["host_sketch_share_pct"], 1),
+        "fused_group_plus_sketch_pct": round(
+            fused["host_group_share_pct"]
+            + fused["host_fused_share_pct"]
+            + fused["host_sketch_share_pct"], 1),
+        "stages_staged": staged["stages"],
+        "stages_fused": fused["stages"],
+        "spread_pct_staged": staged["spread_pct"],
+        "spread_pct_fused": fused["spread_pct"],
+        "native_decode": _NATIVE,
+        "native_capabilities": native_lib.capabilities(),
+        "platform": _PLATFORM,
+        "host_note": (
+            "bench boxes differ 3-4x between rounds and swing within "
+            "hours (r06 caveat); judge by the same-box fused_speedup "
+            "and the share deltas, never cross-round absolutes"),
         **_host_conditions(),
     }))
 
@@ -829,6 +923,8 @@ if __name__ == "__main__":
         bench_e2e()
     elif mode == "hostsketch":
         bench_hostsketch()
+    elif mode == "fused":
+        bench_fused()
     elif mode == "sharded":
         bench_sharded(int(sys.argv[2]) if len(sys.argv) > 2 else 8)
     elif mode == "sweep":

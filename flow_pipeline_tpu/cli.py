@@ -237,6 +237,11 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.boolean("ingest.native_group", True,
                "Group with the native radix kernel (libflowdecode); "
                "falls back to numpy when unbuilt")
+    fs.string("ingest.fused", "auto",
+              "Single-pass fused native dataplane (group->cascade->"
+              "sketch in one C pass): auto (on when sketch.backend=host "
+              "and libflowdecode exports it) | on (required — errors "
+              "when it cannot serve) | off (staged parity reference)")
     fs.string("checkpoint.path", "", "Snapshot directory")
     fs.integer("flush.count", 50, "Batches between snapshots")
     fs.string("metrics.addr", "127.0.0.1:8081", "host:port for /metrics "
@@ -408,6 +413,7 @@ def processor_main(argv=None) -> int:
                 ingest_depth=vals["ingest.depth"],
                 ingest_flush_queue=vals["ingest.flush_queue"],
                 ingest_native_group=vals["ingest.native_group"],
+                ingest_fused=vals["ingest.fused"],
             ),
         )
         if vals["query.addr"]:
@@ -561,7 +567,8 @@ def pipeline_main(argv=None) -> int:
                      ingest_shards=vals["ingest.shards"],
                      ingest_depth=vals["ingest.depth"],
                      ingest_flush_queue=vals["ingest.flush_queue"],
-                     ingest_native_group=vals["ingest.native_group"]),
+                     ingest_native_group=vals["ingest.native_group"],
+                     ingest_fused=vals["ingest.fused"]),
     )
     query = None
     if vals["query.addr"]:

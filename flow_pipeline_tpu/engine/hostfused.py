@@ -47,13 +47,46 @@ from ..ingest.shard import ShardPool, group_by_key_sharded, shared_pool
 from ..models import heavy_hitter as hh
 from ..models.ddos import _accumulate_grouped
 from ..models.dense_top import dense_update
-from ..obs import get_logger
+from ..obs import REGISTRY, get_logger
 from ..obs.tracing import StageTimer
 from ..ops.hostgroup import native_group_available, select_lanes
 from ..schema.batch import FlowBatch, lane_width
 from .fused import FusedPipeline
 
 log = get_logger("hostfused")
+
+_DEGRADED_GAUGE = (
+    "native_path_degraded",
+    "1 when a requested native dataplane feature fell back to the slow "
+    "path (label: feature) — benchmarks must check this is 0",
+)
+
+
+def report_native_degradation(feature: str, reason: str) -> None:
+    """A requested native-dataplane feature falling back to numpy must be
+    LOUD: a startup warning AND a scrapeable gauge. A log line alone let
+    pre-r6 .so builds quietly serve numpy grouping under benchmarks that
+    believed they measured the C kernel."""
+    REGISTRY.gauge(*_DEGRADED_GAUGE).set(1, feature=feature)
+    log.warning(
+        "NATIVE PATH DEGRADED [%s]: %s — throughput from this process "
+        "measures the fallback path; run `make native` (or rebuild the "
+        "stale .so) for the fast path", feature, reason)
+
+
+def mark_native_serving(feature: str) -> None:
+    """Publish the healthy 0 explicitly so dashboards and bench capture
+    can assert on the series instead of inferring from its absence."""
+    REGISTRY.gauge(*_DEGRADED_GAUGE).set(0, feature=feature)
+
+
+def _degradation_reason(symbol: str, since: str) -> str:
+    from .. import native
+
+    if not native.available():
+        return "libflowdecode.so is not built or failed to load"
+    return (f"loaded libflowdecode.so is stale (pre-{since}: "
+            f"no {symbol} export)")
 
 
 class PreparedChunk(NamedTuple):
@@ -67,6 +100,11 @@ class PreparedChunk(NamedTuple):
     hh_in: Optional[list]     # per hh family: (u [B,W], s [B,P+1], g)
     dense_in: Optional[tuple]  # (dcols padded, dvalid) or None
     ddos_in: Optional[tuple]   # (u [B,4], s [B], g) or None
+    # fused dataplane (hostsketch/pipeline.py, -ingest.fused): per tree
+    # (root lanes [N,W] u32, value planes [N,P] f32) — grouping, cascade
+    # AND sketch updates all happen in ONE native pass at apply time, so
+    # no hh group tables are materialized here. None = staged path.
+    fused_in: Optional[list] = None
 
 
 class PreparedBatch(NamedTuple):
@@ -181,8 +219,10 @@ class HostGroupPipeline(FusedPipeline):
         # serves so operators can tell from the log.
         self._native = native_group and native_group_available()
         if native_group and not self._native:
-            log.warning("ingest.native_group requested but libflowdecode "
-                        "lacks flow_hash_group; using numpy grouping")
+            report_native_degradation(
+                "group", _degradation_reason("flow_hash_group", "r6"))
+        elif native_group:
+            mark_native_serving("group")
         self._shards = shards
         self._pool = None if shards == 1 else (pool or shared_pool())
         self._widths = {}
@@ -288,8 +328,13 @@ class HostGroupPipeline(FusedPipeline):
             lanes.append(_u32_lane(cols[cfg.scale_col])[:, None])
         lanes = np.concatenate(lanes, axis=1)
         planes = [np.minimum(cols[name], _U32_MAX) for name in cfg.value_cols]
-        uniq, sums, counts = self._group(
-            lanes, [np.stack(planes, axis=1)], exact=True)
+        return self._group_exact_planes(lanes, np.stack(planes, axis=1))
+
+    def _group_exact_planes(self, lanes: np.ndarray, planes: np.ndarray):
+        """Exact groupby-sum of stacked [N, P] uint64 planes — the
+        flows_5m substrate. Seam: the fused pipeline overrides this with
+        the single-pass ff_group_sum kernel."""
+        uniq, sums, counts = self._group(lanes, [planes], exact=True)
         return uniq, sums[0], counts
 
     def _group_families(self, cols: dict) -> list[tuple]:
@@ -354,35 +399,45 @@ class HostGroupPipeline(FusedPipeline):
             s[:g, :P] = vsum
             s[:g, P] = cnt
             hh_in.append((u, s, g))
-        dense_in = None
-        if self._dense:
-            need = set()
-            for _, w in self._dense:
-                need.add(w.config.key_col)
-                need.update(w.config.value_cols)
-                if w.config.scale_col:
-                    need.add(w.config.scale_col)
-            bs = self._bs
-            dcols = {}
-            for name in need:
-                src = _u32_lane(cols[name])
-                a = np.zeros(bs, np.uint32)
-                a[:n] = src
-                dcols[name] = a.view(np.int32)
-            dvalid = np.zeros(bs, bool)
-            dvalid[:n] = True
-            dense_in = (dcols, dvalid)
         ddos_in = None
         if self._ddos_plan is not None:
             uniq, dsum = fams[-1]
-            g = uniq.shape[0]
-            B = _pow2_bucket(g, hi=hi)
-            u = np.zeros((B, 4), np.uint32)
-            s = np.zeros(B, np.float32)
-            u[:g] = uniq
-            s[:g] = dsum
-            ddos_in = (u, s, g)
-        return hh_in, dense_in, ddos_in
+            ddos_in = self._pad_ddos(uniq, dsum)
+        return hh_in, self._prep_dense(cols, n), ddos_in
+
+    def _prep_dense(self, cols: dict, n: int):
+        """Dense-model columns padded to the static batch shape (shared
+        by the staged and fused prepare halves)."""
+        if not self._dense:
+            return None
+        need = set()
+        for _, w in self._dense:
+            need.add(w.config.key_col)
+            need.update(w.config.value_cols)
+            if w.config.scale_col:
+                need.add(w.config.scale_col)
+        bs = self._bs
+        dcols = {}
+        for name in need:
+            src = _u32_lane(cols[name])
+            a = np.zeros(bs, np.uint32)
+            a[:n] = src
+            dcols[name] = a.view(np.int32)
+        dvalid = np.zeros(bs, bool)
+        dvalid[:n] = True
+        return (dcols, dvalid)
+
+    def _pad_ddos(self, uniq: np.ndarray, dsum: np.ndarray):
+        """Pad a per-dst group table to its power-of-two bucket for the
+        jitted accumulate (shared by the staged prepare and the fused
+        apply, which receives the table from the native pass)."""
+        g = uniq.shape[0]
+        B = _pow2_bucket(g, hi=max(self._bs, 1024))
+        u = np.zeros((B, 4), np.uint32)
+        s = np.zeros(B, np.float32)
+        u[:g] = uniq
+        s[:g] = dsum
+        return (u, s, g)
 
     # ---- apply half: lifecycle + model state -------------------------------
 
@@ -399,7 +454,7 @@ class HostGroupPipeline(FusedPipeline):
                 for (_, m), rows in zip(self._waggs, ch.wagg):
                     m.add_host_rows(*rows)
                 if ch.hh_in is None and ch.dense_in is None \
-                        and ch.ddos_in is None:
+                        and ch.ddos_in is None and ch.fused_in is None:
                     continue
                 if not (do_hh or do_dd):
                     continue  # late part: device models take nothing

@@ -69,6 +69,13 @@ class WorkerConfig:
     ingest_depth: int = 2        # prepared batches held ready
     ingest_flush_queue: int = 8  # queued background flush jobs (bound)
     ingest_native_group: bool = False  # C hash-group kernel (numpy fallback)
+    # Single-pass fused native dataplane (native/flowfused.cc): "auto"
+    # runs group->cascade->sketch in one C pass whenever the host sketch
+    # backend is active and the library exports it (falling back to the
+    # staged path LOUDLY — gauge + warning — when the .so is stale);
+    # "on" demands it (raises when it cannot serve); "off" keeps the
+    # staged prepare/apply split, the bit-exact parity reference.
+    ingest_fused: str = "auto"
     # Full-fidelity raw archiving (the reference's flows_raw path,
     # ref: compose/clickhouse/create.sh:36-62): every consumed batch is
     # handed to sinks exposing archive_raw(batch). Off by default — the
@@ -105,6 +112,14 @@ class StreamWorker:
             raise ValueError(
                 f"sketch_backend must be device|host, "
                 f"got {config.sketch_backend!r}")
+        if config.ingest_fused not in ("auto", "on", "off"):
+            raise ValueError(
+                f"ingest_fused must be auto|on|off, "
+                f"got {config.ingest_fused!r}")
+        if config.ingest_fused == "on" and config.sketch_backend != "host":
+            raise ValueError(
+                "ingest_fused='on' requires sketch_backend='host' — the "
+                "fused pass updates the host sketch engine in place")
         self.fused = None
         if config.fused and models:
             from .fused import FusedPipeline
@@ -117,7 +132,8 @@ class StreamWorker:
 
                     self.fused = HostSketchPipeline(
                         models, shards=config.ingest_shards,
-                        native_group=config.ingest_native_group)
+                        native_group=config.ingest_native_group,
+                        fused=config.ingest_fused)
                 elif config.sketch_backend == "host":
                     # the host engine consumes the host-grouped prepare
                     # tables; without them there is nothing to feed it
@@ -134,6 +150,20 @@ class StreamWorker:
                     self.fused = FusedPipeline(models)
             else:
                 log.info("model set not fusable; using per-model updates")
+        if config.ingest_fused == "on":
+            # "on" is a hard requirement everywhere, not just inside the
+            # pipeline constructor: any selection-level fallback above
+            # (non-fusable models, host grouping ineligible, fused=False)
+            # would otherwise silently run the staged/device path under a
+            # flag that documents "errors when it cannot serve"
+            from ..hostsketch import HostSketchPipeline
+
+            if not isinstance(self.fused, HostSketchPipeline):
+                raise RuntimeError(
+                    "ingest_fused='on' but the host sketch pipeline was "
+                    "not selected — it needs a fusable model set and "
+                    "host-grouped pre-aggregation (CPU backend or "
+                    "-processor.hostassist on)")
         # Pipelined ingest runtime: a group thread prepares batch N+1
         # while this thread applies batch N, and a background flusher
         # takes window extraction + sink writes off the hot path. Only

@@ -31,7 +31,16 @@ from typing import Optional
 
 import numpy as np
 
-from ..engine.hostfused import HostGroupPipeline, PreparedChunk, _cached_apply
+from ..engine.hostfused import (
+    HostGroupPipeline,
+    PreparedChunk,
+    _cached_apply,
+    _degradation_reason,
+    _key_lanes_np,
+    _value_planes_np,
+    mark_native_serving,
+    report_native_degradation,
+)
 from ..ingest.shard import ShardPool
 from ..obs import get_logger
 from .engine import HostSketchEngine, sketch_backend_available
@@ -40,20 +49,30 @@ log = get_logger("hostsketch")
 
 
 class HostSketchPipeline(HostGroupPipeline):
-    """Host-grouped pipeline with the native host sketch apply half."""
+    """Host-grouped pipeline with the native host sketch apply half.
+
+    ``fused`` selects the single-pass native dataplane (-ingest.fused):
+    "on"/"auto" route every hh family tree through ``ff_fused_update`` —
+    radix groupby, cascade regroup AND CMS/prefilter/top-K updates in
+    one C pass at apply time, no intermediate group rows surfacing to
+    Python — while "off" (and any box whose library predates the fused
+    exports) keeps the staged prepare/apply split, which doubles as the
+    bit-exact parity reference (tests/test_fusedplane.py)."""
 
     def __init__(self, models: dict, shards: int = 0,
                  native_group: bool = False,
                  pool: Optional[ShardPool] = None,
-                 sketch_native: str = "auto"):
+                 sketch_native: str = "auto",
+                 fused: str = "auto"):
         super().__init__(models, shards=shards, native_group=native_group,
                          pool=pool)
         self._engine = HostSketchEngine(
             [w.config for _, w in self._hh], use_native=sketch_native)
         if not self._engine.native and sketch_native != "numpy":
-            log.warning("hostsketch native engine unavailable "
-                        "(libflowdecode lacks hs_cms_update); using the "
-                        "numpy twin — run `make native` for the fast path")
+            report_native_degradation(
+                "sketch", _degradation_reason("hs_cms_update", "r8"))
+        elif self._engine.native:
+            mark_native_serving("sketch")
         # The jitted rest-step covers what the engine does not: dense
         # port scatters + the DDoS accumulate. Same module-level cache
         # as the full apply, keyed with no hh families.
@@ -68,19 +87,198 @@ class HostSketchPipeline(HostGroupPipeline):
         self._shadow: list = [None] * len(self._hh)
         # flowlint: unguarded -- worker thread only (apply/sync under worker.lock)
         self._sketch_dirty: list = [False] * len(self._hh)
+        # flowlint: unguarded -- resolved once at construction (_init_fused), read-only after
+        self._fused: bool = False
+        # flowlint: unguarded -- built once at construction (_init_fused), read-only after
+        self._fused_trees: list = []
+        self._init_fused(fused, sketch_native)
+
+    # ---- fused dataplane plan ---------------------------------------------
+
+    def _init_fused(self, fused: str, sketch_native: str) -> None:
+        """Resolve the -ingest.fused mode and precompute the per-tree
+        FusedPlan parameter blocks (static per pipeline; only lanes,
+        value planes and state pointers vary per chunk)."""
+        from .. import native
+
+        if fused not in ("auto", "on", "off"):
+            raise ValueError(f"fused must be auto|on|off, got {fused!r}")
+        can = native.fused_available() and self._engine.native
+        if fused == "on" and not can:
+            raise RuntimeError(
+                "ingest.fused=on but the fused native dataplane cannot "
+                "serve: " + ("the sketch engine is not native"
+                             if native.fused_available() else
+                             _degradation_reason("ff_fused_update", "r10")))
+        self._fused = fused != "off" and can
+        if fused == "auto" and not can and sketch_native != "numpy":
+            # production default wanted the fused plane: degrading to the
+            # staged path must be loud (same contract as native_group)
+            report_native_degradation(
+                "fused", _degradation_reason("ff_fused_update", "r10")
+                if not native.fused_available()
+                else "sketch engine is not native")
+        elif self._fused:
+            mark_native_serving("fused")
+        if not self._fused:
+            return  # staged mode never reads the tree plans
+        # Family trees from _fam_plan: each "own" family roots a tree;
+        # every cascade family joins its (possibly chained) parent's
+        # tree, parents placed before children — the order ff_fused_
+        # update requires.
+        members: dict[int, list[int]] = {}
+        root_of: dict[int, int] = {}
+        for i, plan in enumerate(self._fam_plan):
+            if plan[0] == "own":
+                members[i] = [i]
+                root_of[i] = i
+        pending = [i for i, pl in enumerate(self._fam_plan)
+                   if pl[0] == "cascade"]
+        while pending:
+            rest = []
+            for i in pending:
+                parent = self._fam_plan[i][1]
+                if parent in root_of:
+                    r = root_of[parent]
+                    members[r].append(i)
+                    root_of[i] = r
+                else:
+                    rest.append(i)
+            assert len(rest) < len(pending), "cascade chain has no root"
+            pending = rest
+        cfgs = [w.config for _, w in self._hh]
+        self._fused_trees = []
+        for root in sorted(members):
+            ms = members[root]
+            pos = {fam: k for k, fam in enumerate(ms)}
+            parent = [-1]
+            sel: list[int] = []
+            sel_off = [0, 0]  # root consumes no selection
+            for fam in ms[1:]:
+                _, par, fsel = self._fam_plan[fam]
+                parent.append(pos[par])
+                sel.extend(fsel)
+                sel_off.append(len(sel))
+            ddos_parent, ddos_sel, ddos_plane = -1, None, -1
+            if (self._ddos_plan is not None
+                    and self._ddos_plan[0] == "cascade"
+                    and self._ddos_plan[1] in pos):
+                _, dpar, dsel, dplane = self._ddos_plan
+                ddos_parent = pos[dpar]
+                ddos_sel = np.asarray(dsel, np.int64)
+                ddos_plane = dplane
+            self._fused_trees.append((ms, native.FusedPlan(
+                parent=np.asarray(parent, np.int64),
+                sel=np.asarray(sel, np.int64),
+                sel_off=np.asarray(sel_off, np.int64),
+                depth=np.asarray([cfgs[f].depth for f in ms], np.int64),
+                width=np.asarray([cfgs[f].width for f in ms], np.int64),
+                cap=np.asarray([cfgs[f].capacity for f in ms], np.int64),
+                conservative=np.asarray(
+                    [cfgs[f].conservative for f in ms], np.uint8),
+                prefilter=np.asarray(
+                    [cfgs[f].table_prefilter for f in ms], np.uint8),
+                admission_plain=np.asarray(
+                    [cfgs[f].table_admission == "plain" for f in ms],
+                    np.uint8),
+                ddos_parent=ddos_parent, ddos_sel=ddos_sel,
+                ddos_plane=ddos_plane)))
+
+    # ---- prepare half (fused: lane extraction only) ------------------------
+
+    def _prepare_chunk(self, cols: dict, n: int) -> PreparedChunk:
+        if not self._fused:
+            return super()._prepare_chunk(cols, n)
+        # Fused dataplane: NO hh group tables here — grouping + cascade +
+        # sketch all happen in one native pass at apply time. The
+        # prepare half only extracts lanes/planes (vectorized numpy) and
+        # keeps the inputs the jitted rest-step still needs.
+        wagg = [self._wagg_rows(m, cols, n) for _, m in self._waggs]
+        ddos_in = None
+        if self._ddos_plan is not None and self._ddos_plan[0] == "own":
+            # no hh family carries dst_addr: group raw rows exactly like
+            # the staged path — this table never rides the fused pass
+            dcfg = self._ddos[0][1].config
+            lanes = _key_lanes_np(cols, ("dst_addr",))
+            vals = _value_planes_np(cols, (dcfg.value_col,),
+                                    dcfg.scale_col)[:, 0]
+            uniq, sums, _ = self._group(lanes, [vals], exact=False)
+            ddos_in = self._pad_ddos(uniq, sums[0].astype(np.float32))
+        fused_in = []
+        for ms, _plan in self._fused_trees:
+            cfg = self._hh[ms[0]][1].config
+            lanes = np.ascontiguousarray(
+                _key_lanes_np(cols, cfg.key_cols), dtype=np.uint32)
+            vals = np.ascontiguousarray(
+                _value_planes_np(cols, cfg.value_cols, cfg.scale_col),
+                dtype=np.float32)
+            fused_in.append((lanes, vals))
+        return PreparedChunk(wagg, None, self._prep_dense(cols, n),
+                             ddos_in, fused_in)
+
+    def _group_exact_planes(self, lanes: np.ndarray, planes: np.ndarray):
+        if self._fused:
+            from .. import native
+
+            res = native.group_sum(lanes, planes)
+            if res is not None:
+                return res
+            # 64-bit hash collision between distinct keys (~n^2/2^65):
+            # the staged path takes its exact lexicographic fallback
+        return super()._group_exact_planes(lanes, planes)
 
     # ---- apply half --------------------------------------------------------
 
     def _timed_apply_chunk(self, ch: PreparedChunk, do_hh: bool,
                            do_dd: bool) -> None:
-        # split attribution: host_sketch is the native engine,
-        # device_apply what remains jitted — so the A/B's per-stage
-        # budget compares the same seam under both backends
+        # split attribution: host_fused is the single-pass native
+        # dataplane, host_sketch the staged engine, device_apply what
+        # remains jitted — so the A/B's per-stage budget compares the
+        # same seam under every backend/mode combination
         self._apply_chunk(ch, do_hh, do_dd)
+
+    def _run_fused(self, ch: PreparedChunk, do_hh: bool, do_dd: bool):
+        """The single native pass per family tree: group + cascade +
+        sketch-update in ff_fused_update. Returns the padded ddos table
+        when one tree carries the per-dst cascade (else ch.ddos_in,
+        which holds the "own"-grouped table or None)."""
+        from .. import native
+
+        ddos_in = ch.ddos_in
+        need_ddos = do_dd and any(
+            plan.ddos_parent >= 0 for _, plan in self._fused_trees)
+        if not (do_hh or need_ddos):
+            return ddos_in
+        with self.stages.stage("host_fused"):
+            for (ms, plan), (lanes, vals) in zip(self._fused_trees,
+                                                 ch.fused_in):
+                tree_ddos = plan.ddos_parent >= 0
+                if not (do_hh or (need_ddos and tree_ddos)):
+                    continue
+                states = None
+                if do_hh:
+                    for i in ms:
+                        self._ensure_imported(i)
+                    states = [self._engine.states[i] for i in ms]
+                # do_dd False: _apply_chunk would discard the table —
+                # skip the native per-dst regroup and its output buffers
+                res = native.fused_update(lanes, vals, plan, states,
+                                          do_sketch=do_hh,
+                                          do_ddos=need_ddos and tree_ddos,
+                                          threads=self._engine.threads)
+                if do_hh:
+                    for i in ms:
+                        self._sketch_dirty[i] = True
+                if res is not None:
+                    ddos_in = self._pad_ddos(res[0], res[1])
+        return ddos_in
 
     def _apply_chunk(self, ch: PreparedChunk, do_hh: bool,
                      do_dd: bool) -> None:
-        if do_hh and ch.hh_in is not None:
+        raw_ddos = ch.ddos_in
+        if ch.fused_in is not None:
+            raw_ddos = self._run_fused(ch, do_hh, do_dd)
+        elif do_hh and ch.hh_in is not None:
             with self.stages.stage("host_sketch"):
                 for i, (u, s, g) in enumerate(ch.hh_in):
                     self._ensure_imported(i)
@@ -92,8 +290,8 @@ class HostSketchPipeline(HostGroupPipeline):
             return
         dense_in = ch.dense_in if (self._dense and do_hh) else None
         ddos_in = None
-        if ch.ddos_in is not None and do_dd:
-            u, s, g = ch.ddos_in
+        if raw_ddos is not None and do_dd:
+            u, s, g = raw_ddos
             v = np.zeros(u.shape[0], bool)
             v[:g] = True
             ddos_in = (u, s, v)

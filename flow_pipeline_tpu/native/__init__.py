@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
@@ -144,11 +145,76 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_void_p,  # [n] uint8 valid (NULL = all)
             ctypes.c_longlong,
         ]
+    if hasattr(lib, "ff_group_sum"):  # pre-r10 .so lacks the fused plane
+        lib.ff_group_sum.restype = ctypes.c_longlong
+        lib.ff_group_sum.argtypes = [
+            ctypes.c_void_p,  # [n, w] uint32 lanes
+            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, p] uint64 value planes
+            ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, w] uint32 uniq out
+            ctypes.c_void_p,  # [n, p] uint64 sums out
+            ctypes.c_void_p,  # [n] int64 counts out
+        ]
+    if hasattr(lib, "ff_fused_update"):
+        lib.ff_fused_update.restype = ctypes.c_longlong
+        lib.ff_fused_update.argtypes = [
+            ctypes.c_void_p,  # [n, w] uint32 root lanes
+            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, p] float32 value planes
+            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [nf] int64 parent
+            ctypes.c_void_p,  # [sel_off[nf]] int64 child lane selections
+            ctypes.c_void_p,  # [nf+1] int64 sel offsets
+            ctypes.c_void_p,  # [nf] int64 depth
+            ctypes.c_void_p,  # [nf] int64 width
+            ctypes.c_void_p,  # [nf] int64 capacity
+            ctypes.c_void_p,  # [nf] uint8 conservative
+            ctypes.c_void_p,  # [nf] uint8 prefilter
+            ctypes.c_void_p,  # [nf] uint8 admission==plain
+            ctypes.POINTER(ctypes.c_void_p),  # [nf] cms buffers
+            ctypes.POINTER(ctypes.c_void_p),  # [nf] table key buffers
+            ctypes.POINTER(ctypes.c_void_p),  # [nf] table val buffers
+            ctypes.c_int,     # do_sketch
+            ctypes.c_longlong,  # ddos parent family (-1 = none)
+            ctypes.c_void_p,  # [ddos_sel_w] int64 ddos lane selection
+            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, ddos_sel_w] uint32 ddos keys out
+            ctypes.c_void_p,  # [n] float32 ddos sums out
+            ctypes.c_int,     # threads
+        ]
     return lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+# Feature -> witness symbol: the capability surface operators and the
+# degradation report key off. Each entry marks an .so generation (r1
+# decode, r6 group, r8 sketch, r10 fused) — a stale build silently
+# lacking the newer symbols is exactly what missing_features() exists
+# to make loud (gauge + startup warning, engine/hostfused.py).
+_FEATURE_SYMBOLS = {
+    "decode": "flow_decode_stream",
+    "group": "flow_hash_group",
+    "sketch": "hs_cms_update",
+    "fused": "ff_fused_update",
+}
+
+
+def capabilities() -> dict:
+    """Per-feature availability of the loaded library ({} keys always
+    present; all False when no library loads at all)."""
+    lib = _load()
+    return {feat: bool(lib is not None and hasattr(lib, sym))
+            for feat, sym in _FEATURE_SYMBOLS.items()}
+
+
+def missing_features() -> list[str]:
+    """Features the loaded (or absent) library cannot serve — what a
+    startup banner should name before any fallback quietly engages."""
+    return [feat for feat, ok in capabilities().items() if not ok]
 
 
 def reload() -> bool:
@@ -339,6 +405,154 @@ def hs_topk_merge(table_keys: np.ndarray, table_vals: np.ndarray,
         raise ValueError(f"hs_topk_merge failed (rc={rc}): degenerate "
                          f"shape cap={cap} kw={kw} planes={planes}")
     return int(rc)
+
+
+def fused_available() -> bool:
+    """Whether the loaded library exports the fused dataplane (an .so
+    built before r10 decodes, groups and sketches fine but cannot run
+    the single-pass group->cascade->sketch update)."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "ff_fused_update")
+
+
+def group_sum(lanes: np.ndarray, vals: np.ndarray):
+    """Single-pass exact groupby-sum (ff_group_sum): the native twin of
+    ops.hostgroup.group_by_key(exact=True) over integer planes.
+
+    lanes [n, w] uint32; vals [n, p] uint64. Returns (uniq [G, w] u32,
+    sums [G, p] u64, counts [G] i64), or None on a 64-bit hash collision
+    between distinct key rows — the caller re-groups lexicographically,
+    the same contract the numpy path honors."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "ff_group_sum"):
+        raise RuntimeError("libflowdecode.so missing the fused dataplane; "
+                           "run `make native`")
+    lanes = np.ascontiguousarray(lanes, dtype=np.uint32)
+    vals = np.ascontiguousarray(vals, dtype=np.uint64)
+    n, w = lanes.shape
+    p = vals.shape[1]
+    if vals.shape[0] != n:
+        # C iterates vals by lane row count — a shorter vals would read
+        # out of bounds, and no rc can report it after the fact
+        raise ValueError(f"lanes rows ({n}) != vals rows "
+                         f"({vals.shape[0]})")
+    uniq = np.empty((n, w), np.uint32)
+    sums = np.empty((n, p), np.uint64)
+    counts = np.empty(max(n, 1), np.int64)
+    g = lib.ff_group_sum(_c_arr(lanes), n, w, _c_arr(vals), p,
+                         _c_arr(uniq), _c_arr(sums), _c_arr(counts))
+    if g == -2:
+        return None  # 64-bit collision: caller takes the exact fallback
+    if g < 0:
+        raise ValueError(f"ff_group_sum failed (rc={g})")
+    g = int(g)
+    return uniq[:g], sums[:g], counts[:g]
+
+
+@dataclass(frozen=True)
+class FusedPlan:
+    """Static per-tree parameter block for fused_update — built once per
+    pipeline from engine/hostfused.py's _fam_plan (hostsketch/pipeline),
+    reused every chunk. Family 0 is the tree's root ("own") family;
+    parents precede children."""
+
+    parent: np.ndarray            # [nf] int64; -1 = root
+    sel: np.ndarray               # [sel_off[nf]] int64 child lane picks
+    sel_off: np.ndarray           # [nf+1] int64
+    depth: np.ndarray             # [nf] int64
+    width: np.ndarray             # [nf] int64
+    cap: np.ndarray               # [nf] int64
+    conservative: np.ndarray      # [nf] uint8
+    prefilter: np.ndarray         # [nf] uint8
+    admission_plain: np.ndarray   # [nf] uint8
+    ddos_parent: int = -1         # family index, -1 = no ddos side table
+    ddos_sel: Optional[np.ndarray] = None  # [ddos_sel_w] int64
+    ddos_plane: int = -1
+
+
+def fused_update(lanes: np.ndarray, vals: np.ndarray, plan: FusedPlan,
+                 states, do_sketch: bool, do_ddos: bool = True,
+                 threads: int = 1):
+    """One fused group->cascade->sketch pass over a chunk's root-family
+    lanes (ff_fused_update): every family's CMS/prefilter/top-K state in
+    ``states`` (HostHHState per family, plan order) is updated IN PLACE;
+    the only surfaced output is the DDoS per-dst side table.
+
+    lanes [n, w] uint32; vals [n, p] float32 (pre-scaled value planes —
+    the count plane is appended natively). ``do_sketch=False`` runs the
+    grouping only (late parts that still need the ddos table); states
+    may then be None. ``do_ddos=False`` skips the plan's per-dst cascade
+    (native regroup + output buffers) when the caller would discard the
+    table — a late ddos sub-window. Returns (ddos_uniq [G, dw] u32,
+    ddos_sums [G] f32) or None when no ddos table was produced."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "ff_fused_update"):
+        raise RuntimeError("libflowdecode.so missing the fused dataplane; "
+                           "run `make native`")
+    lanes = np.ascontiguousarray(lanes, dtype=np.uint32)
+    vals = np.ascontiguousarray(vals, dtype=np.float32)
+    n, w = lanes.shape
+    p = vals.shape[1]
+    if vals.shape[0] != n:
+        # the fused pass folds vals rows into in-place sketch state by
+        # lane row index — reject the mismatch before any state is
+        # touched (same contract as the oob lane-selection check)
+        raise ValueError(f"lanes rows ({n}) != vals rows "
+                         f"({vals.shape[0]})")
+    parent = np.ascontiguousarray(plan.parent, dtype=np.int64)
+    sel = np.ascontiguousarray(plan.sel, dtype=np.int64)
+    sel_off = np.ascontiguousarray(plan.sel_off, dtype=np.int64)
+    depth = np.ascontiguousarray(plan.depth, dtype=np.int64)
+    width = np.ascontiguousarray(plan.width, dtype=np.int64)
+    cap = np.ascontiguousarray(plan.cap, dtype=np.int64)
+    conserv = np.ascontiguousarray(plan.conservative, dtype=np.uint8)
+    prefilter = np.ascontiguousarray(plan.prefilter, dtype=np.uint8)
+    plain = np.ascontiguousarray(plan.admission_plain, dtype=np.uint8)
+    nf = parent.shape[0]
+    cms_ptrs = (ctypes.c_void_p * nf)()
+    tkey_ptrs = (ctypes.c_void_p * nf)()
+    tval_ptrs = (ctypes.c_void_p * nf)()
+    if do_sketch:
+        for i, st in enumerate(states):
+            assert st.cms.dtype == np.uint64 and st.cms.flags["C_CONTIGUOUS"]
+            assert st.table_keys.dtype == np.uint32 and \
+                st.table_keys.flags["C_CONTIGUOUS"]
+            assert st.table_vals.dtype == np.float32 and \
+                st.table_vals.flags["C_CONTIGUOUS"]
+            cms_ptrs[i] = st.cms.ctypes.data_as(ctypes.c_void_p).value
+            tkey_ptrs[i] = st.table_keys.ctypes.data_as(
+                ctypes.c_void_p).value
+            tval_ptrs[i] = st.table_vals.ctypes.data_as(
+                ctypes.c_void_p).value
+    ddos_keys = ddos_sums = None
+    ddos_sel_ptr = None
+    ddos_parent = -1
+    ddos_sel_w = 0
+    if do_ddos and plan.ddos_parent >= 0:
+        ddos_parent = plan.ddos_parent
+        ddos_sel = np.ascontiguousarray(plan.ddos_sel, dtype=np.int64)
+        ddos_sel_w = ddos_sel.shape[0]
+        ddos_sel_ptr = _c_arr(ddos_sel)
+        ddos_keys = np.empty((max(n, 1), ddos_sel_w), np.uint32)
+        ddos_sums = np.empty(max(n, 1), np.float32)
+    g = lib.ff_fused_update(
+        _c_arr(lanes), n, w, _c_arr(vals), p, nf,
+        _c_arr(parent), _c_arr(sel), _c_arr(sel_off),
+        _c_arr(depth), _c_arr(width), _c_arr(cap),
+        _c_arr(conserv), _c_arr(prefilter), _c_arr(plain),
+        cms_ptrs, tkey_ptrs, tval_ptrs, int(bool(do_sketch)),
+        ddos_parent, ddos_sel_ptr, ddos_sel_w,
+        plan.ddos_plane if ddos_parent >= 0 else -1,
+        _c_arr(ddos_keys) if ddos_keys is not None else None,
+        _c_arr(ddos_sums) if ddos_sums is not None else None,
+        int(threads))
+    if g < 0:
+        raise ValueError(f"ff_fused_update failed (rc={g}): degenerate "
+                         f"shape n={n} w={w} p={p} nf={nf}")
+    if ddos_parent < 0:
+        return None
+    g = int(g)
+    return ddos_keys[:g], ddos_sums[:g]
 
 
 def encode_stream(batch, out_capacity: int = 0) -> bytes:

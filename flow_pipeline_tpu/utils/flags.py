@@ -38,7 +38,7 @@ KNOWN_FLAGS = frozenset({
     "sketch.capacity", "sketch.topk", "sketch.backend",
     "window.lateness", "archive.raw", "feed.prefetch",
     "ingest.mode", "ingest.shards", "ingest.depth", "ingest.flush_queue",
-    "ingest.native_group",
+    "ingest.native_group", "ingest.fused",
     "checkpoint.path", "flush.count", "metrics.addr", "sink", "in",
     "listen.feed", "query.addr",
     # inserter

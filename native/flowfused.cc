@@ -1,0 +1,394 @@
+// libflowdecode fused dataplane: decode -> group -> sketch in ONE pass.
+//
+// After r08 the host-backend stage budget is dominated by host_group
+// (43.1%) and host_sketch (37.1%, BENCH_r08.json): every decoded batch
+// still round-trips through Python/numpy between grouping, the
+// per-family cascade regroup (engine/hostfused.py _fam_plan), and the
+// sketch engine. The data-plane heavy-hitter literature does detection
+// in a single pass over the stream (HashPipe, arXiv:1611.04825) — this
+// file is the host analogue: one native call takes a decoded chunk's
+// key lanes + value planes and
+//
+//   (a) radix hash-groups the finest ("own") family with the same
+//       64-bit lane hash as flow_hash_group / ops.hostgroup.hash_u64,
+//   (b) regroups every strict-subset family from its parent's group
+//       table (the cascade engine/hostfused.py runs in numpy today),
+//   (c) feeds each family's group table straight into the hostsketch
+//       CMS update -> table prefilter -> admission merge
+//       (native/hostsketch.cc, called in-library),
+//
+// without surfacing any intermediate group rows to Python. The only
+// side output is the DDoS per-dst cascade table, whose consumer (the
+// jitted _accumulate_grouped) stays on the XLA step.
+//
+// Parity contract (tests/test_fusedplane.py): byte-identical inputs
+// produce BIT-EXACT outputs vs the staged path —
+//
+// - grouping reuses flow_hash_group (stable LSD radix, hash-ascending
+//   group order, first-row representative), the exact kernel the staged
+//   -ingest.native_group path runs;
+// - per-group value sums accumulate in double in permutation order
+//   (np.add.reduceat's sequential order over p[perm].astype(f64)) and
+//   round to f32 once, exactly where engine/hostfused.py _prep_device
+//   casts; counts accumulate in uint64 (reduce_groups' integer
+//   accumulator);
+// - the sketch step calls the SAME hs_* kernels the staged engine
+//   calls, with the same thread gate (serial under 2048 groups) and the
+//   same prefilter condition: the staged path tests its padded
+//   power-of-two bucket against 2*capacity, but with n_groups <=
+//   2*capacity both branches are proven output-equal
+//   (hostsketch/engine.py update docstring), so testing the REAL group
+//   count is bit-exact.
+//
+// Threading: the radix groupby is serial (cache-friendly, ~tens of ns
+// per row); parallelism lives inside the hs_* kernels, which join
+// before returning. No state outlives a call.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+// in-library kernels (definitions in flowdecode.cc / hostsketch.cc)
+long long flow_hash_group(const uint32_t* lanes, long long n, long long w,
+                          int32_t* perm, int32_t* starts, int32_t* collided);
+long long hs_cms_update(uint64_t* cms, long long planes, long long depth,
+                        long long width, const uint32_t* keys, long long n,
+                        long long kw, const float* vals,
+                        const uint8_t* valid, int conservative, int threads);
+long long hs_cms_query(const uint64_t* cms, long long planes,
+                       long long depth, long long width,
+                       const uint32_t* keys, long long n, long long kw,
+                       float* out, int threads);
+long long hs_hh_prefilter(const uint32_t* table_keys, long long cap,
+                          long long kw, const uint32_t* uniq,
+                          const float* sums, long long n, long long planes,
+                          int32_t* sel_out, int threads);
+long long hs_topk_merge(uint32_t* table_keys, float* table_vals,
+                        long long cap, long long kw, long long planes,
+                        const uint32_t* cand_keys, const float* cand_sums,
+                        const float* cand_est, const uint8_t* cand_valid,
+                        long long n);
+}  // extern "C"
+
+namespace {
+
+// One family's group table, host-resident for the duration of a call.
+// Value sums stay double until the sketch addends are built — the
+// staged path's numpy reduceat accumulates float64 and casts to f32
+// only when padding the device tables; rounding earlier would break
+// bit-parity off the integer envelope.
+struct FamTable {
+  std::vector<uint32_t> keys;  // [g, wk]
+  std::vector<double> vsum;    // [g, p]
+  std::vector<uint64_t> cnt;   // [g]
+  long long g = 0;
+  long long wk = 0;
+};
+
+// Group [m, wk] lanes via the shared radix kernel. Returns group count
+// or -1 (int32 overflow). Collisions are reported, not resolved — the
+// sketch families run exact=False semantics (hash identity), matching
+// ops.hostgroup.grouping_perm; exactness-contract callers use
+// ff_group_sum below, which surfaces the collision instead.
+long long group_lanes(const uint32_t* lanes, long long m, long long wk,
+                      std::vector<int32_t>& perm,
+                      std::vector<int32_t>& starts, int32_t* collided) {
+  perm.resize(static_cast<size_t>(m));
+  starts.resize(static_cast<size_t>(std::max<long long>(m, 1)));
+  *collided = 0;
+  return flow_hash_group(lanes, m, wk, perm.data(), starts.data(),
+                         collided);
+}
+
+// Fold a grouping into a FamTable: representative keys, double value
+// sums in permutation order (reduceat parity), uint64 counts. Exactly
+// one of fsrc (raw f32 planes) / parent (cascade) provides the values.
+void accumulate(const uint32_t* lanes, long long m, long long wk,
+                long long p, const float* fsrc, const FamTable* parent,
+                const std::vector<int32_t>& perm,
+                const std::vector<int32_t>& starts, long long g,
+                FamTable& out) {
+  out.g = g;
+  out.wk = wk;
+  out.keys.assign(static_cast<size_t>(g * wk), 0);
+  out.vsum.assign(static_cast<size_t>(g * p), 0.0);
+  out.cnt.assign(static_cast<size_t>(g), 0);
+  for (long long gi = 0; gi < g; ++gi) {
+    long long lo = starts[static_cast<size_t>(gi)];
+    long long hi = gi + 1 < g ? starts[static_cast<size_t>(gi + 1)] : m;
+    std::memcpy(out.keys.data() + gi * wk,
+                lanes + static_cast<long long>(perm[lo]) * wk,
+                static_cast<size_t>(wk) * sizeof(uint32_t));
+    double* acc = out.vsum.data() + gi * p;
+    uint64_t cnt = 0;
+    for (long long r = lo; r < hi; ++r) {
+      long long row = perm[static_cast<size_t>(r)];
+      if (parent != nullptr) {
+        const double* src = parent->vsum.data() + row * p;
+        for (long long pi = 0; pi < p; ++pi) acc[pi] += src[pi];
+        cnt += parent->cnt[static_cast<size_t>(row)];
+      } else {
+        const float* src = fsrc + row * p;
+        for (long long pi = 0; pi < p; ++pi)
+          acc[pi] += static_cast<double>(src[pi]);
+        ++cnt;
+      }
+    }
+    out.cnt[static_cast<size_t>(gi)] = cnt;
+  }
+}
+
+// The sketch step for one family — hostsketch/engine.py update(),
+// minus the Python: CMS update over all groups, prefilter when the
+// candidate set exceeds 2*capacity, admission merge. All arithmetic
+// delegated to the hs_* kernels the staged engine calls.
+long long sketch_family(const FamTable& fam, long long p, long long depth,
+                        long long width, long long cap, int conservative,
+                        int prefilter, int admission_plain, uint64_t* cms,
+                        uint32_t* tkeys, float* tvals, int threads) {
+  long long g = fam.g;
+  if (g <= 0) return 0;  // all-invalid chunk: CMS and table both no-ops
+  long long planes = p + 1;  // + count plane
+  // f32 addend planes, cast exactly where _prep_device casts
+  std::vector<float> sums(static_cast<size_t>(g * planes));
+  for (long long gi = 0; gi < g; ++gi) {
+    for (long long pi = 0; pi < p; ++pi) {
+      sums[static_cast<size_t>(gi * planes + pi)] =
+          static_cast<float>(fam.vsum[static_cast<size_t>(gi * p + pi)]);
+    }
+    sums[static_cast<size_t>(gi * planes + p)] =
+        static_cast<float>(fam.cnt[static_cast<size_t>(gi)]);
+  }
+  // same serial gate as HostSketchEngine.update: under 2048 groups the
+  // spawn/join overhead exceeds the win
+  int t = g < 2048 ? 1 : threads;
+  long long rc = hs_cms_update(cms, planes, depth, width, fam.keys.data(),
+                               g, fam.wk, sums.data(), nullptr,
+                               conservative, t);
+  if (rc != 0) return -1;
+  const uint32_t* cand_keys = fam.keys.data();
+  const float* cand_sums = sums.data();
+  long long m = g;
+  std::vector<uint32_t> sel_keys;
+  std::vector<float> sel_sums;
+  if (prefilter && g > 2 * cap) {
+    std::vector<int32_t> sel(static_cast<size_t>(2 * cap));
+    m = hs_hh_prefilter(tkeys, cap, fam.wk, fam.keys.data(), sums.data(),
+                        g, planes, sel.data(), t);
+    if (m < 0) return -1;
+    sel_keys.resize(static_cast<size_t>(m * fam.wk));
+    sel_sums.resize(static_cast<size_t>(m * planes));
+    for (long long r = 0; r < m; ++r) {
+      long long src = sel[static_cast<size_t>(r)];
+      std::memcpy(sel_keys.data() + r * fam.wk,
+                  fam.keys.data() + src * fam.wk,
+                  static_cast<size_t>(fam.wk) * sizeof(uint32_t));
+      std::memcpy(sel_sums.data() + r * planes, sums.data() + src * planes,
+                  static_cast<size_t>(planes) * sizeof(float));
+    }
+    cand_keys = sel_keys.data();
+    cand_sums = sel_sums.data();
+  }
+  std::vector<float> est;
+  const float* cand_est = cand_sums;  // admission "plain": est = sums
+  if (!admission_plain) {
+    est.resize(static_cast<size_t>(m * planes));
+    rc = hs_cms_query(cms, planes, depth, width, cand_keys, m, fam.wk,
+                      est.data(), t);
+    if (rc != 0) return -1;
+    cand_est = est.data();
+  }
+  rc = hs_topk_merge(tkeys, tvals, cap, fam.wk, planes, cand_keys,
+                     cand_sums, cand_est, nullptr, m);
+  return rc < 0 ? -1 : 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Single-pass exact groupby-sum: flow_hash_group + per-group uint64
+// plane sums + counts in one call — the native twin of
+// ops.hostgroup.group_by_key(exact=True) for integer planes (the
+// flows_5m path). Outputs are caller-allocated at capacity n rows:
+// uniq_out [n, w] uint32, sums_out [n, p] uint64, counts_out [n] int64.
+// Returns the group count; -1 on degenerate shapes / int32 overflow;
+// -2 when two DISTINCT key rows share a 64-bit hash (the caller falls
+// back to the lexicographic regroup, same contract as the numpy path).
+long long ff_group_sum(const uint32_t* lanes, long long n, long long w,
+                       const uint64_t* vals, long long p,
+                       uint32_t* uniq_out, uint64_t* sums_out,
+                       int64_t* counts_out) {
+  if (n < 0 || w < 1 || p < 0) return -1;
+  if (n == 0) return 0;
+  std::vector<int32_t> perm, starts;
+  int32_t collided = 0;
+  long long g = group_lanes(lanes, n, w, perm, starts, &collided);
+  if (g < 0) return -1;
+  if (collided) return -2;
+  for (long long gi = 0; gi < g; ++gi) {
+    long long lo = starts[static_cast<size_t>(gi)];
+    long long hi = gi + 1 < g ? starts[static_cast<size_t>(gi + 1)] : n;
+    std::memcpy(uniq_out + gi * w,
+                lanes + static_cast<long long>(perm[lo]) * w,
+                static_cast<size_t>(w) * sizeof(uint32_t));
+    uint64_t* acc = sums_out + gi * p;
+    for (long long pi = 0; pi < p; ++pi) acc[pi] = 0;
+    for (long long r = lo; r < hi; ++r) {
+      const uint64_t* src =
+          vals + static_cast<long long>(perm[static_cast<size_t>(r)]) * p;
+      for (long long pi = 0; pi < p; ++pi) acc[pi] += src[pi];
+    }
+    counts_out[gi] = hi - lo;
+  }
+  return g;
+}
+
+// The fused sketch dataplane over one family tree: group the root
+// family's raw [n, w] lanes, cascade-regroup each child from its
+// parent's group table, and run every family's CMS/prefilter/top-K
+// update in place on its state buffers — plus the optional DDoS
+// per-dst side table.
+//
+//   lanes:  [n, w] uint32 raw key lanes of the ROOT family
+//   vals:   [n, p] float32 value planes (pre-scaled; count appended
+//           internally, so sketch states carry p+1 planes)
+//   nf:     families in the tree; family 0 is the root
+//   parent: [nf] parent index within this call (-1 for the root);
+//           parents must precede children
+//   sel / sel_off: [sel_off[nf]] / [nf+1] — child i's key lanes are
+//           parent's key columns sel[sel_off[i]:sel_off[i+1]]
+//   fdepth/fwidth/fcap: [nf] per-family CMS depth/width + table cap
+//   fconserv/fprefilter/fplain: [nf] per-family update flavor
+//   cms_ptrs/tkey_ptrs/tval_ptrs: [nf] state buffers, updated in place
+//           ([p+1, depth, width] u64 / [cap, wk] u32 / [cap, p+1] f32);
+//           ignored (may be NULL) when do_sketch == 0
+//   do_sketch: 0 skips every state update — grouping only, for late
+//           parts that still need the DDoS side table
+//   ddos_parent: family index whose table feeds the DDoS per-dst
+//           cascade, or -1; ddos_sel [ddos_sel_w] selects its key
+//           columns; ddos_plane picks the value plane
+//   ddos_keys_out/ddos_sums_out: caller-allocated [n, ddos_sel_w]
+//           uint32 / [n] float32 side-table outputs
+//
+// Returns the DDoS side-table group count (0 when ddos_parent < 0), or
+// -1 on degenerate shapes / kernel failure.
+long long ff_fused_update(const uint32_t* lanes, long long n, long long w,
+                          const float* vals, long long p, long long nf,
+                          const int64_t* parent, const int64_t* sel,
+                          const int64_t* sel_off, const int64_t* fdepth,
+                          const int64_t* fwidth, const int64_t* fcap,
+                          const uint8_t* fconserv,
+                          const uint8_t* fprefilter, const uint8_t* fplain,
+                          void** cms_ptrs, void** tkey_ptrs,
+                          void** tval_ptrs, int do_sketch,
+                          long long ddos_parent, const int64_t* ddos_sel,
+                          long long ddos_sel_w, long long ddos_plane,
+                          uint32_t* ddos_keys_out, float* ddos_sums_out,
+                          int threads) {
+  if (n < 0 || w < 1 || p < 0 || nf < 1 || parent[0] != -1) return -1;
+  if (ddos_parent >= nf ||
+      (ddos_parent >= 0 &&
+       (ddos_sel_w < 1 || ddos_plane < 0 || ddos_plane >= p))) {
+    return -1;
+  }
+  std::vector<FamTable> fams(static_cast<size_t>(nf));
+  std::vector<int32_t> perm, starts;
+  std::vector<uint32_t> child_lanes;
+  int32_t collided = 0;
+  for (long long f = 0; f < nf; ++f) {
+    long long par = parent[f];
+    if (par >= f) return -1;  // parents precede children
+    const uint32_t* src_lanes;
+    long long m, wk;
+    const float* fsrc = nullptr;
+    const FamTable* ptab = nullptr;
+    if (par < 0) {
+      src_lanes = lanes;
+      m = n;
+      wk = w;
+      fsrc = vals;
+    } else {
+      const FamTable& pt = fams[static_cast<size_t>(par)];
+      wk = sel_off[f + 1] - sel_off[f];
+      if (wk < 1) return -1;
+      const int64_t* csel = sel + sel_off[f];
+      for (long long c = 0; c < wk; ++c) {
+        // a lane index past the parent's key width would read (and feed
+        // the in-place sketch update) out-of-bounds memory — reject the
+        // plan before any state is touched
+        if (csel[c] < 0 || csel[c] >= pt.wk) return -1;
+      }
+      m = pt.g;
+      child_lanes.resize(static_cast<size_t>(m * wk));
+      for (long long r = 0; r < m; ++r) {
+        for (long long c = 0; c < wk; ++c) {
+          child_lanes[static_cast<size_t>(r * wk + c)] =
+              pt.keys[static_cast<size_t>(r * pt.wk + csel[c])];
+        }
+      }
+      src_lanes = child_lanes.data();
+      ptab = &pt;
+    }
+    if (m == 0) {
+      fams[static_cast<size_t>(f)].g = 0;
+      fams[static_cast<size_t>(f)].wk = wk;
+      continue;
+    }
+    long long g = group_lanes(src_lanes, m, wk, perm, starts, &collided);
+    if (g < 0) return -1;
+    // collisions merge hash-identical tuples — the sketch families'
+    // documented exact=False trade (ops.hostgroup.group_by_key)
+    accumulate(src_lanes, m, wk, p, fsrc, ptab, perm, starts, g,
+               fams[static_cast<size_t>(f)]);
+    if (do_sketch) {
+      long long rc = sketch_family(
+          fams[static_cast<size_t>(f)], p, fdepth[f], fwidth[f], fcap[f],
+          fconserv[f], fprefilter[f], fplain[f],
+          static_cast<uint64_t*>(cms_ptrs[f]),
+          static_cast<uint32_t*>(tkey_ptrs[f]),
+          static_cast<float*>(tval_ptrs[f]), threads);
+      if (rc < 0) return -1;
+    }
+  }
+  if (ddos_parent < 0) return 0;
+  // DDoS per-dst side table: one more cascade regroup, surfaced to the
+  // caller because its consumer (the jitted _accumulate_grouped) stays
+  // on the XLA step.
+  const FamTable& pt = fams[static_cast<size_t>(ddos_parent)];
+  for (long long c = 0; c < ddos_sel_w; ++c) {
+    if (ddos_sel[c] < 0 || ddos_sel[c] >= pt.wk) return -1;
+  }
+  if (pt.g == 0) return 0;
+  child_lanes.resize(static_cast<size_t>(pt.g * ddos_sel_w));
+  for (long long r = 0; r < pt.g; ++r) {
+    for (long long c = 0; c < ddos_sel_w; ++c) {
+      child_lanes[static_cast<size_t>(r * ddos_sel_w + c)] =
+          pt.keys[static_cast<size_t>(r * pt.wk + ddos_sel[c])];
+    }
+  }
+  long long g = group_lanes(child_lanes.data(), pt.g, ddos_sel_w, perm,
+                            starts, &collided);
+  if (g < 0) return -1;
+  for (long long gi = 0; gi < g; ++gi) {
+    long long lo = starts[static_cast<size_t>(gi)];
+    long long hi = gi + 1 < g ? starts[static_cast<size_t>(gi + 1)] : pt.g;
+    std::memcpy(
+        ddos_keys_out + gi * ddos_sel_w,
+        child_lanes.data() +
+            static_cast<long long>(perm[lo]) * ddos_sel_w,
+        static_cast<size_t>(ddos_sel_w) * sizeof(uint32_t));
+    double acc = 0.0;
+    for (long long r = lo; r < hi; ++r) {
+      acc += pt.vsum[static_cast<size_t>(
+          static_cast<long long>(perm[static_cast<size_t>(r)]) * p +
+          ddos_plane)];
+    }
+    ddos_sums_out[gi] = static_cast<float>(acc);
+  }
+  return g;
+}
+
+}  // extern "C"

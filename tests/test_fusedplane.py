@@ -1,0 +1,635 @@
+"""Fused native dataplane parity (-ingest.fused, native/flowfused.cc).
+
+The single-pass group->cascade->sketch kernel must be BIT-EXACT against
+the staged path it replaces — same flows_5m rows, same CMS counters,
+same top-K tables, same DDoS alerts — across prefilter x admission x
+family-cascade configurations (`make fused-parity` runs this file
+against a freshly built library).
+
+Layers:
+
+- kernel parity: ff_group_sum vs ops.hostgroup.group_by_key(exact);
+  ff_fused_update (single family, cascade chain, ddos side table) vs
+  the staged HostSketchEngine fed numpy-grouped tables;
+- pipeline parity: HostSketchPipeline(fused=on) vs fused=off vs
+  HostGroupPipeline on the shared fused-test stream (window rolls +
+  late rows), engine-state arrays compared bit-for-bit after sync;
+- worker integration: identical sink rows fused vs staged, a
+  checkpoint hand-off between the two modes, and the flag-validation
+  error paths.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu import native
+from flow_pipeline_tpu.engine import StreamWorker, WorkerConfig
+from flow_pipeline_tpu.engine.hostfused import HostGroupPipeline
+from flow_pipeline_tpu.hostsketch import HostSketchPipeline
+from flow_pipeline_tpu.hostsketch.engine import HostSketchEngine
+from flow_pipeline_tpu.models import (
+    DDoSConfig,
+    DDoSDetector,
+    DenseTopConfig,
+    DenseTopKModel,
+    HeavyHitterConfig,
+    WindowAggConfig,
+    WindowAggregator,
+)
+from flow_pipeline_tpu.engine import WindowedHeavyHitter
+from flow_pipeline_tpu.ops import hostgroup
+from flow_pipeline_tpu.schema import wire
+from flow_pipeline_tpu.transport import Consumer, InProcessBus
+
+from test_fused import (
+    BS,
+    WINDOW,
+    assert_same_windows,
+    canon_rows,
+    make_models,
+    make_stream,
+)
+
+try:  # hypothesis gates ONLY the property run — parity runs regardless
+    from hypothesis import given, settings, strategies as st
+    HAVE_HYPOTHESIS = True
+except ImportError:  # pragma: no cover - CI installs hypothesis
+    HAVE_HYPOTHESIS = False
+
+pytestmark = pytest.mark.skipif(
+    not native.fused_available(),
+    reason="libflowdecode lacks the fused dataplane; run `make native`")
+
+
+# ---- kernel layer ----------------------------------------------------------
+
+
+class TestGroupSumKernel:
+    @pytest.fixture
+    def rng(self):
+        return np.random.default_rng(11)
+
+    @pytest.mark.parametrize("n,w,p", [(1, 1, 1), (257, 3, 2),
+                                       (4096, 11, 3), (100, 2, 1)])
+    def test_matches_exact_groupby(self, rng, n, w, p):
+        lanes = rng.integers(0, 8, size=(n, w), dtype=np.uint32)
+        vals = rng.integers(0, 1 << 20, size=(n, p), dtype=np.uint64)
+        got = native.group_sum(lanes, vals)
+        assert got is not None
+        uniq, sums, counts = got
+        ref_u, ref_s, ref_c = hostgroup.group_by_key(
+            lanes, [vals], exact=True, native=True)
+        np.testing.assert_array_equal(uniq, ref_u)
+        np.testing.assert_array_equal(sums, ref_s[0])
+        np.testing.assert_array_equal(counts, ref_c)
+
+    def test_empty_batch(self):
+        got = native.group_sum(np.zeros((0, 2), np.uint32),
+                               np.zeros((0, 1), np.uint64))
+        assert got is not None
+        uniq, sums, counts = got
+        assert uniq.shape == (0, 2) and sums.shape == (0, 1)
+        assert counts.shape == (0,)
+
+    def test_all_identical_rows(self):
+        lanes = np.full((500, 4), 7, np.uint32)
+        vals = np.full((500, 2), 3, np.uint64)
+        uniq, sums, counts = native.group_sum(lanes, vals)
+        assert uniq.shape == (1, 4)
+        np.testing.assert_array_equal(sums, [[1500, 1500]])
+        np.testing.assert_array_equal(counts, [500])
+
+    def test_u64_sums_exact_at_scale(self, rng):
+        # sums past 2^53 stay exact in the uint64 accumulator (the f64
+        # path would round) — the flows_5m exactness contract
+        lanes = np.zeros((4, 1), np.uint32)
+        vals = np.full((4, 1), (1 << 62) // 4 + 1, np.uint64)
+        _, sums, _ = native.group_sum(lanes, vals)
+        assert sums[0, 0] == np.uint64((1 << 62) // 4 + 1) * np.uint64(4)
+
+
+def np_group(lanes, planes):
+    """Staged-reference grouping for sketch families (exact=False hash
+    identity, hash-ascending order — what _group_families computes)."""
+    return hostgroup.group_by_key(lanes, planes, exact=False, native=True)
+
+
+def run_engine_reference(cfg, rounds, engine_mode="native"):
+    """Feed numpy-grouped tables through the staged HostSketchEngine —
+    the bit-exactness baseline the fused kernel must reproduce."""
+    eng = HostSketchEngine([cfg], use_native=engine_mode)
+    eng.reset(0)
+    for lanes, vals in rounds:
+        uniq, sums, counts = np_group(lanes, [vals])
+        g = uniq.shape[0]
+        s = np.zeros((g, vals.shape[1] + 1), np.float32)
+        s[:, :vals.shape[1]] = sums[0]
+        s[:, vals.shape[1]] = counts
+        eng.update(0, uniq, s, g)
+    return eng.states[0]
+
+
+def single_family_plan(cfg):
+    return native.FusedPlan(
+        parent=np.asarray([-1], np.int64),
+        sel=np.zeros(0, np.int64),
+        sel_off=np.asarray([0, 0], np.int64),
+        depth=np.asarray([cfg.depth], np.int64),
+        width=np.asarray([cfg.width], np.int64),
+        cap=np.asarray([cfg.capacity], np.int64),
+        conservative=np.asarray([cfg.conservative], np.uint8),
+        prefilter=np.asarray([cfg.table_prefilter], np.uint8),
+        admission_plain=np.asarray([cfg.table_admission == "plain"],
+                                   np.uint8),
+    )
+
+
+class TestFusedUpdateKernel:
+    @pytest.fixture
+    def rng(self):
+        return np.random.default_rng(23)
+
+    def _rounds(self, rng, n_rounds=3, n=900, w=4, p=2, keyspace=64):
+        out = []
+        for _ in range(n_rounds):
+            lanes = rng.integers(0, keyspace, size=(n, w), dtype=np.uint32)
+            vals = rng.integers(0, 1 << 12, size=(n, p)).astype(np.float32)
+            out.append((lanes, vals))
+        return out
+
+    @pytest.mark.parametrize("prefilter", [True, False])
+    @pytest.mark.parametrize("admission", ["est", "plain"])
+    @pytest.mark.parametrize("conservative", [True, False])
+    def test_single_family_vs_staged_engine(self, rng, prefilter,
+                                            admission, conservative):
+        # capacity 16 with a 64-key space: prefilter boundary (g > 2*cap)
+        # is crossed every round, evictions happen, width 32 forces CMS
+        # collisions; four scalar key cols = the 4 lanes _rounds builds
+        cfg = HeavyHitterConfig(key_cols=("proto", "src_port", "dst_port",
+                                          "etype"),
+                                depth=2, width=32,
+                                capacity=16, conservative=conservative,
+                                table_prefilter=prefilter,
+                                table_admission=admission, batch_size=BS)
+        rounds = self._rounds(rng)
+        ref = run_engine_reference(cfg, rounds)
+        eng = HostSketchEngine([cfg], use_native="native")
+        eng.reset(0)
+        plan = single_family_plan(cfg)
+        for lanes, vals in rounds:
+            assert native.fused_update(lanes, vals, plan, [eng.states[0]],
+                                       do_sketch=True, threads=2) is None
+        np.testing.assert_array_equal(eng.states[0].cms, ref.cms)
+        np.testing.assert_array_equal(eng.states[0].table_keys,
+                                      ref.table_keys)
+        np.testing.assert_array_equal(eng.states[0].table_vals,
+                                      ref.table_vals)
+
+    def test_capacity_one_table(self, rng):
+        cfg = HeavyHitterConfig(key_cols=("proto",), depth=2, width=16,
+                                capacity=1, batch_size=BS)
+        rounds = self._rounds(rng, n=200, w=1, keyspace=8)
+        ref = run_engine_reference(cfg, rounds)
+        eng = HostSketchEngine([cfg], use_native="native")
+        eng.reset(0)
+        plan = single_family_plan(cfg)
+        for lanes, vals in rounds:
+            native.fused_update(lanes, vals, plan, [eng.states[0]],
+                                do_sketch=True)
+        np.testing.assert_array_equal(eng.states[0].table_keys,
+                                      ref.table_keys)
+        np.testing.assert_array_equal(eng.states[0].table_vals,
+                                      ref.table_vals)
+
+    def test_cascade_chain_and_ddos(self, rng):
+        """Root [w=4] -> child selecting lanes (0,1) -> grandchild
+        selecting child lane (1,) == root lane 1, plus the ddos side
+        table off the child — vs the staged cascade in numpy."""
+        def cfg_w(key_cols):
+            return HeavyHitterConfig(key_cols=key_cols, depth=2, width=64,
+                                     capacity=8, batch_size=BS)
+        root_cfg = cfg_w(("proto", "src_port", "dst_port", "etype"))
+        child_cfg = cfg_w(("proto", "src_port"))
+        grand_cfg = cfg_w(("src_port",))
+        plan = native.FusedPlan(
+            parent=np.asarray([-1, 0, 1], np.int64),
+            sel=np.asarray([0, 1, 1], np.int64),
+            sel_off=np.asarray([0, 0, 2, 3], np.int64),
+            depth=np.asarray([2, 2, 2], np.int64),
+            width=np.asarray([64, 64, 64], np.int64),
+            cap=np.asarray([8, 8, 8], np.int64),
+            conservative=np.asarray([1, 1, 1], np.uint8),
+            prefilter=np.asarray([1, 1, 1], np.uint8),
+            admission_plain=np.asarray([0, 0, 0], np.uint8),
+            ddos_parent=1, ddos_sel=np.asarray([0], np.int64),
+            ddos_plane=1)
+        engines = [HostSketchEngine([c], use_native="native")
+                   for c in (root_cfg, child_cfg, grand_cfg)]
+        for e in engines:
+            e.reset(0)
+        ref_engines = [HostSketchEngine([c], use_native="native")
+                       for c in (root_cfg, child_cfg, grand_cfg)]
+        for e in ref_engines:
+            e.reset(0)
+        for lanes, vals in self._rounds(rng, n=600, w=4, p=2, keyspace=16):
+            states = [e.states[0] for e in engines]
+            got = native.fused_update(lanes, vals, plan, states,
+                                      do_sketch=True)
+            # staged reference: numpy cascade, engine per family
+            r_u, r_s, r_c = np_group(lanes, [vals])
+            c_u, c_s, c_c64 = np_group(
+                r_u[:, [0, 1]], [r_s[0], r_c.astype(np.uint64)])
+            c_c = c_s[1].astype(np.int64)
+            g_u, g_s, g_c64 = np_group(
+                c_u[:, [1]], [c_s[0], c_c.astype(np.uint64)])
+            g_c = g_s[1].astype(np.int64)
+            for eng, (u, vs, cnt) in zip(
+                    ref_engines, [(r_u, r_s[0], r_c), (c_u, c_s[0], c_c),
+                                  (g_u, g_s[0], g_c)]):
+                s = np.zeros((u.shape[0], 3), np.float32)
+                s[:, :2] = vs
+                s[:, 2] = cnt
+                eng.update(0, u, s, u.shape[0])
+            d_u, d_s, _ = np_group(c_u[:, [0]], [c_s[0][:, 1]])
+            np.testing.assert_array_equal(got[0], d_u)
+            np.testing.assert_array_equal(got[1],
+                                          d_s[0].astype(np.float32))
+        for eng, ref in zip(engines, ref_engines):
+            np.testing.assert_array_equal(eng.states[0].cms,
+                                          ref.states[0].cms)
+            np.testing.assert_array_equal(eng.states[0].table_keys,
+                                          ref.states[0].table_keys)
+            np.testing.assert_array_equal(eng.states[0].table_vals,
+                                          ref.states[0].table_vals)
+
+    def test_do_sketch_false_leaves_state_untouched(self, rng):
+        cfg = HeavyHitterConfig(key_cols=("proto",), depth=2, width=16,
+                                capacity=4, batch_size=BS)
+        base = single_family_plan(cfg)
+        plan = native.FusedPlan(
+            parent=base.parent, sel=base.sel, sel_off=base.sel_off,
+            depth=base.depth, width=base.width, cap=base.cap,
+            conservative=base.conservative, prefilter=base.prefilter,
+            admission_plain=base.admission_plain,
+            ddos_parent=0, ddos_sel=np.asarray([0], np.int64),
+            ddos_plane=0)
+        lanes = rng.integers(0, 8, size=(100, 1), dtype=np.uint32)
+        vals = rng.integers(0, 100, size=(100, 1)).astype(np.float32)
+        got = native.fused_update(lanes, vals, plan, None,
+                                  do_sketch=False)
+        d_u, d_s, _ = np_group(lanes, [vals[:, 0]])
+        np.testing.assert_array_equal(got[0], d_u)
+        np.testing.assert_array_equal(got[1], d_s[0].astype(np.float32))
+
+    def test_do_ddos_false_skips_side_table_only(self, rng):
+        """do_ddos=False (a late ddos sub-window discarding the table)
+        must skip the per-dst cascade output while the sketch updates
+        stay bit-identical to a gated-on pass."""
+        cfg = HeavyHitterConfig(key_cols=("proto",), depth=2, width=16,
+                                capacity=4, batch_size=BS)
+        base = single_family_plan(cfg)
+        plan = native.FusedPlan(
+            parent=base.parent, sel=base.sel, sel_off=base.sel_off,
+            depth=base.depth, width=base.width, cap=base.cap,
+            conservative=base.conservative, prefilter=base.prefilter,
+            admission_plain=base.admission_plain,
+            ddos_parent=0, ddos_sel=np.asarray([0], np.int64),
+            ddos_plane=0)
+        lanes = rng.integers(0, 8, size=(100, 1), dtype=np.uint32)
+        vals = rng.integers(0, 100, size=(100, 1)).astype(np.float32)
+        engines = [HostSketchEngine([cfg], use_native="native")
+                   for _ in range(2)]
+        for e in engines:
+            e.reset(0)
+        on = native.fused_update(lanes, vals, plan,
+                                 [engines[0].states[0]], do_sketch=True)
+        off = native.fused_update(lanes, vals, plan,
+                                  [engines[1].states[0]], do_sketch=True,
+                                  do_ddos=False)
+        assert on is not None and off is None
+        np.testing.assert_array_equal(engines[0].states[0].cms,
+                                      engines[1].states[0].cms)
+        np.testing.assert_array_equal(engines[0].states[0].table_keys,
+                                      engines[1].states[0].table_keys)
+        np.testing.assert_array_equal(engines[0].states[0].table_vals,
+                                      engines[1].states[0].table_vals)
+
+    def test_degenerate_shapes_rejected(self):
+        cfg = HeavyHitterConfig(key_cols=("proto",), depth=2, width=16,
+                                capacity=4, batch_size=BS)
+        plan = single_family_plan(cfg)
+        bad = native.FusedPlan(  # root must have parent -1
+            parent=np.asarray([0], np.int64), sel=np.zeros(0, np.int64),
+            sel_off=np.asarray([0, 0], np.int64),
+            depth=plan.depth, width=plan.width, cap=plan.cap,
+            conservative=plan.conservative, prefilter=plan.prefilter,
+            admission_plain=plan.admission_plain)
+        eng = HostSketchEngine([cfg], use_native="native")
+        eng.reset(0)
+        lanes = np.zeros((4, 1), np.uint32)
+        vals = np.zeros((4, 1), np.float32)
+        with pytest.raises(ValueError, match="ff_fused_update"):
+            native.fused_update(lanes, vals, bad, [eng.states[0]],
+                                do_sketch=True)
+
+    def test_out_of_range_lane_selection_rejected(self):
+        """A sel (or ddos_sel) index past the parent's key width must be
+        rejected before any state write — it would otherwise read
+        out-of-bounds memory into the sketch."""
+        cfg = HeavyHitterConfig(key_cols=("proto",), depth=2, width=16,
+                                capacity=4, batch_size=BS)
+        engines = [HostSketchEngine([cfg], use_native="native")
+                   for _ in range(2)]
+        for e in engines:
+            e.reset(0)
+        lanes = np.zeros((4, 1), np.uint32)
+        vals = np.zeros((4, 1), np.float32)
+        base = single_family_plan(cfg)
+        bad_sel = native.FusedPlan(
+            parent=np.asarray([-1, 0], np.int64),
+            sel=np.asarray([5], np.int64),  # parent has 1 key lane
+            sel_off=np.asarray([0, 0, 1], np.int64),
+            depth=np.asarray([2, 2], np.int64),
+            width=np.asarray([16, 16], np.int64),
+            cap=np.asarray([4, 4], np.int64),
+            conservative=np.asarray([1, 1], np.uint8),
+            prefilter=np.asarray([1, 1], np.uint8),
+            admission_plain=np.asarray([0, 0], np.uint8))
+        with pytest.raises(ValueError, match="ff_fused_update"):
+            native.fused_update(lanes, vals, bad_sel,
+                                [e.states[0] for e in engines],
+                                do_sketch=True)
+        bad_ddos_sel = native.FusedPlan(
+            parent=base.parent, sel=base.sel, sel_off=base.sel_off,
+            depth=base.depth, width=base.width, cap=base.cap,
+            conservative=base.conservative, prefilter=base.prefilter,
+            admission_plain=base.admission_plain,
+            ddos_parent=0, ddos_sel=np.asarray([-1], np.int64),
+            ddos_plane=0)
+        with pytest.raises(ValueError, match="ff_fused_update"):
+            native.fused_update(lanes, vals, bad_ddos_sel,
+                                [engines[0].states[0]], do_sketch=True)
+
+    def test_empty_chunk_is_noop(self):
+        cfg = HeavyHitterConfig(key_cols=("proto",), depth=2, width=16,
+                                capacity=4, batch_size=BS)
+        eng = HostSketchEngine([cfg], use_native="native")
+        eng.reset(0)
+        before = eng.states[0].cms.copy()
+        native.fused_update(np.zeros((0, 1), np.uint32),
+                            np.zeros((0, 1), np.float32),
+                            single_family_plan(cfg), [eng.states[0]],
+                            do_sketch=True)
+        np.testing.assert_array_equal(eng.states[0].cms, before)
+
+
+# ---- pipeline layer --------------------------------------------------------
+
+
+def cfg_models(prefilter=True, admission="est", capacity=128,
+               families="cascade"):
+    """The test model family with configurable sketch knobs. families=
+    "cascade" includes the 5-tuple parent the IP families regroup from;
+    "flat" keeps only the (own, own) IP families; "noddos" drops the
+    detector; "minimal" is flows_5m + ddos only (the ddos-"own" path);
+    "nodense" is hh + cascade ddos with NO dense model — the chunk whose
+    staged inputs are all None and only fused_in carries work (the
+    apply() skip-condition regression)."""
+    def hh_cfg(key_cols):
+        return HeavyHitterConfig(
+            key_cols=key_cols, batch_size=BS, width=1 << 10,
+            capacity=capacity, table_prefilter=prefilter,
+            table_admission=admission)
+
+    models = {"flows_5m": WindowAggregator(WindowAggConfig(batch_size=BS))}
+    if families != "minimal":
+        if families in ("cascade", "nodense"):
+            models["top_talkers"] = WindowedHeavyHitter(
+                hh_cfg(("src_addr", "dst_addr", "src_port", "dst_port",
+                        "proto")), k=50)
+        models["top_src_ips"] = WindowedHeavyHitter(
+            hh_cfg(("src_addr",)), k=50)
+        models["top_dst_ips"] = WindowedHeavyHitter(
+            hh_cfg(("dst_addr",)), k=50)
+        if families != "nodense":
+            models["top_src_ports"] = WindowedHeavyHitter(
+                DenseTopConfig(key_col="src_port", batch_size=BS), k=50,
+                model_cls=DenseTopKModel)
+    if families != "noddos":
+        models["ddos_alerts"] = DDoSDetector(DDoSConfig(
+            n_buckets=1 << 10, sub_window_seconds=WINDOW,
+            warmup_windows=0, batch_size=BS))
+    return models
+
+
+def drive(models, batches, **kw):
+    pipe = HostSketchPipeline(models, **kw)
+    for b in batches:
+        pipe.update(b)
+    pipe.sync_states()
+    return models, pipe
+
+
+def assert_models_identical(a: dict, b: dict):
+    assert canon_rows(a["flows_5m"].flush(True)) == \
+        canon_rows(b["flows_5m"].flush(True))
+    for name in a:
+        m = a[name]
+        if isinstance(m, WindowedHeavyHitter):
+            assert_same_windows(m.flush(True), b[name].flush(True))
+            assert m.late_flows_dropped == b[name].late_flows_dropped
+    if "ddos_alerts" in a:
+        fa, ha = a["ddos_alerts"], b["ddos_alerts"]
+        assert fa.late_flows_dropped == ha.late_flows_dropped
+        assert len(fa.alerts) == len(ha.alerts)
+        for x, y in zip(fa.alerts, ha.alerts):
+            assert x.keys() == y.keys()
+            for k in x:
+                np.testing.assert_array_equal(np.asarray(x[k]),
+                                              np.asarray(y[k]))
+
+
+class TestPipelineParity:
+    @pytest.mark.parametrize("prefilter", [True, False])
+    @pytest.mark.parametrize("admission", ["est", "plain"])
+    def test_bit_exact_vs_staged(self, prefilter, admission):
+        batches = make_stream()
+        staged, sp = drive(cfg_models(prefilter, admission), batches,
+                           fused="off")
+        fused, fp = drive(cfg_models(prefilter, admission), batches,
+                          fused="on")
+        assert fp._fused and not sp._fused
+        assert_models_identical(staged, fused)
+
+    @pytest.mark.parametrize("families", ["flat", "noddos", "minimal",
+                                          "nodense"])
+    def test_family_plan_shapes(self, families):
+        """Multiple own-rooted trees (flat), no detector riding the
+        cascade (noddos), no hh families at all (minimal — the
+        ddos-"own" grouping stays on the staged path), and no dense
+        model (nodense — the prepared chunk's staged inputs are ALL
+        None, so only fused_in keeps apply() from skipping the chunk;
+        regression for the silent-drop bug)."""
+        batches = make_stream()
+        staged, _ = drive(cfg_models(families=families), batches,
+                          fused="off")
+        fused, fp = drive(cfg_models(families=families), batches,
+                          fused="on")
+        assert fp._fused
+        assert_models_identical(staged, fused)
+
+    def test_capacity_one_eviction_storm(self):
+        batches = make_stream()
+        staged, _ = drive(cfg_models(capacity=1), batches, fused="off")
+        fused, _ = drive(cfg_models(capacity=1), batches, fused="on")
+        assert_models_identical(staged, fused)
+
+    def test_engine_state_bit_exact_mid_stream(self):
+        """CMS counters and top-K tables — not just flushed windows —
+        must match after a partial stream (sync_states exports them)."""
+        batches = make_stream()[:3]  # open window, nothing flushed
+        staged, sp = drive(make_models(WINDOW, 100), batches, fused="off")
+        fused, fp = drive(make_models(WINDOW, 100), batches, fused="on")
+        for (name, w), (_, w2) in zip(sp._hh, fp._hh):
+            s, f = w.model.state, w2.model.state
+            np.testing.assert_array_equal(
+                np.asarray(s.cms), np.asarray(f.cms),
+                err_msg=f"{name} cms")
+            np.testing.assert_array_equal(
+                np.asarray(s.table_keys), np.asarray(f.table_keys),
+                err_msg=f"{name} table_keys")
+            np.testing.assert_array_equal(
+                np.asarray(s.table_vals), np.asarray(f.table_vals),
+                err_msg=f"{name} table_vals")
+
+    def test_vs_hostgrouped_device_pipeline(self):
+        """Transitively: fused == staged == the jitted device apply."""
+        batches = make_stream()
+
+        def drive_dev(models):
+            pipe = HostGroupPipeline(models)
+            for b in batches:
+                pipe.update(b)
+            return models
+
+        dev = drive_dev(make_models(WINDOW, 100))
+        fused, _ = drive(make_models(WINDOW, 100), batches, fused="on")
+        assert_models_identical(dev, fused)
+
+    def test_bad_fused_mode_rejected(self):
+        with pytest.raises(ValueError, match="fused"):
+            HostSketchPipeline(make_models(WINDOW, 100), fused="fast")
+
+    def test_fused_on_requires_native_engine(self):
+        with pytest.raises(RuntimeError, match="fused"):
+            HostSketchPipeline(make_models(WINDOW, 100), fused="on",
+                               sketch_native="numpy")
+
+    if HAVE_HYPOTHESIS:
+        @settings(max_examples=8, deadline=None)
+        @given(seed=st.integers(0, 2**16), n_keys=st.integers(2, 400))
+        def test_random_streams_property(self, seed, n_keys):
+            from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+
+            gen = FlowGenerator(ZipfProfile(n_keys=n_keys, alpha=1.1),
+                                seed=seed)
+            t0 = 6000
+            batches = []
+            for i in range(3):
+                b = gen.batch(BS)
+                b.columns["time_received"] = (
+                    t0 + i * 120 + (np.arange(BS) % 40)).astype(np.uint64)
+                batches.append(b)
+            staged, _ = drive(cfg_models(capacity=32), batches,
+                              fused="off")
+            fused, _ = drive(cfg_models(capacity=32), batches, fused="on")
+            assert_models_identical(staged, fused)
+
+
+# ---- worker layer ----------------------------------------------------------
+
+
+class CollectSink:
+    def __init__(self):
+        self.rows: dict[str, list] = {}
+
+    def write(self, table, rows):
+        self.rows.setdefault(table, []).append(rows)
+
+
+def _canon_table(chunks) -> list:
+    out = []
+    for rows in chunks:
+        if isinstance(rows, dict):
+            out.extend(canon_rows(rows))
+        else:
+            out.extend(tuple(sorted((k, str(v)) for k, v in r.items()))
+                       for r in rows)
+    return sorted(out)
+
+
+def _run_worker(fused_mode, batches, ckpt=None, snapshot_every=0,
+                restore=False):
+    bus = InProcessBus()
+    bus.create_topic("flows", 1)
+    for b in batches:
+        for frame in wire.iter_raw_frames(b.to_wire()):
+            bus.produce("flows", frame)
+    sink = CollectSink()
+    worker = StreamWorker(
+        Consumer(bus, fixedlen=True), make_models(WINDOW, 100), [sink],
+        WorkerConfig(poll_max=BS, snapshot_every=snapshot_every,
+                     checkpoint_path=ckpt, sketch_backend="host",
+                     ingest_fused=fused_mode),
+    )
+    if restore:
+        assert worker.restore()
+    worker.run(stop_when_idle=True)
+    return worker, sink
+
+
+class TestWorkerIntegration:
+    def test_worker_sink_rows_fused_vs_staged(self):
+        batches = make_stream()
+        worker, fused = _run_worker("on", batches)
+        assert isinstance(worker.fused, HostSketchPipeline)
+        assert worker.fused._fused
+        _, staged = _run_worker("off", batches)
+        assert set(fused.rows) == set(staged.rows)
+        for table in fused.rows:
+            assert _canon_table(fused.rows[table]) == \
+                _canon_table(staged.rows[table]), f"table {table} diverged"
+
+    @pytest.mark.parametrize("first,second", [("on", "off"),
+                                              ("off", "on")])
+    def test_checkpoint_mode_switch(self, tmp_path, first, second):
+        """Snapshot under one dataplane mode, restore under the other:
+        engine state re-imports transparently, rows stay identical."""
+        batches = make_stream()
+        ck = str(tmp_path / "ck")
+        _, ref1 = _run_worker(first, batches[:4], ckpt=str(
+            tmp_path / "ck_ref"), snapshot_every=1)
+        _, ref2 = _run_worker(first, batches[4:], ckpt=str(
+            tmp_path / "ck_ref"), restore=True)
+        _, got1 = _run_worker(first, batches[:4], ckpt=ck,
+                              snapshot_every=1)
+        _, got2 = _run_worker(second, batches[4:], ckpt=ck, restore=True)
+        for ref, got in ((ref1, got1), (ref2, got2)):
+            assert set(ref.rows) == set(got.rows)
+            for table in ref.rows:
+                assert _canon_table(ref.rows[table]) == \
+                    _canon_table(got.rows[table]), \
+                    f"{first}->{second}: table {table} diverged"
+
+    def test_fused_on_needs_host_backend(self):
+        with pytest.raises(ValueError, match="ingest_fused"):
+            StreamWorker(None, {}, [],
+                         WorkerConfig(sketch_backend="device",
+                                      ingest_fused="on"))
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="ingest_fused"):
+            StreamWorker(None, {}, [],
+                         WorkerConfig(ingest_fused="always"))

@@ -40,7 +40,13 @@ Workload per thread and why:
   counts (the engine spawns its own workers — sanitizers see nested
   threading), degenerate shapes (zero-width CMS rejected cleanly, n=0
   no-ops, 1-lane and 11-lane keys, capacity-1 tables), results checked
-  against the single-threaded numpy twin every iteration.
+  against the single-threaded numpy twin every iteration;
+- fused dataplane (``ff_group_sum`` / ``ff_fused_update``): whole
+  family trees (root + cascade child + ddos side table) run end-to-end
+  on thread-private state at several internal thread counts with a
+  byte-identical determinism oracle, truncated/odd-length batches, n=0,
+  capacity-1 tables, a linear-mass invariant on the root CMS, and the
+  malformed-plan rejection paths (root with a parent, bad ddos plane).
 
 Exit 0 = clean run; prints one JSON summary line.
 """
@@ -203,6 +209,9 @@ def _thread_work(native, tid: int, iters: int, batch, data: bytes,
             #    threads — nested threading under the sanitizer)
             if native.sketch_available():
                 _sketch_work(native, rng, it)
+            # 7) fused dataplane: group + cascade + sketch in one call
+            if native.fused_available():
+                _fused_work(native, rng, it)
     except Exception as e:  # noqa: BLE001 — collected for the exit code
         errors.append(f"thread {tid}: {type(e).__name__}: {e}")
 
@@ -280,6 +289,120 @@ def _sketch_work(native, rng, it: int) -> None:
         assert (table_keys[real:] == 0xFFFFFFFF).all()
 
 
+def _fresh_states(np, nf: int, cap: int, kws, planes: int):
+    """Thread-private sketch state triples (cms, table_keys, table_vals)
+    shaped like hostsketch.state.HostHHState — a tiny namespace stands
+    in so the stress driver does not pull jax through the model stack."""
+    import types
+
+    return [types.SimpleNamespace(
+        cms=np.zeros((planes, 2, 32), np.uint64),
+        table_keys=np.full((cap, kws[i]), 0xFFFFFFFF, np.uint32),
+        table_vals=np.zeros((cap, planes), np.float32),
+    ) for i in range(nf)]
+
+
+def _fused_work(native, rng, it: int) -> None:
+    """One fused-dataplane stress round on thread-private state.
+
+    The whole tree — root (3 key lanes) -> cascade child (lane 0) ->
+    ddos side table (lane 1, plane 0) — runs at several internal thread
+    counts; the oracle is byte-identical state and side tables across
+    counts. Truncated/odd batch lengths, n=0 and capacity-1 tables ride
+    the same rounds; malformed plans must be REJECTED, never written."""
+    import numpy as np
+
+    p = 2
+    cap = (1, 8)[it % 2]
+    plan = native.FusedPlan(
+        parent=np.asarray([-1, 0], np.int64),
+        sel=np.asarray([0], np.int64),
+        sel_off=np.asarray([0, 0, 1], np.int64),
+        depth=np.asarray([2, 2], np.int64),
+        width=np.asarray([32, 32], np.int64),
+        cap=np.asarray([cap, cap], np.int64),
+        conservative=np.asarray([it % 2, 1 - it % 2], np.uint8),
+        prefilter=np.asarray([1, 1], np.uint8),
+        admission_plain=np.asarray([it % 2, it % 2], np.uint8),
+        ddos_parent=0, ddos_sel=np.asarray([1], np.int64), ddos_plane=0)
+    n_full = int(rng.integers(0, 700))
+    lanes_full = rng.integers(0, 64, size=(n_full, 3), dtype=np.uint32)
+    vals_full = rng.integers(0, 1500, size=(n_full, p)).astype(np.float32)
+    # truncations: every call sees a different (possibly empty) prefix
+    for n in {0, n_full, n_full // 2, n_full // 3}:
+        lanes = np.ascontiguousarray(lanes_full[:n])
+        vals = np.ascontiguousarray(vals_full[:n])
+        runs = []
+        for threads in (1, 8):
+            states = _fresh_states(np, 2, cap, (3, 1), p + 1)
+            ddos = native.fused_update(lanes, vals, plan, states,
+                                       do_sketch=True, threads=threads)
+            runs.append((states, ddos))
+        (s1, d1), (s8, d8) = runs
+        for a, b in zip(s1, s8):
+            assert np.array_equal(a.cms, b.cms), "fused cms nondeterminism"
+            assert np.array_equal(a.table_keys, b.table_keys)
+            assert np.array_equal(a.table_vals, b.table_vals)
+        assert np.array_equal(d1[0], d8[0]) and np.array_equal(d1[1], d8[1])
+        if n and not plan.conservative[0]:
+            # linear root update: per-(plane, depth)-row mass == total
+            # addend mass (integer-valued, so the f64->f32->u64 chain is
+            # exact) — lost or duplicated scatters show here
+            want = vals.astype(np.uint64).sum(axis=0)
+            got = s1[0].cms[:p].sum(axis=2)
+            assert np.array_equal(
+                got, np.broadcast_to(want[:, None], (p, 2))), \
+                "fused linear mass mismatch"
+            assert s1[0].cms[p].sum() == np.uint64(n) * np.uint64(2)
+        # ff_group_sum on the same lanes: exact groupby invariants
+        gs = native.group_sum(lanes, vals.astype(np.uint64))
+        if gs is not None:
+            uniq, sums, counts = gs
+            assert counts.sum() == n
+            assert sums.sum(axis=0).tolist() == \
+                vals.astype(np.uint64).sum(axis=0).tolist()
+            if len(uniq):
+                assert len(np.unique(uniq, axis=0)) == len(uniq)
+    # malformed plans must be rejected before any write
+    bad_root = native.FusedPlan(
+        parent=np.asarray([0, 0], np.int64), sel=plan.sel,
+        sel_off=plan.sel_off, depth=plan.depth, width=plan.width,
+        cap=plan.cap, conservative=plan.conservative,
+        prefilter=plan.prefilter, admission_plain=plan.admission_plain)
+    try:
+        native.fused_update(lanes_full[:4], vals_full[:4], bad_root,
+                            _fresh_states(np, 2, cap, (3, 1), p + 1),
+                            do_sketch=True)
+        raise AssertionError("rooted-parent plan accepted")
+    except ValueError:
+        pass
+    bad_ddos = native.FusedPlan(
+        parent=plan.parent, sel=plan.sel, sel_off=plan.sel_off,
+        depth=plan.depth, width=plan.width, cap=plan.cap,
+        conservative=plan.conservative, prefilter=plan.prefilter,
+        admission_plain=plan.admission_plain,
+        ddos_parent=0, ddos_sel=np.asarray([0], np.int64), ddos_plane=99)
+    try:
+        native.fused_update(lanes_full[:4], vals_full[:4], bad_ddos,
+                            _fresh_states(np, 2, cap, (3, 1), p + 1),
+                            do_sketch=True)
+        raise AssertionError("out-of-range ddos plane accepted")
+    except ValueError:
+        pass
+    bad_sel = native.FusedPlan(
+        parent=plan.parent, sel=np.asarray([7], np.int64),  # parent w=3
+        sel_off=plan.sel_off, depth=plan.depth, width=plan.width,
+        cap=plan.cap, conservative=plan.conservative,
+        prefilter=plan.prefilter, admission_plain=plan.admission_plain)
+    try:
+        native.fused_update(lanes_full[:4], vals_full[:4], bad_sel,
+                            _fresh_states(np, 2, cap, (3, 1), p + 1),
+                            do_sketch=True)
+        raise AssertionError("out-of-range lane selection accepted")
+    except ValueError:
+        pass
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", choices=("plain", "san", "tsan"),
@@ -327,6 +450,8 @@ def main(argv=None) -> int:
         "threads": args.threads,
         "iters_per_thread": args.iters,
         "adversarial_buffers": len(adversarial),
+        "sketch_covered": native.sketch_available(),
+        "fused_covered": native.fused_available(),
         **abi,
         "seconds": round(dt, 2),
         "errors": errors,
