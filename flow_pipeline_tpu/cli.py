@@ -246,6 +246,10 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.integer("flush.count", 50, "Batches between snapshots")
     fs.string("metrics.addr", "127.0.0.1:8081", "host:port for /metrics "
                                                 "(empty disables)")
+    fs.string("obs.trace", "ring",
+              "flowtrace per-chunk span recorder: ring (flight recorder, "
+              "<2% overhead — dump via /debug/trace or on worker error) "
+              "| always (retain every span; CI/diagnostics only) | off")
     fs.string("sink", "stdout", "stdout | sqlite:PATH | postgres:DSN | "
                                 "clickhouse:URL (comma separated)")
     fs.string("in", "", "Read frames from file instead of Kafka")
@@ -361,6 +365,9 @@ def processor_main(argv=None) -> int:
     fs = _processor_flags(_common_flags(FlagSet("processor")))
     vals = fs.parse(argv if argv is not None else sys.argv[2:])
     set_level(vals["loglevel"])
+    from .obs.trace import TRACER
+
+    TRACER.configure(vals["obs.trace"])
     _apply_backend(vals["processor.backend"])
     from .engine import StreamWorker, WorkerConfig
     from .transport import Consumer
@@ -535,6 +542,9 @@ def pipeline_main(argv=None) -> int:
     fs.integer("bus.partitions", 2, "Bus partitions (reference default 2)")
     vals = fs.parse(argv if argv is not None else sys.argv[2:])
     set_level(vals["loglevel"])
+    from .obs.trace import TRACER
+
+    TRACER.configure(vals["obs.trace"])
     _apply_backend(vals["processor.backend"])
     from .engine import StreamWorker, WorkerConfig
     from .schema import wire

@@ -19,7 +19,15 @@ from ..models.ddos import DDoSDetector
 from ..models.heavy_hitter import HHState
 from ..models.window_agg import WindowAggregator
 from ..obs import REGISTRY, get_logger
+from ..obs.trace import TRACER
 from ..obs.tracing import StageTimer
+
+# Buckets for the window-end -> sink-commit latency histogram: seconds,
+# spanning "flushed within the batch" (~1s) to "stuck for an hour".
+COMMIT_LATENCY_BUCKETS = (
+    1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1_200.0, 1_800.0,
+    3_600.0,
+)
 from .checkpoint import load_checkpoint, save_checkpoint
 from .prefetch import PrefetchConsumer
 from .windowed import WindowedHeavyHitter
@@ -219,6 +227,34 @@ class StreamWorker:
         )
         self.m_proc = REGISTRY.summary("flow_processing_time_us",
                                        "per-batch processing time")
+        # End-to-end watermark: the newest flow-export timestamp (window
+        # end) whose rows are COMMITTED to the sinks, plus the
+        # window-end -> sink-commit latency distribution. Registered
+        # eagerly (not on first flush) so /metrics always carries the
+        # families the dashboards chart.
+        self.m_commit_wm = REGISTRY.gauge(
+            "flow_commit_watermark_seconds",
+            "newest flow-export timestamp (window end, epoch s) whose "
+            "rows are committed to the sinks")
+        self.m_commit_lat = REGISTRY.histogram(
+            "flow_sink_commit_latency_seconds",
+            "window end (flow export time) -> sink commit latency",
+            buckets=COMMIT_LATENCY_BUCKETS)
+        # host_fused phase counters (flowtrace): fed by the fused native
+        # dataplane from the kernels' stats out-struct; the name/help
+        # specs live in hostsketch.pipeline (the publisher) and are
+        # registered here so the family exists — and scrapes as zeros —
+        # on every worker, fused or not.
+        from ..hostsketch.pipeline import (GROUPS_COUNTER, PHASE_COUNTERS,
+                                           ROWS_COUNTER)
+
+        REGISTRY.counter(*PHASE_COUNTERS["host_fused"])
+        REGISTRY.counter(*ROWS_COUNTER)
+        REGISTRY.counter(*GROUPS_COUNTER)
+        # flowlint: unguarded -- written by whichever single thread runs _write_rows (worker inline, or the one flusher thread)
+        self._commit_watermark = 0.0
+        # flowlint: unguarded -- worker thread only (set per _process step, read when queueing flush jobs)
+        self._trace_chunk = -1
         # per-stage breakdown (the reference charts the same
         # flow_summary_*_time_us family for its collector stages)
         self.stages = StageTimer()
@@ -247,6 +283,8 @@ class StreamWorker:
 
     def _process(self, batch, prep=None) -> bool:
         t0 = time.perf_counter()
+        t0_wall = time.time()
+        self._trace_chunk = getattr(batch, "chunk_id", -1)
         if self.config.archive_raw:
             archived = False
             for sink in self.sinks:
@@ -278,6 +316,8 @@ class StreamWorker:
         self.m_flows.inc(len(batch))
         self.m_batches.inc()
         self.m_proc.observe((time.perf_counter() - t0) * 1e6)
+        TRACER.record("apply", t0_wall, time.time(),
+                      chunk=self._trace_chunk, rows=len(batch))
         if batch.last_offset >= 0:
             prev = self._covered.get(batch.partition, 0)
             self._covered[batch.partition] = max(prev, batch.last_offset + 1)
@@ -307,6 +347,15 @@ class StreamWorker:
                 else:
                     time.sleep(self.config.idle_sleep)
             self.finalize()
+        except BaseException:
+            # flight-recorder dump on the way down: the last ring's worth
+            # of per-chunk spans is exactly the causality a post-mortem
+            # needs, and it is gone once the supervisor restarts us
+            path = TRACER.dump_on_error("worker")
+            if path:
+                log.error("worker error: flowtrace flight recorder "
+                          "dumped to %s", path)
+            raise
         finally:
             # A crash mid-loop (e.g. a sink raising in _emit) must not
             # leak the feed/group/flush threads: the group thread owns
@@ -365,6 +414,7 @@ class StreamWorker:
         emitted = False
         for name, model in self.models.items():
             if isinstance(model, WindowAggregator):
+                win = model.config.window_seconds
                 if self.flusher is not None:
                     # detach the closed stores under the lock (cheap dict
                     # pops); row building + sink writes run on the flusher
@@ -374,18 +424,23 @@ class StreamWorker:
 
                         cfg = model.config
                         self._emit(name, lambda c=cfg, s=stores:
-                                   rows_from_stores(c, s))
+                                   rows_from_stores(c, s),
+                                   export_ts=max(s for s, _ in stores)
+                                   + win)
                         emitted = True
                 else:
                     rows = model.flush(force)
                     if len(rows["timeslot"]):
-                        self._emit(f"{name}", rows, len(rows["timeslot"]))
+                        self._emit(f"{name}", rows, len(rows["timeslot"]),
+                                   export_ts=int(rows["timeslot"].max())
+                                   + win)
                         emitted = True
             elif isinstance(model, WindowedHeavyHitter):
                 for top in model.flush(force):
                     # dict, or an unresolved LazyWindowTop (lazy_extract):
                     # _emit materializes it wherever the write runs
-                    self._emit(f"{name}", top)
+                    self._emit(f"{name}", top,
+                               export_ts=self._top_export_ts(model, top))
                     emitted = True
             elif isinstance(model, DDoSDetector):
                 if force:
@@ -395,6 +450,17 @@ class StreamWorker:
                     self._emit(f"{name}", alerts, len(alerts))
                     emitted = True
         return emitted
+
+    @staticmethod
+    def _top_export_ts(model, top):
+        """Window-end export timestamp for one flushed top-K window —
+        dict rows carry a timeslot column, lazy handles the slot attr."""
+        slot = getattr(top, "timeslot", None)
+        if slot is None and isinstance(top, dict) and len(top["timeslot"]):
+            slot = int(top["timeslot"][0])
+        if slot is None:
+            return None
+        return int(slot) + model.window_seconds
 
     @staticmethod
     def _materialize(rows):
@@ -413,26 +479,49 @@ class StreamWorker:
             return int(rows["valid"].sum())
         return len(rows)
 
-    def _emit(self, table: str, rows, n: Optional[int] = None) -> None:
+    def _emit(self, table: str, rows, n: Optional[int] = None,
+              export_ts: Optional[float] = None) -> None:
         """Write rows (or a deferred producer of rows) to the sinks —
         inline, or via the background flusher when the ingest runtime is
         on. A flusher failure surfaces on the next submit/drain and fails
-        that step BEFORE its offsets commit (at-least-once)."""
+        that step BEFORE its offsets commit (at-least-once). export_ts
+        (window end, epoch s) feeds the commit-latency watermark; the
+        triggering chunk's id is captured here so flush spans stay tied
+        to the chunk that closed the window, across the thread hop."""
         self._emitted_since_snapshot = True
+        chunk = self._trace_chunk
         if self.flusher is not None:
             self.flusher.submit(
-                lambda: self._write_rows(table, rows, n))
+                lambda: self._write_rows(table, rows, n, export_ts, chunk))
             return
-        self._write_rows(table, rows, n)
+        self._write_rows(table, rows, n, export_ts, chunk)
 
-    def _write_rows(self, table: str, rows, n: Optional[int]) -> None:
+    def _write_rows(self, table: str, rows, n: Optional[int],
+                    export_ts: Optional[float] = None,
+                    chunk: int = -1) -> None:
         t0 = time.perf_counter()
+        t0_wall = time.time()
         rows = self._materialize(rows)
         n = self._row_count(rows) if n is None else n
         for sink in self.sinks:
             sink.write(table, rows)
         if self.flusher is not None:
             self.stages.observe("flushing", (time.perf_counter() - t0) * 1e6)
+        now = time.time()
+        TRACER.record("flush", t0_wall, now, chunk=chunk, table=table,
+                      rows=n)
+        if export_ts is not None:
+            # flow-export-timestamp -> sink-commit latency: how stale the
+            # serving tables are relative to the traffic they describe.
+            # A forced flush (shutdown) pops the still-OPEN window, whose
+            # end lies in the future — clamp to now so the latency can't
+            # go negative and the watermark never claims coverage beyond
+            # wall clock (late rows for that window would be new partials)
+            export_ts = min(export_ts, now)
+            self.m_commit_lat.observe(now - export_ts, table=table)
+            if export_ts > self._commit_watermark:
+                self._commit_watermark = export_ts
+                self.m_commit_wm.set(export_ts)
         self.m_rows.inc(n)
         log.info("flushed table=%s rows=%d", table, n)
 

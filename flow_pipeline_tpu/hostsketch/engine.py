@@ -225,7 +225,7 @@ class HostSketchEngine:
     # ---- the grouped update step ------------------------------------------
 
     def update(self, i: int, uniq: np.ndarray, sums: np.ndarray,
-               n_groups: int) -> None:
+               n_groups: int, stats=None) -> None:
         """Fold one prepared group table into family ``i`` — the host twin
         of heavy_hitter._apply_grouped. ``uniq`` [B, W] uint32 padded,
         ``sums`` [B, P+1] float32 (count plane last), first ``n_groups``
@@ -248,32 +248,33 @@ class HostSketchEngine:
             from .. import native
 
             native.hs_cms_update(st.cms, uniq, sums, None,
-                                 cfg.conservative, threads)
+                                 cfg.conservative, threads, stats=stats)
         else:
             np_cms_update(st.cms, uniq, sums, cfg.conservative)
         if cfg.table_prefilter and padded_b > 2 * cfg.capacity:
             uniq, sums = self._prefilter(st, uniq, sums, cfg.capacity,
-                                         threads)
+                                         threads, stats)
         if cfg.table_admission == "plain":
             est = sums
         else:
             if self.native:
                 from .. import native
 
-                est = native.hs_cms_query(st.cms, uniq, threads)
+                est = native.hs_cms_query(st.cms, uniq, threads,
+                                          stats=stats)
             else:
                 est = np_cms_query(st.cms, uniq)
         if self.native:
             from .. import native
 
             native.hs_topk_merge(st.table_keys, st.table_vals,
-                                 uniq, sums, est, None)
+                                 uniq, sums, est, None, stats=stats)
         else:
             st.table_keys, st.table_vals = np_topk_merge(
                 st.table_keys, st.table_vals, uniq, sums, est)
 
     def _prefilter(self, st: HostHHState, uniq: np.ndarray,
-                   sums: np.ndarray, cap: int, threads: int):
+                   sums: np.ndarray, cap: int, threads: int, stats=None):
         """Table-aware candidate truncation — _apply_grouped's prefilter
         block. Membership rides the same 32-bit hash lane (hash_lanes'
         first mix = the high word of ops.hostgroup.hash_u64), and the
@@ -284,7 +285,7 @@ class HostSketchEngine:
             from .. import native
 
             sel = native.hs_hh_prefilter(st.table_keys, uniq, sums,
-                                         threads)
+                                         threads, stats=stats)
         else:
             th = (hash_u64(np.ascontiguousarray(st.table_keys))
                   >> np.uint64(32)).astype(np.uint32)

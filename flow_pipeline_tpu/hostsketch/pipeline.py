@@ -42,10 +42,54 @@ from ..engine.hostfused import (
     report_native_degradation,
 )
 from ..ingest.shard import ShardPool
-from ..obs import get_logger
+from ..obs import REGISTRY, get_logger
 from .engine import HostSketchEngine, sketch_backend_available
 
 log = get_logger("hostsketch")
+
+# flowtrace phase counters: the in-kernel attribution (radix/refine/
+# regroup/fold/cms/prefilter/topk wall ns + row/group counts) the fused
+# pass accumulates into its stats out-struct, re-published as Prometheus
+# counters so the `host_fused` stage share can be broken down without
+# attaching a profiler. Labels are the FF_STAT phase names. This table
+# is the ONE definition of these families' names/help — StreamWorker
+# imports it to pre-register them so /metrics carries the family (as
+# zeros) on every worker, fused or not.
+PHASE_COUNTERS = {
+    "host_fused": (
+        "host_fused_phase_ns_total",
+        "host_fused stage wall ns by in-kernel phase "
+        "(radix|refine|regroup|fold|cms|prefilter|topk)"),
+    "host_sketch": (
+        "host_sketch_phase_ns_total",
+        "host_sketch (staged engine) wall ns by in-kernel phase"),
+    "host_group": (
+        "host_group_phase_ns_total",
+        "host_group ff_group_sum wall ns by in-kernel phase"),
+}
+ROWS_COUNTER = ("host_fused_rows_total",
+                "rows through the fused native dataplane")
+GROUPS_COUNTER = ("host_fused_groups_total",
+                  "groups produced by the fused native dataplane")
+
+
+def _publish_stats(stage: str, stats) -> None:
+    """Fold one zeroed-then-accumulated stats buffer into the stage's
+    phase counters (cheap: a handful of locked adds per chunk)."""
+    from .. import native
+
+    ctr = REGISTRY.counter(*PHASE_COUNTERS[stage])
+    for phase, slot in native.FF_STAT_SLOTS.items():
+        v = int(stats[slot])
+        if v:
+            ctr.inc(v, phase=phase)
+    if stage == "host_fused":
+        rows = int(stats[native.FF_STAT_ROWS])
+        groups = int(stats[native.FF_STAT_GROUPS])
+        if rows:
+            REGISTRY.counter(*ROWS_COUNTER).inc(rows)
+        if groups:
+            REGISTRY.counter(*GROUPS_COUNTER).inc(groups)
 
 
 class HostSketchPipeline(HostGroupPipeline):
@@ -91,6 +135,19 @@ class HostSketchPipeline(HostGroupPipeline):
         self._fused: bool = False
         # flowlint: unguarded -- built once at construction (_init_fused), read-only after
         self._fused_trees: list = []
+        # flowtrace stats buffers, one per thread context: the apply
+        # half (fused pass / staged engine) runs on the worker thread,
+        # the prepare half (ff_group_sum) on the ingest group thread —
+        # sharing one buffer would race the accumulation.
+        # flowlint: unguarded -- worker thread only (apply half)
+        self._apply_stats = None
+        # flowlint: unguarded -- group thread only (prepare half)
+        self._group_stats = None
+        from .. import native as _native
+
+        if _native.available():
+            self._apply_stats = _native.new_stats()
+            self._group_stats = _native.new_stats()
         self._init_fused(fused, sketch_native)
 
     # ---- fused dataplane plan ---------------------------------------------
@@ -220,7 +277,12 @@ class HostSketchPipeline(HostGroupPipeline):
         if self._fused:
             from .. import native
 
-            res = native.group_sum(lanes, planes)
+            stats = self._group_stats
+            if stats is not None:
+                stats[:] = 0
+            res = native.group_sum(lanes, planes, stats=stats)
+            if stats is not None:
+                _publish_stats("host_group", stats)
             if res is not None:
                 return res
             # 64-bit hash collision between distinct keys (~n^2/2^65):
@@ -249,6 +311,9 @@ class HostSketchPipeline(HostGroupPipeline):
             plan.ddos_parent >= 0 for _, plan in self._fused_trees)
         if not (do_hh or need_ddos):
             return ddos_in
+        stats = self._apply_stats
+        if stats is not None:
+            stats[:] = 0
         with self.stages.stage("host_fused"):
             for (ms, plan), (lanes, vals) in zip(self._fused_trees,
                                                  ch.fused_in):
@@ -265,12 +330,15 @@ class HostSketchPipeline(HostGroupPipeline):
                 res = native.fused_update(lanes, vals, plan, states,
                                           do_sketch=do_hh,
                                           do_ddos=need_ddos and tree_ddos,
-                                          threads=self._engine.threads)
+                                          threads=self._engine.threads,
+                                          stats=stats)
                 if do_hh:
                     for i in ms:
                         self._sketch_dirty[i] = True
                 if res is not None:
                     ddos_in = self._pad_ddos(res[0], res[1])
+        if stats is not None:
+            _publish_stats("host_fused", stats)
         return ddos_in
 
     def _apply_chunk(self, ch: PreparedChunk, do_hh: bool,
@@ -279,11 +347,16 @@ class HostSketchPipeline(HostGroupPipeline):
         if ch.fused_in is not None:
             raw_ddos = self._run_fused(ch, do_hh, do_dd)
         elif do_hh and ch.hh_in is not None:
+            stats = self._apply_stats if self._engine.native else None
+            if stats is not None:
+                stats[:] = 0
             with self.stages.stage("host_sketch"):
                 for i, (u, s, g) in enumerate(ch.hh_in):
                     self._ensure_imported(i)
-                    self._engine.update(i, u, s, g)
+                    self._engine.update(i, u, s, g, stats=stats)
                     self._sketch_dirty[i] = True
+            if stats is not None:
+                _publish_stats("host_sketch", stats)
         # do_hh False is a late part: the jitted path would run the merge
         # with all-invalid candidates, a proven no-op — skipping is exact.
         if self._apply_rest is None:

@@ -33,9 +33,11 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Optional
 
 from ..obs import REGISTRY, get_logger
+from ..obs.trace import TRACER
 
 log = get_logger("ingest.executor")
 
@@ -85,8 +87,13 @@ class PipelinedExecutor:
             if self._error is not None:
                 raise self._error
             try:
-                item = self._out.get(timeout=self.idle_sleep)
+                item, t_enq, chunk = self._out.get(timeout=self.idle_sleep)
                 self.m_depth.set(self._out.qsize(), stage="group")
+                # queue-wait: prepared-to-picked-up — the interval that
+                # shows whether the device step or the group thread is
+                # the bottleneck for THIS chunk
+                TRACER.record("queue_wait", t_enq, time.time(),
+                              chunk=chunk, stage="group")
                 return item
             except queue.Empty:
                 if not self._thread.is_alive():
@@ -148,8 +155,10 @@ class PipelinedExecutor:
                 self._completed_start = round_no
                 self._stop.wait(self.idle_sleep)
                 continue
+            chunk = getattr(batch, "chunk_id", -1)
             try:
-                prep = self.prepare(batch)
+                with TRACER.span("prepare", chunk=chunk, rows=len(batch)):
+                    prep = self.prepare(batch)
             except Exception as e:  # noqa: BLE001 — surface via next()
                 log.exception("ingest prepare failed; surfacing to worker")
                 self._error = e
@@ -158,7 +167,7 @@ class PipelinedExecutor:
             self._completed_start = round_no
             # space is guaranteed: this thread is the only producer and
             # it checked full() above; next() only ever removes items
-            self._out.put(prep)
+            self._out.put((prep, time.time(), chunk))
             depth = self._out.qsize()
             self.m_depth.set(depth, stage="group")
             if depth > self.high_water:

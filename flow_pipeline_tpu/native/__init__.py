@@ -102,6 +102,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_void_p,  # [n] int32 perm out
             ctypes.c_void_p,  # [n] int32 starts out
             ctypes.POINTER(ctypes.c_int32),  # collided out
+            ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
         ]
     if hasattr(lib, "hs_cms_update"):  # pre-r8 .so lacks the sketch engine
         lib.hs_cms_update.restype = ctypes.c_longlong
@@ -114,6 +115,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_void_p,  # [n] uint8 valid (NULL = all)
             ctypes.c_int,     # conservative
             ctypes.c_int,     # threads
+            ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
         ]
         lib.hs_cms_query.restype = ctypes.c_longlong
         lib.hs_cms_query.argtypes = [
@@ -123,6 +125,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_longlong, ctypes.c_longlong,
             ctypes.c_void_p,  # [n, P] float32 out
             ctypes.c_int,     # threads
+            ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
         ]
         lib.hs_hh_prefilter.restype = ctypes.c_longlong
         lib.hs_hh_prefilter.argtypes = [
@@ -133,6 +136,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_longlong, ctypes.c_longlong,
             ctypes.c_void_p,  # [2*cap] int32 selection out
             ctypes.c_int,     # threads
+            ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
         ]
         lib.hs_topk_merge.restype = ctypes.c_longlong
         lib.hs_topk_merge.argtypes = [
@@ -144,6 +148,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_void_p,  # [n, P] float32 CMS estimates
             ctypes.c_void_p,  # [n] uint8 valid (NULL = all)
             ctypes.c_longlong,
+            ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
         ]
     if hasattr(lib, "ff_group_sum"):  # pre-r10 .so lacks the fused plane
         lib.ff_group_sum.restype = ctypes.c_longlong
@@ -155,6 +160,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_void_p,  # [n, w] uint32 uniq out
             ctypes.c_void_p,  # [n, p] uint64 sums out
             ctypes.c_void_p,  # [n] int64 counts out
+            ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
         ]
     if hasattr(lib, "ff_fused_update"):
         lib.ff_fused_update.restype = ctypes.c_longlong
@@ -182,12 +188,52 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_void_p,  # [n, ddos_sel_w] uint32 ddos keys out
             ctypes.c_void_p,  # [n] float32 ddos sums out
             ctypes.c_int,     # threads
+            ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
         ]
     return lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+# ---- flowtrace phase counters ----------------------------------------------
+#
+# Every groupby/sketch kernel takes an optional trailing int64 stats
+# buffer it ACCUMULATES per-phase wall nanoseconds and row/group counts
+# into — the in-kernel attribution that the single-pass fused dataplane
+# erased from the Python-side stage timers. Slot layout mirrors the
+# FF_STAT_* enum in native/ffstat.h (the C side is authoritative;
+# tests/test_flowtrace.py pins the two in sync via behavior).
+FF_STATS_LEN = 16
+FF_STAT_SLOTS = {
+    "radix": 0,      # LSD radix passes incl. the row-hash pass (ns)
+    "refine": 1,     # run refinement + group boundary scan (ns)
+    "regroup": 2,    # cascade regroup: gather + group + fold (ns)
+    "cms": 3,        # hs_cms_update (ns)
+    "prefilter": 4,  # hs_hh_prefilter (ns)
+    "topk": 5,       # hs_cms_query (admission est) + hs_topk_merge (ns)
+    "fold": 6,       # root group-table accumulation (ns)
+}
+FF_STAT_PHASES = tuple(FF_STAT_SLOTS)  # ns-valued phase slots, in order
+FF_STAT_ROWS = 7
+FF_STAT_GROUPS = 8
+FF_STAT_RADIX_PASSES = 9
+
+
+def new_stats() -> np.ndarray:
+    """A zeroed stats buffer kernels accumulate into (reusable across
+    calls — callers zero or diff it themselves)."""
+    return np.zeros(FF_STATS_LEN, np.int64)
+
+
+def _stats_ptr(stats):
+    """Validated ctypes arg for an optional stats buffer."""
+    if stats is None:
+        return None
+    assert stats.dtype == np.int64 and stats.flags["C_CONTIGUOUS"] \
+        and stats.shape == (FF_STATS_LEN,)
+    return _c_arr(stats)
 
 
 # Feature -> witness symbol: the capability surface operators and the
@@ -264,7 +310,7 @@ def group_available() -> bool:
     return lib is not None and hasattr(lib, "flow_hash_group")
 
 
-def hash_group(lanes: np.ndarray):
+def hash_group(lanes: np.ndarray, stats: Optional[np.ndarray] = None):
     """Native hash-grouping of [N, W] uint32 key lanes.
 
     Computes the same 64-bit row hash as ops.hostgroup.hash_u64, radix-
@@ -287,6 +333,7 @@ def hash_group(lanes: np.ndarray):
         perm.ctypes.data_as(ctypes.c_void_p),
         starts.ctypes.data_as(ctypes.c_void_p),
         ctypes.byref(collided),
+        _stats_ptr(stats),
     )
     if g < 0:
         raise ValueError("flow_hash_group failed (batch too large?)")
@@ -305,7 +352,8 @@ def _c_arr(arr: np.ndarray):
 
 
 def hs_cms_update(cms: np.ndarray, keys: np.ndarray, vals: np.ndarray,
-                  valid, conservative: bool, threads: int = 1) -> None:
+                  valid, conservative: bool, threads: int = 1,
+                  stats: Optional[np.ndarray] = None) -> None:
     """Native uint64 CMS update (plain or conservative) in place.
 
     cms [P, D, W] uint64 C-contiguous; keys [n, kw] uint32; vals [n, P]
@@ -326,14 +374,14 @@ def hs_cms_update(cms: np.ndarray, keys: np.ndarray, vals: np.ndarray,
         vptr = _c_arr(valid)
     rc = lib.hs_cms_update(_c_arr(cms), p, d, w, _c_arr(keys), n, kw,
                            _c_arr(vals), vptr, int(bool(conservative)),
-                           int(threads))
+                           int(threads), _stats_ptr(stats))
     if rc != 0:
         raise ValueError(f"hs_cms_update failed (rc={rc}): degenerate "
                          f"shape planes={p} depth={d} width={w}")
 
 
-def hs_cms_query(cms: np.ndarray, keys: np.ndarray,
-                 threads: int = 1) -> np.ndarray:
+def hs_cms_query(cms: np.ndarray, keys: np.ndarray, threads: int = 1,
+                 stats: Optional[np.ndarray] = None) -> np.ndarray:
     """Native CMS point query: [n, P] float32 min-over-depth estimates."""
     lib = _load()
     if lib is None or not hasattr(lib, "hs_cms_query"):
@@ -345,14 +393,15 @@ def hs_cms_query(cms: np.ndarray, keys: np.ndarray,
     n, kw = keys.shape
     out = np.empty((n, p), np.float32)
     rc = lib.hs_cms_query(_c_arr(cms), p, d, w, _c_arr(keys), n, kw,
-                          _c_arr(out), int(threads))
+                          _c_arr(out), int(threads), _stats_ptr(stats))
     if rc != 0:
         raise ValueError(f"hs_cms_query failed (rc={rc})")
     return out
 
 
 def hs_hh_prefilter(table_keys: np.ndarray, cand_keys: np.ndarray,
-                    cand_sums: np.ndarray, threads: int = 1) -> np.ndarray:
+                    cand_sums: np.ndarray, threads: int = 1,
+                    stats: Optional[np.ndarray] = None) -> np.ndarray:
     """Native table-aware candidate prefilter: selected row indices in
     (metric desc, index asc) order — lax.top_k's tie-break. Returns
     [min(n, 2*cap)] int32."""
@@ -368,7 +417,7 @@ def hs_hh_prefilter(table_keys: np.ndarray, cand_keys: np.ndarray,
     sel = np.empty(2 * cap, np.int32)
     m = lib.hs_hh_prefilter(_c_arr(table_keys), cap, kw, _c_arr(cand_keys),
                             _c_arr(cand_sums), n, planes, _c_arr(sel),
-                            int(threads))
+                            int(threads), _stats_ptr(stats))
     if m < 0:
         raise ValueError(f"hs_hh_prefilter failed (rc={m})")
     return sel[:m]
@@ -376,7 +425,8 @@ def hs_hh_prefilter(table_keys: np.ndarray, cand_keys: np.ndarray,
 
 def hs_topk_merge(table_keys: np.ndarray, table_vals: np.ndarray,
                   cand_keys: np.ndarray, cand_sums: np.ndarray,
-                  cand_est: np.ndarray, valid) -> int:
+                  cand_est: np.ndarray, valid,
+                  stats: Optional[np.ndarray] = None) -> int:
     """Native space-saving admission merge, in place on the table buffers
     (ops.topk.topk_merge_est semantics — pass cand_est=cand_sums for the
     'plain' batch-sum merge). Returns the number of real rows."""
@@ -400,7 +450,8 @@ def hs_topk_merge(table_keys: np.ndarray, table_vals: np.ndarray,
         vptr = _c_arr(valid)
     rc = lib.hs_topk_merge(_c_arr(table_keys), _c_arr(table_vals),
                            cap, kw, planes, _c_arr(cand_keys),
-                           _c_arr(cand_sums), _c_arr(cand_est), vptr, n)
+                           _c_arr(cand_sums), _c_arr(cand_est), vptr, n,
+                           _stats_ptr(stats))
     if rc < 0:
         raise ValueError(f"hs_topk_merge failed (rc={rc}): degenerate "
                          f"shape cap={cap} kw={kw} planes={planes}")
@@ -415,7 +466,8 @@ def fused_available() -> bool:
     return lib is not None and hasattr(lib, "ff_fused_update")
 
 
-def group_sum(lanes: np.ndarray, vals: np.ndarray):
+def group_sum(lanes: np.ndarray, vals: np.ndarray,
+              stats: Optional[np.ndarray] = None):
     """Single-pass exact groupby-sum (ff_group_sum): the native twin of
     ops.hostgroup.group_by_key(exact=True) over integer planes.
 
@@ -440,7 +492,8 @@ def group_sum(lanes: np.ndarray, vals: np.ndarray):
     sums = np.empty((n, p), np.uint64)
     counts = np.empty(max(n, 1), np.int64)
     g = lib.ff_group_sum(_c_arr(lanes), n, w, _c_arr(vals), p,
-                         _c_arr(uniq), _c_arr(sums), _c_arr(counts))
+                         _c_arr(uniq), _c_arr(sums), _c_arr(counts),
+                         _stats_ptr(stats))
     if g == -2:
         return None  # 64-bit collision: caller takes the exact fallback
     if g < 0:
@@ -472,7 +525,8 @@ class FusedPlan:
 
 def fused_update(lanes: np.ndarray, vals: np.ndarray, plan: FusedPlan,
                  states, do_sketch: bool, do_ddos: bool = True,
-                 threads: int = 1):
+                 threads: int = 1,
+                 stats: Optional[np.ndarray] = None):
     """One fused group->cascade->sketch pass over a chunk's root-family
     lanes (ff_fused_update): every family's CMS/prefilter/top-K state in
     ``states`` (HostHHState per family, plan order) is updated IN PLACE;
@@ -545,7 +599,7 @@ def fused_update(lanes: np.ndarray, vals: np.ndarray, plan: FusedPlan,
         plan.ddos_plane if ddos_parent >= 0 else -1,
         _c_arr(ddos_keys) if ddos_keys is not None else None,
         _c_arr(ddos_sums) if ddos_sums is not None else None,
-        int(threads))
+        int(threads), _stats_ptr(stats))
     if g < 0:
         raise ValueError(f"ff_fused_update failed (rc={g}): degenerate "
                          f"shape n={n} w={w} p={p} nf={nf}")

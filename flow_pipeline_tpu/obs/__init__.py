@@ -9,17 +9,23 @@ metrics port is meant to be scraped (the reference never adds :8081 to
 prometheus.yml).
 """
 
-from .metrics import Counter, Gauge, Summary, MetricsRegistry, REGISTRY
+from .metrics import (Counter, Gauge, Histogram, Summary, MetricsRegistry,
+                      REGISTRY)
 from .server import MetricsServer
 from .logging import get_logger, set_level
+from .trace import TRACER, TraceRecorder, next_chunk_id
 
 __all__ = [
     "Counter",
     "Gauge",
+    "Histogram",
     "Summary",
     "MetricsRegistry",
     "REGISTRY",
     "MetricsServer",
+    "TRACER",
+    "TraceRecorder",
+    "next_chunk_id",
     "get_logger",
     "set_level",
 ]

@@ -1,4 +1,5 @@
-"""Minimal Prometheus-compatible metrics: counters, gauges, summaries.
+"""Minimal Prometheus-compatible metrics: counters, gauges, summaries,
+histograms.
 
 Dependency-free (no prometheus_client in the image); renders the text
 exposition format v0.0.4. Metric names follow the reference's observed
@@ -9,6 +10,13 @@ latency summaries GoFlow exposes (SURVEY.md §2-C12).
 
 from __future__ import annotations
 
+# flowlint: lock-checked
+# (metrics are mutated from every pipeline thread — worker, group,
+# flusher, feed, HTTP scrape handlers — so each metric owns one _lock
+# and every mutable field declares it below; `make lint` verifies the
+# write sites — see docs/STATIC_ANALYSIS.md)
+
+import bisect
 import threading
 from collections import deque
 from typing import Optional
@@ -26,7 +34,7 @@ class Counter:
         self.name = name
         self.help = help_
         self._lock = threading.Lock()
-        self._values: dict[tuple, float] = {}
+        self._values: dict[tuple, float] = {}  # guarded-by: _lock
 
     def inc(self, amount: float = 1.0, **labels) -> None:
         key = tuple(sorted(labels.items()))
@@ -56,6 +64,7 @@ class Gauge(Counter):
     def set(self, value: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
         with self._lock:
+            # flowlint: disable=lock-discipline -- _values is declared guarded-by _lock in Counter.__init__ (the checker is per-class and cannot see base-class annotations); this write holds that lock
             self._values[key] = value
 
 
@@ -85,11 +94,12 @@ class Summary:
         self._window = window
         self._max_label_sets = max_label_sets
         self._lock = threading.Lock()
-        self._obs: dict[tuple, deque] = {}
-        self._sums: dict[tuple, float] = {}
-        self._counts: dict[tuple, int] = {}
-        self._sum = 0.0  # totals across label sets (stage budgets)
-        self._count = 0
+        self._obs: dict[tuple, deque] = {}  # guarded-by: _lock
+        self._sums: dict[tuple, float] = {}  # guarded-by: _lock
+        self._counts: dict[tuple, int] = {}  # guarded-by: _lock
+        # totals across label sets (stage budgets)
+        self._sum = 0.0  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
 
     def observe(self, value: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
@@ -140,10 +150,110 @@ class Summary:
         return "\n".join(lines)
 
 
+# Default buckets for microsecond-scale stage latencies: log-ish spacing
+# from 100us (a cheap host stage) to 10s (a wedged sink write), the span
+# the pipeline's stages actually occupy.
+DEFAULT_US_BUCKETS = (
+    100.0, 250.0, 500.0, 1_000.0, 2_500.0, 5_000.0, 10_000.0, 25_000.0,
+    50_000.0, 100_000.0, 250_000.0, 500_000.0, 1_000_000.0, 2_500_000.0,
+    10_000_000.0,
+)
+
+
+class Histogram:
+    """Prometheus-native histogram: cumulative ``le`` buckets plus
+    ``_sum``/``_count``.
+
+    This exists next to Summary because the two are NOT interchangeable
+    for fleet dashboards: a Summary exports pre-computed per-instance
+    quantiles, which cannot be aggregated across workers (the p99 of
+    p99s is not the fleet p99), while histogram buckets are plain
+    counters — ``sum by (le)`` across instances then
+    ``histogram_quantile`` gives honest fleet-wide quantiles, and the
+    bucket matrix renders as a Grafana heatmap.
+
+    Labels follow Summary's contract, including the cardinality cap:
+    distinct label sets beyond ``max_label_sets`` fold into a per-name
+    ``_other`` series, so attacker-influenced label values cannot grow
+    the family unbounded (each label set pins len(buckets)+2 series)."""
+
+    _kind = "histogram"
+
+    def __init__(self, name: str, help_: str = "",
+                 buckets: tuple = DEFAULT_US_BUCKETS,
+                 max_label_sets: int = 64):
+        self.name = name
+        self.help = help_
+        self._buckets = tuple(sorted(float(b) for b in buckets))
+        if not self._buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._max_label_sets = max_label_sets
+        self._lock = threading.Lock()
+        # per label set: cumulative bucket counts (+Inf last), sum, count
+        self._counts: dict[tuple, list[int]] = {}  # guarded-by: _lock
+        self._sums: dict[tuple, float] = {}  # guarded-by: _lock
+
+    def _bucket_index(self, value: float) -> int:
+        return bisect.bisect_left(self._buckets, value)
+
+    def observe(self, value: float, **labels) -> None:
+        idx = self._bucket_index(value)
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                if key and len(self._counts) >= self._max_label_sets:
+                    # cardinality cap: fold the tail into _other (same
+                    # trade as Summary — the tail stays measured, the
+                    # scrape stays bounded)
+                    key = tuple((name, "_other") for name, _ in key)
+                    counts = self._counts.get(key)
+                if counts is None:
+                    counts = self._counts[key] = \
+                        [0] * (len(self._buckets) + 1)
+            counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def value(self, **labels) -> tuple[int, float]:
+        """(count, sum) for one label set — test/debug surface."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.get(key)
+            return (sum(counts) if counts else 0,
+                    self._sums.get(key, 0.0))
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self._kind}"]
+        with self._lock:
+            snap = {k: list(v) for k, v in self._counts.items()} or \
+                {(): [0] * (len(self._buckets) + 1)}
+            sums = dict(self._sums)
+        for key, counts in snap.items():
+            cum = 0
+            for bound, c in zip(self._buckets, counts):
+                cum += c
+                labels = _fmt_labels({**dict(key), "le": _fmt_le(bound)})
+                lines.append(f"{self.name}_bucket{labels} {cum}")
+            cum += counts[-1]
+            labels = _fmt_labels({**dict(key), "le": "+Inf"})
+            lines.append(f"{self.name}_bucket{labels} {cum}")
+            plain = _fmt_labels(dict(key))
+            lines.append(f"{self.name}_sum{plain} {sums.get(key, 0.0)}")
+            lines.append(f"{self.name}_count{plain} {cum}")
+        return "\n".join(lines)
+
+
+def _fmt_le(bound: float) -> str:
+    """Integral bounds render without the trailing .0 (Prometheus
+    convention: le="1000", not le="1000.0")."""
+    return str(int(bound)) if bound == int(bound) else str(bound)
+
+
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: dict[str, object] = {}
+        self._metrics: dict[str, object] = {}  # guarded-by: _lock
 
     def counter(self, name: str, help_: str = "") -> Counter:
         return self._get_or_make(name, lambda: Counter(name, help_), Counter)
@@ -156,6 +266,13 @@ class MetricsRegistry:
         return self._get_or_make(
             name, lambda: Summary(name, help_, window, max_label_sets),
             Summary)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: tuple = DEFAULT_US_BUCKETS,
+                  max_label_sets: int = 64) -> Histogram:
+        return self._get_or_make(
+            name, lambda: Histogram(name, help_, buckets, max_label_sets),
+            Histogram)
 
     def _get_or_make(self, name, factory, cls):
         with self._lock:

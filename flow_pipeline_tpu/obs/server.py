@@ -1,8 +1,11 @@
 """HTTP /metrics endpoint (the reference exposes :8081/metrics,
-ref: inserter/inserter.go:28-29,69-73)."""
+ref: inserter/inserter.go:28-29,69-73), plus the flowtrace flight
+recorder's /debug/trace dump (Chrome trace-event JSON — open in
+Perfetto or chrome://tracing)."""
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -18,6 +21,18 @@ class MetricsServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
+                if self.path == "/debug/trace":
+                    # flight-recorder snapshot: the last ring's worth of
+                    # per-chunk spans across the pipeline threads
+                    from .trace import TRACER
+
+                    body = json.dumps(TRACER.chrome_trace()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path not in ("/metrics", "/"):
                     self.send_response(404)
                     self.end_headers()

@@ -4,7 +4,10 @@ is GoFlow's per-stage latency summaries; here we add real device traces).
 - ``device_trace``: context manager around jax.profiler.trace — captures a
   TensorBoard-loadable trace of everything the device executed.
 - ``StageTimer``: host-side per-stage wall-clock accumulation exposed as
-  the flow_summary_*_time_us metric family the reference dashboards chart.
+  the flow_summary_*_time_us metric family the reference dashboards chart,
+  PLUS the aggregable ``flow_stage_duration_us`` histogram (cumulative
+  ``le`` buckets by stage — Summary quantiles cannot be summed across
+  workers; histogram buckets can, and they render as Grafana heatmaps).
 """
 
 from __future__ import annotations
@@ -13,6 +16,16 @@ import contextlib
 import time
 
 from .metrics import REGISTRY
+
+# Stage names are dynamic (callers mint them), and every distinct name
+# registers a whole summary family plus a histogram label set — so the
+# family is CAPPED exactly like r08 capped labeled summaries: beyond
+# MAX_STAGES distinct names, observations fold into the single
+# ``flow_summary_other_time_us`` overflow series (measured, bounded).
+MAX_STAGES = 64
+OVERFLOW_STAGE = "other"
+
+STAGE_HISTOGRAM = "flow_stage_duration_us"
 
 
 @contextlib.contextmanager
@@ -33,10 +46,26 @@ def device_trace(logdir: str):
 
 
 class StageTimer:
-    """Named per-stage timers -> flow_summary_<stage>_time_us summaries."""
+    """Named per-stage timers -> flow_summary_<stage>_time_us summaries
+    + the shared flow_stage_duration_us{stage=...} histogram."""
 
     def __init__(self):
         self._summaries = {}
+        # registered eagerly so /metrics (and the dashboard honesty
+        # test) sees the family before the first stage observation
+        self._hist = REGISTRY.histogram(
+            STAGE_HISTOGRAM,
+            "per-stage wall time histogram (us; aggregable across "
+            "instances, unlike the summary quantiles)")
+
+    def _resolve(self, name: str) -> str:
+        """Overflow guard: a caller minting unbounded stage names (e.g. a
+        name built from input data) must not grow the metric family
+        unbounded — beyond MAX_STAGES distinct names, the tail folds into
+        the single overflow stage (measured, bounded)."""
+        if name in self._summaries or len(self._summaries) < MAX_STAGES:
+            return name
+        return OVERFLOW_STAGE
 
     def _summary(self, name: str):
         s = self._summaries.get(name)
@@ -50,13 +79,14 @@ class StageTimer:
         """Record one measurement directly (for callers that must decide
         AFTER the fact whether a timing is worth recording, e.g. skipping
         no-op flushes that would bury real latency in the quantiles)."""
+        name = self._resolve(name)
         self._summary(name).observe(us)
+        self._hist.observe(us, stage=name)
 
     @contextlib.contextmanager
     def stage(self, name: str):
-        s = self._summary(name)
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            s.observe((time.perf_counter() - t0) * 1e6)
+            self.observe(name, (time.perf_counter() - t0) * 1e6)

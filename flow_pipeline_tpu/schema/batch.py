@@ -98,6 +98,10 @@ class FlowBatch:
     partition: int = 0
     first_offset: int = -1
     last_offset: int = -1
+    # flowtrace chunk id, minted at decode (transport.consumer) — ties
+    # one chunk's spans together across the feed/group/worker/flusher
+    # threads. -1 = not traced (batches built outside the consume path).
+    chunk_id: int = -1
 
     # ---- construction -----------------------------------------------------
 
@@ -184,7 +188,7 @@ class FlowBatch:
         cols = {k: v[start:stop] for k, v in self.columns.items()}
         first = self.first_offset + start if self.first_offset >= 0 else -1
         last = self.first_offset + stop - 1 if self.first_offset >= 0 else -1
-        return FlowBatch(cols, self.partition, first, last)
+        return FlowBatch(cols, self.partition, first, last, self.chunk_id)
 
     def pad_to(self, n: int) -> tuple["FlowBatch", np.ndarray]:
         """Pad to length n (static shapes for jit); returns (batch, valid mask).
@@ -204,7 +208,8 @@ class FlowBatch:
             padded = np.zeros(shape, dtype=v.dtype)
             padded[:cur] = v
             cols[k] = padded
-        return FlowBatch(cols, self.partition, self.first_offset, self.last_offset), mask
+        return FlowBatch(cols, self.partition, self.first_offset,
+                         self.last_offset, self.chunk_id), mask
 
     @staticmethod
     def concat(batches: list["FlowBatch"]) -> "FlowBatch":

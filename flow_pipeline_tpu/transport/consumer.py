@@ -10,6 +10,9 @@ from __future__ import annotations
 
 from typing import Optional
 
+import time
+
+from ..obs.trace import TRACER, next_chunk_id
 from ..obs.tracing import StageTimer
 from ..schema import wire
 from ..schema.batch import FlowBatch
@@ -64,26 +67,38 @@ class Consumer:
                 if span is None:
                     continue
                 data, first, last = span
+                t0 = time.time()
                 with _STAGES.stage("consume_decode"):
                     batch = FlowBatch.from_wire(data)
                 batch.partition = p
                 batch.first_offset = first
                 batch.last_offset = last
                 self.positions[p] = last + 1
+                self._trace_decode(batch, t0)
                 return batch
             with _STAGES.stage("consume_fetch"):
                 msgs = self.bus.fetch(self.topic, p, self.positions[p],
                                       max_messages)
             if not msgs:
                 continue
+            t0 = time.time()
             with _STAGES.stage("consume_decode"):
                 batch = self._decode(msgs)
             batch.partition = p
             batch.first_offset = msgs[0].offset
             batch.last_offset = msgs[-1].offset
             self.positions[p] = msgs[-1].offset + 1
+            self._trace_decode(batch, t0)
             return batch
         return None
+
+    @staticmethod
+    def _trace_decode(batch: FlowBatch, t0: float) -> None:
+        """Mint the flowtrace chunk id (decode is where a chunk is born)
+        and record the decode span under it."""
+        batch.chunk_id = next_chunk_id()
+        TRACER.record("decode", t0, time.time(), chunk=batch.chunk_id,
+                      rows=len(batch), partition=batch.partition)
 
     def _rotation(self):
         # rotate start partition so one hot partition cannot starve others

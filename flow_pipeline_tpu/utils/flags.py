@@ -40,7 +40,7 @@ KNOWN_FLAGS = frozenset({
     "ingest.mode", "ingest.shards", "ingest.depth", "ingest.flush_queue",
     "ingest.native_group", "ingest.fused",
     "checkpoint.path", "flush.count", "metrics.addr", "sink", "in",
-    "listen.feed", "query.addr",
+    "listen.feed", "query.addr", "obs.trace",
     # inserter
     "postgres.dsn", "postgres.pass", "sqlite", "flush.dur",
     # topic admin

@@ -24,6 +24,8 @@
 #include <utility>
 #include <vector>
 
+#include "ffstat.h"  // flowtrace stats out-struct: slots + ff_now_ns
+
 namespace {
 
 // scalar columns in schema.batch.COLUMNS order; width in bytes (4 or 8)
@@ -266,13 +268,16 @@ extern "C" {
 // `starts` (both caller-allocated, n int32 entries), and sets *collided
 // when two DISTINCT lane rows share a 64-bit hash (callers needing
 // exactness re-group lexicographically, same contract as the numpy path).
-// Returns the number of groups, or -1 when n exceeds int32 indexing.
+// `stats` (nullable) accumulates radix/refine wall ns + row/group counts
+// (slot layout above). Returns the number of groups, or -1 when n
+// exceeds int32 indexing.
 long long flow_hash_group(const uint32_t* lanes, long long n, long long w,
                           int32_t* perm, int32_t* starts,
-                          int32_t* collided) {
+                          int32_t* collided, int64_t* stats) {
   *collided = 0;
   if (n <= 0) return 0;
   if (n > INT32_MAX) return -1;
+  int64_t t0 = ff_now_ns(stats);
   // hash + index pairs, double-buffered for the LSD radix passes
   uint64_t* h = new uint64_t[2 * n];
   uint32_t* idx = new uint32_t[2 * n];
@@ -309,6 +314,7 @@ long long flow_hash_group(const uint32_t* lanes, long long n, long long w,
     uint64_t* th = h; h = hb; hb = th;
     uint32_t* ti = idx; idx = ib; ib = ti;
   }
+  int64_t t1 = ff_now_ns(stats);
   for (int64_t i = 0; i < n;) {
     int64_t j = i + 1;
     while (j < n && (h[j] >> 32) == (h[i] >> 32)) ++j;
@@ -370,6 +376,13 @@ long long flow_hash_group(const uint32_t* lanes, long long n, long long w,
   // ended up back in the originally-allocated halves — free matches new[]
   delete[] (h < hb ? h : hb);
   delete[] (idx < ib ? idx : ib);
+  if (stats != nullptr) {
+    stats[FF_STAT_RADIX_NS] += t1 - t0;
+    stats[FF_STAT_REFINE_NS] += ff_now_ns(stats) - t1;
+    stats[FF_STAT_ROWS] += n;
+    stats[FF_STAT_GROUPS] += n_groups;
+    stats[FF_STAT_RADIX_PASSES] += 4;
+  }
   return n_groups;
 }
 

@@ -49,30 +49,39 @@
 #include <cstring>
 #include <vector>
 
+#include "ffstat.h"  // flowtrace stats out-struct: slots + ff_now_ns
+
 extern "C" {
 // in-library kernels (definitions in flowdecode.cc / hostsketch.cc)
 long long flow_hash_group(const uint32_t* lanes, long long n, long long w,
-                          int32_t* perm, int32_t* starts, int32_t* collided);
+                          int32_t* perm, int32_t* starts, int32_t* collided,
+                          int64_t* stats);
 long long hs_cms_update(uint64_t* cms, long long planes, long long depth,
                         long long width, const uint32_t* keys, long long n,
                         long long kw, const float* vals,
-                        const uint8_t* valid, int conservative, int threads);
+                        const uint8_t* valid, int conservative, int threads,
+                        int64_t* stats);
 long long hs_cms_query(const uint64_t* cms, long long planes,
                        long long depth, long long width,
                        const uint32_t* keys, long long n, long long kw,
-                       float* out, int threads);
+                       float* out, int threads, int64_t* stats);
 long long hs_hh_prefilter(const uint32_t* table_keys, long long cap,
                           long long kw, const uint32_t* uniq,
                           const float* sums, long long n, long long planes,
-                          int32_t* sel_out, int threads);
+                          int32_t* sel_out, int threads, int64_t* stats);
 long long hs_topk_merge(uint32_t* table_keys, float* table_vals,
                         long long cap, long long kw, long long planes,
                         const uint32_t* cand_keys, const float* cand_sums,
                         const float* cand_est, const uint8_t* cand_valid,
-                        long long n);
+                        long long n, int64_t* stats);
 }  // extern "C"
 
 namespace {
+
+// flowtrace stats (ffstat.h): the fused pass attributes root grouping
+// to radix/refine (inside flow_hash_group), cascade work to regroup,
+// group-table accumulation to fold, and passes the buffer through to
+// the hs_* kernels for the sketch phases.
 
 // One family's group table, host-resident for the duration of a call.
 // Value sums stay double until the sketch addends are built — the
@@ -94,12 +103,13 @@ struct FamTable {
 // ff_group_sum below, which surfaces the collision instead.
 long long group_lanes(const uint32_t* lanes, long long m, long long wk,
                       std::vector<int32_t>& perm,
-                      std::vector<int32_t>& starts, int32_t* collided) {
+                      std::vector<int32_t>& starts, int32_t* collided,
+                      int64_t* stats) {
   perm.resize(static_cast<size_t>(m));
   starts.resize(static_cast<size_t>(std::max<long long>(m, 1)));
   *collided = 0;
   return flow_hash_group(lanes, m, wk, perm.data(), starts.data(),
-                         collided);
+                         collided, stats);
 }
 
 // Fold a grouping into a FamTable: representative keys, double value
@@ -147,7 +157,8 @@ void accumulate(const uint32_t* lanes, long long m, long long wk,
 long long sketch_family(const FamTable& fam, long long p, long long depth,
                         long long width, long long cap, int conservative,
                         int prefilter, int admission_plain, uint64_t* cms,
-                        uint32_t* tkeys, float* tvals, int threads) {
+                        uint32_t* tkeys, float* tvals, int threads,
+                        int64_t* stats) {
   long long g = fam.g;
   if (g <= 0) return 0;  // all-invalid chunk: CMS and table both no-ops
   long long planes = p + 1;  // + count plane
@@ -166,7 +177,7 @@ long long sketch_family(const FamTable& fam, long long p, long long depth,
   int t = g < 2048 ? 1 : threads;
   long long rc = hs_cms_update(cms, planes, depth, width, fam.keys.data(),
                                g, fam.wk, sums.data(), nullptr,
-                               conservative, t);
+                               conservative, t, stats);
   if (rc != 0) return -1;
   const uint32_t* cand_keys = fam.keys.data();
   const float* cand_sums = sums.data();
@@ -176,7 +187,7 @@ long long sketch_family(const FamTable& fam, long long p, long long depth,
   if (prefilter && g > 2 * cap) {
     std::vector<int32_t> sel(static_cast<size_t>(2 * cap));
     m = hs_hh_prefilter(tkeys, cap, fam.wk, fam.keys.data(), sums.data(),
-                        g, planes, sel.data(), t);
+                        g, planes, sel.data(), t, stats);
     if (m < 0) return -1;
     sel_keys.resize(static_cast<size_t>(m * fam.wk));
     sel_sums.resize(static_cast<size_t>(m * planes));
@@ -196,12 +207,12 @@ long long sketch_family(const FamTable& fam, long long p, long long depth,
   if (!admission_plain) {
     est.resize(static_cast<size_t>(m * planes));
     rc = hs_cms_query(cms, planes, depth, width, cand_keys, m, fam.wk,
-                      est.data(), t);
+                      est.data(), t, stats);
     if (rc != 0) return -1;
     cand_est = est.data();
   }
   rc = hs_topk_merge(tkeys, tvals, cap, fam.wk, planes, cand_keys,
-                     cand_sums, cand_est, nullptr, m);
+                     cand_sums, cand_est, nullptr, m, stats);
   return rc < 0 ? -1 : 0;
 }
 
@@ -214,20 +225,23 @@ extern "C" {
 // ops.hostgroup.group_by_key(exact=True) for integer planes (the
 // flows_5m path). Outputs are caller-allocated at capacity n rows:
 // uniq_out [n, w] uint32, sums_out [n, p] uint64, counts_out [n] int64.
-// Returns the group count; -1 on degenerate shapes / int32 overflow;
+// `stats` (nullable) accumulates the flowtrace phase counters (radix/
+// refine via flow_hash_group, the group fold under fold_ns). Returns
+// the group count; -1 on degenerate shapes / int32 overflow;
 // -2 when two DISTINCT key rows share a 64-bit hash (the caller falls
 // back to the lexicographic regroup, same contract as the numpy path).
 long long ff_group_sum(const uint32_t* lanes, long long n, long long w,
                        const uint64_t* vals, long long p,
                        uint32_t* uniq_out, uint64_t* sums_out,
-                       int64_t* counts_out) {
+                       int64_t* counts_out, int64_t* stats) {
   if (n < 0 || w < 1 || p < 0) return -1;
   if (n == 0) return 0;
   std::vector<int32_t> perm, starts;
   int32_t collided = 0;
-  long long g = group_lanes(lanes, n, w, perm, starts, &collided);
+  long long g = group_lanes(lanes, n, w, perm, starts, &collided, stats);
   if (g < 0) return -1;
   if (collided) return -2;
+  int64_t t_fold = ff_now_ns(stats);
   for (long long gi = 0; gi < g; ++gi) {
     long long lo = starts[static_cast<size_t>(gi)];
     long long hi = gi + 1 < g ? starts[static_cast<size_t>(gi + 1)] : n;
@@ -242,6 +256,9 @@ long long ff_group_sum(const uint32_t* lanes, long long n, long long w,
       for (long long pi = 0; pi < p; ++pi) acc[pi] += src[pi];
     }
     counts_out[gi] = hi - lo;
+  }
+  if (stats != nullptr) {
+    stats[FF_STAT_FOLD_NS] += ff_now_ns(stats) - t_fold;
   }
   return g;
 }
@@ -273,6 +290,10 @@ long long ff_group_sum(const uint32_t* lanes, long long n, long long w,
 //   ddos_keys_out/ddos_sums_out: caller-allocated [n, ddos_sel_w]
 //           uint32 / [n] float32 side-table outputs
 //
+// `stats` (nullable) accumulates the flowtrace phase counters — root
+// grouping under radix/refine, cascade regroups (incl. the ddos side
+// table) under regroup_ns, group-table folds under fold_ns, and the
+// sketch phases inside the hs_* kernels the buffer rides through.
 // Returns the DDoS side-table group count (0 when ddos_parent < 0), or
 // -1 on degenerate shapes / kernel failure.
 long long ff_fused_update(const uint32_t* lanes, long long n, long long w,
@@ -287,7 +308,7 @@ long long ff_fused_update(const uint32_t* lanes, long long n, long long w,
                           long long ddos_parent, const int64_t* ddos_sel,
                           long long ddos_sel_w, long long ddos_plane,
                           uint32_t* ddos_keys_out, float* ddos_sums_out,
-                          int threads) {
+                          int threads, int64_t* stats) {
   if (n < 0 || w < 1 || p < 0 || nf < 1 || parent[0] != -1) return -1;
   if (ddos_parent >= nf ||
       (ddos_parent >= 0 &&
@@ -301,6 +322,7 @@ long long ff_fused_update(const uint32_t* lanes, long long n, long long w,
   for (long long f = 0; f < nf; ++f) {
     long long par = parent[f];
     if (par >= f) return -1;  // parents precede children
+    int64_t t_gather = ff_now_ns(stats);  // cascade regroup starts here
     const uint32_t* src_lanes;
     long long m, wk;
     const float* fsrc = nullptr;
@@ -337,19 +359,33 @@ long long ff_fused_update(const uint32_t* lanes, long long n, long long w,
       fams[static_cast<size_t>(f)].wk = wk;
       continue;
     }
-    long long g = group_lanes(src_lanes, m, wk, perm, starts, &collided);
+    // phase attribution: the root family's grouping is the radix/refine
+    // phases (flow_hash_group self-reports them); a cascade child's
+    // whole pass — lane gather above + grouping + fold — is "regroup"
+    bool is_root = par < 0;
+    long long g = group_lanes(src_lanes, m, wk, perm, starts, &collided,
+                              is_root ? stats : nullptr);
     if (g < 0) return -1;
     // collisions merge hash-identical tuples — the sketch families'
     // documented exact=False trade (ops.hostgroup.group_by_key)
+    int64_t t_fold = ff_now_ns(stats);
     accumulate(src_lanes, m, wk, p, fsrc, ptab, perm, starts, g,
                fams[static_cast<size_t>(f)]);
+    if (stats != nullptr) {
+      if (is_root) {
+        stats[FF_STAT_FOLD_NS] += ff_now_ns(stats) - t_fold;
+      } else {
+        stats[FF_STAT_REGROUP_NS] += ff_now_ns(stats) - t_gather;
+        stats[FF_STAT_GROUPS] += g;
+      }
+    }
     if (do_sketch) {
       long long rc = sketch_family(
           fams[static_cast<size_t>(f)], p, fdepth[f], fwidth[f], fcap[f],
           fconserv[f], fprefilter[f], fplain[f],
           static_cast<uint64_t*>(cms_ptrs[f]),
           static_cast<uint32_t*>(tkey_ptrs[f]),
-          static_cast<float*>(tval_ptrs[f]), threads);
+          static_cast<float*>(tval_ptrs[f]), threads, stats);
       if (rc < 0) return -1;
     }
   }
@@ -362,6 +398,7 @@ long long ff_fused_update(const uint32_t* lanes, long long n, long long w,
     if (ddos_sel[c] < 0 || ddos_sel[c] >= pt.wk) return -1;
   }
   if (pt.g == 0) return 0;
+  int64_t t_ddos = ff_now_ns(stats);
   child_lanes.resize(static_cast<size_t>(pt.g * ddos_sel_w));
   for (long long r = 0; r < pt.g; ++r) {
     for (long long c = 0; c < ddos_sel_w; ++c) {
@@ -370,7 +407,7 @@ long long ff_fused_update(const uint32_t* lanes, long long n, long long w,
     }
   }
   long long g = group_lanes(child_lanes.data(), pt.g, ddos_sel_w, perm,
-                            starts, &collided);
+                            starts, &collided, nullptr);
   if (g < 0) return -1;
   for (long long gi = 0; gi < g; ++gi) {
     long long lo = starts[static_cast<size_t>(gi)];
@@ -387,6 +424,9 @@ long long ff_fused_update(const uint32_t* lanes, long long n, long long w,
           ddos_plane)];
     }
     ddos_sums_out[gi] = static_cast<float>(acc);
+  }
+  if (stats != nullptr) {
+    stats[FF_STAT_REGROUP_NS] += ff_now_ns(stats) - t_ddos;
   }
   return g;
 }

@@ -41,6 +41,8 @@
 #include <thread>
 #include <vector>
 
+#include "ffstat.h"  // flowtrace stats out-struct: slots + ff_now_ns
+
 namespace {
 
 // ---- murmur3_x86_32 over uint32 word lanes (schema/keys.py twin) ----------
@@ -167,9 +169,10 @@ long long hs_cms_update(uint64_t* cms, long long planes, long long depth,
                         long long width, const uint32_t* keys, long long n,
                         long long kw, const float* vals,
                         const uint8_t* valid, int conservative,
-                        int threads) {
+                        int threads, int64_t* stats) {
   if (planes < 1 || depth < 1 || width < 1 || n < 0 || kw < 0) return -1;
   if (n == 0) return 0;
+  int64_t t0 = ff_now_ns(stats);
   std::vector<uint32_t> buckets(static_cast<size_t>(depth * n));
   fill_buckets(keys, n, kw, depth, width, threads, buckets.data());
 
@@ -185,6 +188,7 @@ long long hs_cms_update(uint64_t* cms, long long planes, long long depth,
         row[b[r]] += addend_u64(vals[r * planes + p]);
       }
     });
+    if (stats != nullptr) stats[FF_STAT_CMS_NS] += ff_now_ns(stats) - t0;
     return 0;
   }
 
@@ -218,6 +222,7 @@ long long hs_cms_update(uint64_t* cms, long long planes, long long depth,
       if (t > row[b[r]]) row[b[r]] = t;
     }
   });
+  if (stats != nullptr) stats[FF_STAT_CMS_NS] += ff_now_ns(stats) - t0;
   return 0;
 }
 
@@ -226,9 +231,10 @@ long long hs_cms_update(uint64_t* cms, long long planes, long long depth,
 long long hs_cms_query(const uint64_t* cms, long long planes,
                        long long depth, long long width,
                        const uint32_t* keys, long long n, long long kw,
-                       float* out, int threads) {
+                       float* out, int threads, int64_t* stats) {
   if (planes < 1 || depth < 1 || width < 1 || n < 0 || kw < 0) return -1;
   if (n == 0) return 0;
+  int64_t t0 = ff_now_ns(stats);
   std::vector<uint32_t> buckets(static_cast<size_t>(depth * n));
   fill_buckets(keys, n, kw, depth, width, threads, buckets.data());
   parallel_tasks(n_blocks(n), threads, [&](long long blk) {
@@ -245,6 +251,9 @@ long long hs_cms_query(const uint64_t* cms, long long planes,
       }
     }
   });
+  // query time counts toward the admission/top-K phase: the only
+  // in-pipeline caller is the `est` admission's pre-merge estimate
+  if (stats != nullptr) stats[FF_STAT_TOPK_NS] += ff_now_ns(stats) - t0;
   return 0;
 }
 
@@ -263,9 +272,10 @@ long long hs_cms_query(const uint64_t* cms, long long planes,
 long long hs_hh_prefilter(const uint32_t* table_keys, long long cap,
                           long long kw, const uint32_t* uniq,
                           const float* sums, long long n, long long planes,
-                          int32_t* sel_out, int threads) {
+                          int32_t* sel_out, int threads, int64_t* stats) {
   if (cap < 1 || kw < 1 || planes < 1 || n < 0) return -1;
   if (n == 0) return 0;
+  int64_t t0 = ff_now_ns(stats);
   std::vector<uint32_t> th(static_cast<size_t>(cap));
   for (long long c = 0; c < cap; ++c) {
     th[static_cast<size_t>(c)] = mix_h1(table_keys + c * kw, kw);
@@ -296,6 +306,7 @@ long long hs_hh_prefilter(const uint32_t* table_keys, long long cap,
   };
   std::partial_sort(idx.begin(), idx.begin() + m, idx.end(), cmp);
   std::memcpy(sel_out, idx.data(), static_cast<size_t>(m) * sizeof(int32_t));
+  if (stats != nullptr) stats[FF_STAT_PREFILTER_NS] += ff_now_ns(stats) - t0;
   return m;
 }
 
@@ -319,8 +330,9 @@ long long hs_topk_merge(uint32_t* table_keys, float* table_vals,
                         long long cap, long long kw, long long planes,
                         const uint32_t* cand_keys, const float* cand_sums,
                         const float* cand_est, const uint8_t* cand_valid,
-                        long long n) {
+                        long long n, int64_t* stats) {
   if (cap < 1 || kw < 1 || planes < 1 || n < 0) return -1;
+  int64_t t0 = ff_now_ns(stats);
 
   // Snapshot the table first: the merge rewrites the buffers in place.
   std::vector<uint32_t> old_keys(table_keys,
@@ -418,6 +430,7 @@ long long hs_topk_merge(uint32_t* table_keys, float* table_vals,
     for (long long w = 0; w < kw; ++w) table_keys[c * kw + w] = 0xFFFFFFFFu;
     for (long long p = 0; p < planes; ++p) table_vals[c * planes + p] = 0.0f;
   }
+  if (stats != nullptr) stats[FF_STAT_TOPK_NS] += ff_now_ns(stats) - t0;
   return real;
 }
 

@@ -181,6 +181,30 @@ class TestGrafana:
                        "consumer_lag", "flow_processing_time_us"):
             assert metric in text
 
+    def test_pipeline_dashboard_flowtrace_panels(self):
+        """Round-11 flowtrace panels: the host_fused in-kernel phase
+        breakdown (the attribution fusion erased) and the stage-latency
+        histogram heatmap (aggregable le buckets, not summary
+        quantiles), plus the commit watermark."""
+        with open(os.path.join(DEPLOY, "grafana", "dashboards",
+                               "pipeline.json")) as f:
+            dash = json.load(f)
+        panels = {p["title"]: p for p in dash["panels"]}
+        breakdown = panels[
+            "host_fused phase breakdown (in-kernel, ns/s)"]
+        assert "host_fused_phase_ns_total" in \
+            breakdown["targets"][0]["expr"]
+        assert breakdown["targets"][0]["legendFormat"] == "{{phase}}"
+        heat = panels["Stage latency heatmap (us, cumulative le buckets)"]
+        assert heat["type"] == "heatmap"
+        assert "flow_stage_duration_us_bucket" in \
+            heat["targets"][0]["expr"]
+        assert "by (le)" in heat["targets"][0]["expr"]
+        wm = panels["Sink commit watermark lag (s)"]
+        exprs = " ".join(t["expr"] for t in wm["targets"])
+        assert "flow_commit_watermark_seconds" in exprs
+        assert "flow_sink_commit_latency_seconds_bucket" in exprs
+
     def test_traffic_dashboards_have_four_topn_tables(self):
         # reference viz.json serves four top-N tables: src/dst IPs AND
         # src/dst ports — both dashboard variants must carry all four
@@ -210,7 +234,7 @@ class TestDashboardHonesty:
     missing nf-delay summary)."""
 
     PROM_FUNCS = {"rate", "irate", "sum", "avg", "max", "min", "increase",
-                  "by", "histogram_quantile"}
+                  "by", "histogram_quantile", "time", "le"}
     SQL_KEYWORDS = {"select", "from", "where", "group", "by", "order",
                     "limit", "as", "between", "and", "or", "desc", "asc",
                     "in", "not", "time", "case", "when", "then", "else",
@@ -241,7 +265,14 @@ class TestDashboardHonesty:
 
     @staticmethod
     def exported_metric_names():
-        """Metric names registered by instantiating the REAL services."""
+        """Every series name the REAL services' /metrics would serve:
+        registered family names PLUS the exposition-level series the
+        renderers derive from them (histogram ``_bucket``/``_sum``/
+        ``_count``, summary ``_sum``/``_count``) — so a dashboard expr
+        over ``..._bucket`` is honest exactly when a scrape would
+        resolve it."""
+        import re
+
         from flow_pipeline_tpu.collector import (CollectorConfig,
                                                  CollectorServer)
         from flow_pipeline_tpu.engine.worker import StreamWorker
@@ -254,7 +285,13 @@ class TestDashboardHonesty:
                                               sflow_addr=None), registry=reg)
         StreamWorker(consumer=None, models={})  # registers on the global
         Supervisor(lambda: None)  # worker_restarts_total
-        return set(reg._metrics) | set(REGISTRY._metrics)
+        names = set(reg._metrics) | set(REGISTRY._metrics)
+        for text in (reg.render(), REGISTRY.render()):
+            for line in text.splitlines():
+                m = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)[{ ]", line)
+                if m and not line.startswith("#"):
+                    names.add(m.group(1))
+        return names
 
     def test_prometheus_exprs_use_registered_metrics(self):
         import re

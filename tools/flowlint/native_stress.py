@@ -200,6 +200,21 @@ def _thread_work(native, tid: int, iters: int, batch, data: bytes,
             same = np.zeros((257, 3), np.uint32)
             perm, starts, _ = native.hash_group(same)
             assert len(starts) == 1 and len(perm) == 257
+            # 4b) flowtrace stats out-struct: thread-private buffer, must
+            #     be purely observational (identical outputs) and sane
+            #     (counts match, ns slots non-negative, accumulation +=)
+            stats = native.new_stats()
+            p0, s0, c0 = native.hash_group(lanes)
+            p1, s1, c1 = native.hash_group(lanes, stats=stats)
+            assert np.array_equal(p0, p1) and np.array_equal(s0, s1) \
+                and c0 == c1, "stats arg changed hash_group output"
+            assert stats[native.FF_STAT_ROWS] == len(lanes)
+            assert stats[native.FF_STAT_GROUPS] == len(s1)
+            assert (stats >= 0).all(), "negative stats slot"
+            before = stats.copy()
+            native.hash_group(lanes, stats=stats)
+            assert stats[native.FF_STAT_ROWS] == 2 * len(lanes)
+            assert (stats >= before).all(), "stats not accumulated"
             # 5) encode round-trip of a slice (exercises put_varint paths)
             sl = batch.slice(0, 1 + (it % 61))
             enc = native.encode_stream(sl)
@@ -236,12 +251,13 @@ def _sketch_work(native, rng, it: int) -> None:
     m = keys.shape[0]
     vals = rng.integers(0, 1500, size=(m, planes)).astype(np.float32)
     valid = rng.random(m) > 0.2
+    stats = native.new_stats()  # thread-private; rides every hs_* call
     for conservative in (False, True):
         sketches = []
         for threads in (1, 2, 8):
             cms = np.zeros((planes, depth, width), np.uint64)
             native.hs_cms_update(cms, keys, vals, valid, conservative,
-                                 threads)
+                                 threads, stats=stats)
             sketches.append(cms)
         assert all(np.array_equal(s, sketches[0]) for s in sketches[1:]), \
             f"thread-count nondeterminism (conservative={conservative})"
@@ -252,9 +268,13 @@ def _sketch_work(native, rng, it: int) -> None:
             got = sketches[0].sum(axis=2)
             assert np.array_equal(got, np.broadcast_to(
                 want[:, None], (planes, depth))), "linear mass mismatch"
-        est = [native.hs_cms_query(sketches[0], keys, threads=t)
+        est = [native.hs_cms_query(sketches[0], keys, threads=t,
+                                   stats=stats)
                for t in (1, 8)]
         assert np.array_equal(est[0], est[1]), "query nondeterminism"
+    if m:
+        assert stats[native.FF_STAT_SLOTS["cms"]] > 0
+        assert (stats >= 0).all(), "negative hs stats slot"
     # zero-width sketch must be REJECTED, never written
     try:
         native.hs_cms_update(np.zeros((1, 1, 0), np.uint64),
@@ -269,9 +289,11 @@ def _sketch_work(native, rng, it: int) -> None:
     table_keys = np.full((cap, kw), 0xFFFFFFFF, np.uint32)
     table_vals = np.zeros((cap, planes), np.float32)
     if m:
-        sel1 = native.hs_hh_prefilter(table_keys, keys, vals, threads=1)
+        sel1 = native.hs_hh_prefilter(table_keys, keys, vals, threads=1,
+                                      stats=stats)
         sel8 = native.hs_hh_prefilter(table_keys, keys, vals, threads=8)
         assert np.array_equal(sel1, sel8), "prefilter nondeterminism"
+        assert stats[native.FF_STAT_SLOTS["prefilter"]] > 0
         assert len(sel1) == min(m, 2 * cap)
         assert len(np.unique(sel1)) == len(sel1)
         assert sel1.min() >= 0 and sel1.max() < m
@@ -279,7 +301,7 @@ def _sketch_work(native, rng, it: int) -> None:
     # no duplicate real keys, sentinel padding after `real` rows
     for _ in range(3):
         real = native.hs_topk_merge(table_keys, table_vals, keys, vals,
-                                    vals, valid)
+                                    vals, valid, stats=stats)
         assert 0 <= real <= cap
         assert (table_vals[:max(real - 1, 0), 0]
                 >= table_vals[1:real, 0]).all(), "table not ranked"
@@ -354,8 +376,25 @@ def _fused_work(native, rng, it: int) -> None:
                 got, np.broadcast_to(want[:, None], (p, 2))), \
                 "fused linear mass mismatch"
             assert s1[0].cms[p].sum() == np.uint64(n) * np.uint64(2)
-        # ff_group_sum on the same lanes: exact groupby invariants
+        # stats-instrumented run must be byte-identical to the plain
+        # one (the out-struct is observational, never behavioral)
+        stats = native.new_stats()
+        states_s = _fresh_states(np, 2, cap, (3, 1), p + 1)
+        ddos_s = native.fused_update(lanes, vals, plan, states_s,
+                                     do_sketch=True, threads=1,
+                                     stats=stats)
+        for a, b in zip(s1, states_s):
+            assert np.array_equal(a.cms, b.cms), "stats arg changed state"
+            assert np.array_equal(a.table_keys, b.table_keys)
+            assert np.array_equal(a.table_vals, b.table_vals)
+        assert np.array_equal(d1[0], ddos_s[0])
+        assert stats[native.FF_STAT_ROWS] == n
+        assert (stats >= 0).all(), "negative fused stats slot"
+        # ff_group_sum on the same lanes: exact groupby invariants,
+        # with the stats buffer riding along
         gs = native.group_sum(lanes, vals.astype(np.uint64))
+        gs_s = native.group_sum(lanes, vals.astype(np.uint64),
+                                stats=stats)
         if gs is not None:
             uniq, sums, counts = gs
             assert counts.sum() == n
@@ -363,6 +402,8 @@ def _fused_work(native, rng, it: int) -> None:
                 vals.astype(np.uint64).sum(axis=0).tolist()
             if len(uniq):
                 assert len(np.unique(uniq, axis=0)) == len(uniq)
+            for a, b in zip(gs, gs_s):
+                assert np.array_equal(a, b), "stats arg changed group_sum"
     # malformed plans must be rejected before any write
     bad_root = native.FusedPlan(
         parent=np.asarray([0, 0], np.int64), sel=plan.sel,
