@@ -4,7 +4,7 @@
 # additionally builds the native host-path library and runs the suite.
 
 .PHONY: all native test bench proto clean services-test lint native-san \
-	hostsketch-parity fused-parity fused-parity-traced
+	hostsketch-parity fused-parity fused-parity-traced mesh-parity
 
 all: native
 
@@ -51,6 +51,15 @@ fused-parity:
 	$(MAKE) -C native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fusedplane.py -v
 
+# Oracle-exactness of the flowmesh (mesh/): N in {1,2,4} in-process
+# meshes vs a single-worker oracle over the identical key-hash-sharded
+# bus — merged flows_5m bit-exact to the numpy oracle, merged top-K
+# bit-exact to the single worker — plus the kill-one-worker churn leg
+# (live rebalance: no window lost or double-counted) and the merge-codec
+# round-trip suite (docs/ARCHITECTURE.md "flowmesh" states the contract).
+mesh-parity:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_mesh.py -v
+
 # The same parity suite with the flowtrace recorder at full retention
 # (-obs.trace=always via the env fallback): span recording and the
 # kernels' stats out-structs must be purely observational — bit-exact
@@ -73,7 +82,21 @@ services-test:
 	FLOWTPU_POSTGRES="host=localhost user=flows password=flows dbname=flows" \
 	FLOWTPU_CLICKHOUSE=http://localhost:8123 \
 	python -m pytest tests/test_service_integration.py -v; rc=$$?; \
-	$(SERVICES_COMPOSE) down -v; exit $$rc
+	$(SERVICES_COMPOSE) down -v; \
+	if [ $$rc -eq 0 ]; then $(MAKE) mesh-services-test; rc=$$?; fi; \
+	exit $$rc
+
+# Composed flowmesh proof (deploy/compose/mesh.yml): coordinator + 4
+# workers + sharded generator over an 8-partition Kafka topic; the smoke
+# driver polls the coordinator until all 4 members serve, a window has
+# merged network-wide, and the mesh-aware /topk answers.
+MESH_COMPOSE = docker compose -f deploy/compose/mesh.yml
+mesh-services-test:
+	$(MESH_COMPOSE) up -d --build --wait kafka
+	$(MESH_COMPOSE) up -d coordinator worker-0 worker-1 worker-2 \
+		worker-3 mocker
+	python deploy/compose/mesh_smoke.py; rc=$$?; \
+	$(MESH_COMPOSE) down -v; exit $$rc
 
 # Regenerate canonical protobuf bindings (optional; the framework ships its
 # own dependency-free codec — this is for interop consumers who want _pb2).

@@ -26,6 +26,7 @@ Modes (default ``hh`` is what the driver records):
     python bench.py flowtrace    # -obs.trace=off|ring overhead A/B +
                                  # host_fused in-kernel phase breakdown
     python bench.py sharded [n]  # n-device mesh rate + merge cost
+    python bench.py mesh         # flowmesh 1/2/4-worker scaling curve
     python bench.py sweep        # batch x width x impl tuning sweep
     python bench.py trace [dir]  # jax.profiler device trace of the step
 """
@@ -760,6 +761,79 @@ def bench_e2e() -> None:
     }))
 
 
+MESH_FLOWS = 60_000
+MESH_PARTITIONS = 8
+MESH_WORKERS = (1, 2, 4)
+
+
+def bench_mesh() -> None:
+    """flowmesh partition-count scaling curve: the SAME key-hash-sharded
+    stream through an in-process mesh of 1, 2 and 4 workers (ROADMAP
+    item 3's acceptance artifact). Same-box, same-stream legs: the
+    speedup column is the honest statistic; absolute flows/s swings with
+    the box (see BASELINE host_note history). On boxes with fewer cores
+    than workers the curve flattens — the artifact records nproc so a
+    flat curve on a 2-core box reads as the box, not the mesh."""
+    global _NATIVE
+    _NATIVE = _ensure_native()
+    from flow_pipeline_tpu.cli import (_build_models, _common_flags,
+                                       _gen_flags, _make_generator,
+                                       _processor_flags)
+    from flow_pipeline_tpu.engine import WorkerConfig
+    from flow_pipeline_tpu.mesh import InProcessMesh, produce_sharded
+    from flow_pipeline_tpu.transport import InProcessBus
+    from flow_pipeline_tpu.utils.flags import FlagSet
+
+    fs = _processor_flags(_gen_flags(_common_flags(FlagSet("bench"))))
+    vals = fs.parse(["-produce.profile", "zipf"])
+
+    def make_bus():
+        bus = InProcessBus()
+        bus.create_topic("flows", MESH_PARTITIONS)
+        gen = _make_generator(vals)
+        done = 0
+        while done < MESH_FLOWS:
+            n = min(16384, MESH_FLOWS - done)
+            done += produce_sharded(bus, "flows", gen.batch(n),
+                                    MESH_PARTITIONS)
+        return bus
+
+    def leg(n_workers):
+        def step():
+            bus = make_bus()  # untimed: production is upstream
+            mesh = InProcessMesh(
+                bus, "flows", n_workers,
+                model_factory=lambda: _build_models(vals),
+                config=WorkerConfig(poll_max=vals["processor.batch"],
+                                    snapshot_every=0,
+                                    ingest_native_group=True),
+                sinks=[])
+            elapsed = mesh.run()
+            return MESH_FLOWS, elapsed
+
+        return _timed_samples(step, samples=3)
+
+    legs = {}
+    for n in MESH_WORKERS:
+        legs[n] = leg(n)
+    base = legs[MESH_WORKERS[0]]["value"] or 1.0
+    print(json.dumps({
+        "metric": "mesh partition-count scaling "
+                  "(key-hash sharded, window-close merge)",
+        "unit": "flows/sec",
+        "partitions": MESH_PARTITIONS,
+        "flows_per_leg": MESH_FLOWS,
+        "legs": [{
+            "workers": n,
+            **legs[n],
+            "speedup_vs_1": round(legs[n]["value"] / base, 3),
+        } for n in MESH_WORKERS],
+        "value": legs[max(MESH_WORKERS)]["value"],
+        "native_decode": _NATIVE,
+        "platform": _PLATFORM,
+    }))
+
+
 def bench_sweep() -> None:
     """Tuning sweep for the flagship step: batch size x CMS width x impl
     x table prefilter x admission rule. One JSON line per point plus a
@@ -1058,6 +1132,8 @@ if __name__ == "__main__":
         bench_flowtrace()
     elif mode == "sharded":
         bench_sharded(int(sys.argv[2]) if len(sys.argv) > 2 else 8)
+    elif mode == "mesh":
+        bench_mesh()
     elif mode == "sweep":
         bench_sweep()
     elif mode == "trace":

@@ -1,0 +1,71 @@
+"""Smoke assertion for the composed flowmesh topology (mesh.yml).
+
+Polls the coordinator until 4 members are live and at least one window
+has merged network-wide, then exercises the mesh-aware /topk. Exits 0
+on success, 1 on timeout — `make mesh-services-test` gates on it.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.request
+
+BASE = "http://localhost:8090"
+QUERY = "http://localhost:8082"
+METRICS = "http://localhost:8081/metrics"
+TIMEOUT_S = 300
+
+
+def get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def merged_windows() -> float:
+    """Sum of mesh_windows_merged_total across models — the proof the
+    window-close MERGE path ran, not merely that members consumed
+    (mesh.yml's mocker models time at -produce.rate 2000000 so a
+    5-minute window closes within the smoke budget)."""
+    total = 0.0
+    with urllib.request.urlopen(METRICS, timeout=10) as resp:
+        for line in resp.read().decode().splitlines():
+            if line.startswith("mesh_windows_merged_total"):
+                total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def main() -> int:
+    deadline = time.time() + TIMEOUT_S
+    seen_members = 0
+    while time.time() < deadline:
+        try:
+            state = get(BASE + "/state")
+            merged = merged_windows()
+        except OSError:
+            time.sleep(5)
+            continue
+        live = [m for m, v in state["members"].items() if v["alive"]]
+        seen_members = max(seen_members, len(live))
+        owned = sorted(p for v in state["members"].values()
+                       for p in v["owned"])
+        print(f"mesh state: epoch={state['epoch']} live={len(live)} "
+              f"owned={len(owned)}/{state['partitions']} "
+              f"frontier={sum(state['covered'])} merged={merged}",
+              flush=True)
+        if len(live) >= 4 and len(owned) == state["partitions"] \
+                and merged > 0:
+            topk = get(QUERY + "/topk?model=top_talkers&k=5")
+            print("mesh /topk rows:", len(topk["rows"]), flush=True)
+            if topk["rows"]:
+                print("MESH SMOKE OK", flush=True)
+                return 0
+        time.sleep(5)
+    print(f"MESH SMOKE TIMEOUT (best: {seen_members} live members)",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,10 @@ def _gen_flags(fs: FlagSet) -> FlagSet:
     fs.string("produce.profile", "mocker", "mocker | zipf")
     fs.integer("zipf.keys", 10_000, "Distinct keys in zipf mode")
     fs.number("zipf.alpha", 1.2, "Zipf exponent")
+    fs.boolean("produce.shard", False,
+               "Partition produced flows by 5-tuple KEY HASH over "
+               "-bus.partitions partitions (the flowmesh shard "
+               "contract) instead of round-robin")
     return fs
 
 
@@ -69,6 +73,8 @@ def mocker_main(argv=None) -> int:
     fs.string("out", "", "Write length-prefixed frames to this file instead "
                          "of Kafka")
     fs.integer("produce.batch", 4096, "Frames per write")
+    fs.integer("bus.partitions", 2, "Topic partition count (the "
+                                    "-produce.shard key-hash modulus)")
     vals = fs.parse(argv if argv is not None else sys.argv[2:])
     set_level(vals["loglevel"])
     gen = _make_generator(vals)
@@ -98,8 +104,18 @@ def mocker_main(argv=None) -> int:
     sent = 0
     while total == 0 or sent < total:
         n = min(4096, total - sent) if total else 4096
-        for m in gen.batch(n).to_messages():
-            producer.send(m)
+        batch = gen.batch(n)
+        if vals["produce.shard"]:
+            # flowmesh shard contract: every row of a flow key lands on
+            # the same partition (mesh/runtime.py shard_ids)
+            from .mesh import shard_ids
+
+            pids = shard_ids(batch, vals["bus.partitions"])
+            for i, m in enumerate(batch.to_messages()):
+                producer.send(m, partition=int(pids[i]))
+        else:
+            for m in batch.to_messages():
+                producer.send(m)
         sent += n
     producer.flush()
     log.info("produced %d flows to %s", sent, vals["kafka.topic"])
@@ -252,6 +268,27 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
               "| always (retain every span; CI/diagnostics only) | off")
     fs.string("sink", "stdout", "stdout | sqlite:PATH | postgres:DSN | "
                                 "clickhouse:URL (comma separated)")
+    # flowmesh (mesh/): N-worker sharded sketch mesh with window-close
+    # merge and live rebalance — see docs/ARCHITECTURE.md "flowmesh"
+    fs.integer("mesh.workers", 0, "Run an in-process flowmesh of this "
+                                  "many workers (pipeline command; "
+                                  "0 disables)")
+    fs.string("mesh.role", "", "flowmesh role: coordinator | member "
+                               "(processor command; empty = standalone)")
+    fs.string("mesh.coordinator", "", "flowmesh coordinator base URL "
+                                      "(member role), e.g. "
+                                      "http://coordinator:8090")
+    fs.string("mesh.id", "", "flowmesh member id (default host-pid)")
+    fs.string("mesh.listen", "", "flowmesh listen host:port — the "
+                                 "coordinator's protocol/query HTTP "
+                                 "(default :8090), or the member's "
+                                 "state endpoint for /topk fan-out "
+                                 "(empty disables)")
+    fs.number("mesh.heartbeat", 5.0, "flowmesh heartbeat timeout "
+                                     "seconds before a member is fenced")
+    fs.integer("bus.partitions", 2, "Bus partitions (reference default "
+                                    "2; the mesh coordinator's "
+                                    "partition-count contract)")
     fs.string("in", "", "Read frames from file instead of Kafka")
     fs.string("listen.feed", "", "gRPC feed address (host:port) — accept "
                                  "batches from colocated producers instead "
@@ -361,6 +398,126 @@ def _load_frames_bus(path: str, topic: str, partitions: int = 2):
     return bus
 
 
+def _worker_config(vals) -> "WorkerConfig":
+    from .engine import WorkerConfig
+
+    return WorkerConfig(
+        poll_max=vals["processor.batch"],
+        snapshot_every=vals["flush.count"],
+        checkpoint_path=vals["checkpoint.path"] or None,
+        archive_raw=vals["archive.raw"],
+        prefetch=vals["feed.prefetch"],
+        fused=vals["processor.fused"],
+        host_assist=vals["processor.hostassist"],
+        sketch_backend=vals["sketch.backend"],
+        ingest_mode=vals["ingest.mode"],
+        ingest_shards=vals["ingest.shards"],
+        ingest_depth=vals["ingest.depth"],
+        ingest_flush_queue=vals["ingest.flush_queue"],
+        ingest_native_group=vals["ingest.native_group"],
+        ingest_fused=vals["ingest.fused"],
+    )
+
+
+def _mesh_coordinator_main(vals) -> int:
+    """flowmesh coordinator service: membership + merge barrier + the
+    mesh-aware query surface. Consumes nothing itself."""
+    from .engine.query_api import QueryServer
+    from .mesh import MeshCoordinator, MeshCoordinatorServer, \
+        spec_from_models
+
+    specs = spec_from_models(_build_models(vals))
+    coord = MeshCoordinator(specs, vals["bus.partitions"],
+                            sinks=_make_sinks(vals["sink"]),
+                            heartbeat_timeout=vals["mesh.heartbeat"])
+    host, port = _host_port(vals["mesh.listen"] or ":8090", 8090,
+                            default_host="0.0.0.0")
+    server = MeshCoordinatorServer(coord, port, host).start()
+    metrics = _start_metrics(vals["metrics.addr"], 8081)
+    query = None
+    if vals["query.addr"]:
+        qhost, qport = _host_port(vals["query.addr"], 8082)
+        query = QueryServer(None, qport, qhost, mesh=coord).start()
+    log.info("mesh coordinator: %d partitions, models=%s",
+             vals["bus.partitions"], [s.name for s in specs])
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if query:
+            query.stop()
+        server.stop()
+        if metrics:
+            metrics.stop()
+    return 0
+
+
+def _mesh_member_main(vals) -> int:
+    """flowmesh member service: a coordinator-driven StreamWorker over
+    explicitly assigned Kafka partitions."""
+    import os
+    import socket
+
+    from .mesh import MeshMember, MemberStateServer, RemoteCoordinator
+    from .transport import kafka as tkafka
+
+    if not vals["mesh.coordinator"]:
+        log.error("mesh.role=member needs -mesh.coordinator URL")
+        return 2
+    if not tkafka.available():
+        log.error("mesh member mode needs a Kafka client (the mesh "
+                  "shards a real partitioned topic); use `pipeline "
+                  "-mesh.workers N` for the in-process mesh")
+        return 2
+    member_id = vals["mesh.id"] or f"{socket.gethostname()}-{os.getpid()}"
+
+    def consumer_factory(partitions):
+        return tkafka.KafkaConsumerAdapter(
+            vals["kafka.brokers"], vals["kafka.topic"],
+            group=f"mesh-{member_id}", fixedlen=vals["proto.fixedlen"],
+            partitions=list(partitions))
+
+    state_url = None
+    shost = sport = None
+    if vals["mesh.listen"]:
+        # the state endpoint port must be known before join() advertises
+        # it; an explicit port keeps the advertised URL stable
+        shost, sport = _host_port(vals["mesh.listen"], 8091,
+                                  default_host="0.0.0.0")
+        state_url = f"http://{socket.gethostname()}:{sport}/meshstate"
+    coord = RemoteCoordinator(vals["mesh.coordinator"],
+                              state_url=state_url)
+    member = MeshMember(
+        member_id, coord, consumer_factory,
+        model_factory=lambda: _build_models(vals),
+        config=_worker_config(vals),
+        sinks=_make_sinks(vals["sink"]),
+        # progress carries every 64 batches: bounds a successor's replay
+        # (and the promotable carry) mid-window — windows are minutes of
+        # stream, a rebalance should not replay minutes of flows
+        submit_every=64, sync_interval=1.0)
+    state = None
+    if sport is not None:
+        state = MemberStateServer(member, sport, shost).start()
+    metrics = _start_metrics(vals["metrics.addr"], 8081)
+    log.info("mesh member %s -> %s", member_id, vals["mesh.coordinator"])
+    try:
+        while True:
+            if not member.step():
+                time.sleep(0.05)
+    except KeyboardInterrupt:
+        log.info("interrupt: final submit + leave")
+        member.finalize()
+    finally:
+        if state is not None:
+            state.stop()
+        if metrics:
+            metrics.stop()
+    return 0
+
+
 def processor_main(argv=None) -> int:
     fs = _processor_flags(_common_flags(FlagSet("processor")))
     vals = fs.parse(argv if argv is not None else sys.argv[2:])
@@ -369,6 +526,14 @@ def processor_main(argv=None) -> int:
 
     TRACER.configure(vals["obs.trace"])
     _apply_backend(vals["processor.backend"])
+    if vals["mesh.role"]:
+        if vals["mesh.role"] == "coordinator":
+            return _mesh_coordinator_main(vals)
+        if vals["mesh.role"] == "member":
+            return _mesh_member_main(vals)
+        raise ValueError(
+            f"mesh.role must be coordinator|member, got "
+            f"{vals['mesh.role']!r}")
     from .engine import StreamWorker, WorkerConfig
     from .transport import Consumer
 
@@ -406,22 +571,7 @@ def processor_main(argv=None) -> int:
             consumer,
             _build_models(vals),
             _make_sinks(vals["sink"]),
-            WorkerConfig(
-                poll_max=vals["processor.batch"],
-                snapshot_every=vals["flush.count"],
-                checkpoint_path=vals["checkpoint.path"] or None,
-                archive_raw=vals["archive.raw"],
-                prefetch=vals["feed.prefetch"],
-                fused=vals["processor.fused"],
-                host_assist=vals["processor.hostassist"],
-                sketch_backend=vals["sketch.backend"],
-                ingest_mode=vals["ingest.mode"],
-                ingest_shards=vals["ingest.shards"],
-                ingest_depth=vals["ingest.depth"],
-                ingest_flush_queue=vals["ingest.flush_queue"],
-                ingest_native_group=vals["ingest.native_group"],
-                ingest_fused=vals["ingest.fused"],
-            ),
+            _worker_config(vals),
         )
         if vals["query.addr"]:
             from .engine.query_api import QueryServer
@@ -536,16 +686,67 @@ def _raw_rows(batch) -> list[dict]:
     ]
 
 
+def _pipeline_mesh(vals) -> int:
+    """In-process flowmesh run (`pipeline -mesh.workers N`): key-hash
+    sharded produce -> N coordinator-driven workers -> network-wide
+    window merge at close."""
+    from .engine.query_api import QueryServer
+    from .mesh import InProcessMesh, produce_sharded
+    from .transport import InProcessBus
+
+    if vals.get("processor.mesh"):
+        raise ValueError(
+            "-mesh.workers is the horizontal (multi-worker) scale-out; "
+            "combining it with -processor.mesh device sharding inside "
+            "each member is not supported yet")
+    n_workers = vals["mesh.workers"]
+    partitions = max(vals["bus.partitions"], n_workers)
+    bus = InProcessBus()
+    bus.create_topic(vals["kafka.topic"], partitions)
+    gen = _make_generator(vals)
+    t0 = time.perf_counter()
+    produced = 0
+    while produced < vals["produce.count"]:
+        n = min(8192, vals["produce.count"] - produced)
+        produced += produce_sharded(bus, vals["kafka.topic"],
+                                    gen.batch(n), partitions)
+    log.info("produced %d flows (key-hash sharded over %d partitions) "
+             "in %.2fs", produced, partitions, time.perf_counter() - t0)
+    sinks = _make_sinks(vals["sink"])
+    server = _start_metrics(vals["metrics.addr"], 8081)
+    mesh = InProcessMesh(
+        bus, vals["kafka.topic"], n_workers,
+        model_factory=lambda: _build_models(vals),
+        config=_worker_config(vals), sinks=sinks, member_sinks=sinks,
+        heartbeat_timeout=vals["mesh.heartbeat"])
+    query = None
+    if vals["query.addr"]:
+        qhost, qport = _host_port(vals["query.addr"], 8082)
+        query = QueryServer(None, qport, qhost,
+                            mesh=mesh.coordinator).start()
+    elapsed = mesh.run()
+    merged = sum(len(v) for v in mesh.coordinator.merged.values())
+    log.info("mesh aggregated %d flows with %d workers in %.2fs "
+             "(%.0f flows/sec, %d merged windows)", produced, n_workers,
+             elapsed, produced / max(elapsed, 1e-9), merged)
+    if query:
+        query.stop()
+    if server:
+        server.stop()
+    return 0
+
+
 def pipeline_main(argv=None) -> int:
     """In-process end-to-end demo (the compose *-mock topology equivalent)."""
     fs = _processor_flags(_gen_flags(_common_flags(FlagSet("pipeline"))))
-    fs.integer("bus.partitions", 2, "Bus partitions (reference default 2)")
     vals = fs.parse(argv if argv is not None else sys.argv[2:])
     set_level(vals["loglevel"])
     from .obs.trace import TRACER
 
     TRACER.configure(vals["obs.trace"])
     _apply_backend(vals["processor.backend"])
+    if vals["mesh.workers"]:
+        return _pipeline_mesh(vals)
     from .engine import StreamWorker, WorkerConfig
     from .schema import wire
     from .transport import Consumer, InProcessBus
@@ -567,18 +768,7 @@ def pipeline_main(argv=None) -> int:
         consumer,
         _build_models(vals),
         _make_sinks(vals["sink"]),
-        WorkerConfig(poll_max=vals["processor.batch"],
-                     snapshot_every=vals["flush.count"],
-                     checkpoint_path=vals["checkpoint.path"] or None,
-                     archive_raw=vals["archive.raw"],
-                     prefetch=vals["feed.prefetch"],
-                     sketch_backend=vals["sketch.backend"],
-                     ingest_mode=vals["ingest.mode"],
-                     ingest_shards=vals["ingest.shards"],
-                     ingest_depth=vals["ingest.depth"],
-                     ingest_flush_queue=vals["ingest.flush_queue"],
-                     ingest_native_group=vals["ingest.native_group"],
-                     ingest_fused=vals["ingest.fused"]),
+        _worker_config(vals),
     )
     query = None
     if vals["query.addr"]:

@@ -32,10 +32,19 @@ log = get_logger("query")
 
 
 class QueryServer:
-    """HTTP query endpoint over a StreamWorker's models."""
+    """HTTP query endpoint over a StreamWorker's models.
 
-    def __init__(self, worker, port: int = 8082, host: str = "127.0.0.1"):
+    ``mesh`` (a mesh.MeshCoordinator) makes /topk mesh-aware: instead of
+    reading one worker's sketch, the coordinator fans the query to every
+    live member's state provider and answers from the network-wide
+    MERGED open-window view — the same monoid fold the window-close
+    merge runs, so the answer equals a single worker seeing the whole
+    stream (tests/test_mesh.py pins the equality)."""
+
+    def __init__(self, worker, port: int = 8082, host: str = "127.0.0.1",
+                 mesh=None):
         self.worker = worker
+        self.mesh = mesh
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -52,8 +61,18 @@ class QueryServer:
                     if handler is None:
                         self._reply(404, {"error": f"unknown path {url.path}"})
                         return
-                    with outer.worker.lock:  # consistent view vs the loop
+                    if outer.mesh is not None and url.path in (
+                            "/topk", "/healthz"):
+                        # mesh fan-out acquires MEMBER locks; it must
+                        # not run under a co-resident worker's lock
                         result = handler(q)
+                    elif outer.worker is None:
+                        self._reply(400, {"error":
+                                          "no worker behind this path"})
+                        return
+                    else:
+                        with outer.worker.lock:  # consistent view
+                            result = handler(q)
                     self._reply(200, result)
                 except (KeyError, ValueError) as e:
                     self._reply(400, {"error": str(e)})
@@ -79,6 +98,11 @@ class QueryServer:
     # ---- endpoints --------------------------------------------------------
 
     def _healthz(self, q) -> dict:
+        if self.worker is None:
+            st = self.mesh.status()
+            return {"ok": True, "mesh_epoch": st["epoch"],
+                    "mesh_members": len(st["members"]),
+                    "models": [s.name for s in self.mesh.specs]}
         return {
             "ok": True,
             "flows_seen": self.worker.flows_seen,
@@ -99,6 +123,11 @@ class QueryServer:
         raise KeyError(f"no model of kind {want_type.__name__} configured")
 
     def _topk(self, q) -> dict:
+        if self.mesh is not None:
+            # the coordinator merges every live member's open-window
+            # state (mesh.MeshCoordinator.query_topk) — O(K) per member
+            return self.mesh.query_topk(
+                q.get("model"), int(q["k"]) if "k" in q else None)
         name, model = self._model(q, WindowedHeavyHitter)
         if not isinstance(model, WindowedHeavyHitter):
             raise ValueError(f"model {name!r} has no top-K surface")

@@ -52,6 +52,13 @@ class WindowedHeavyHitter:
         self.k = k
         self.model = model_cls(config, **model_kw)
         self.current_slot: int | None = None
+        # flowmesh capture seam (mesh/member.py): when set, a window
+        # close hands (slot, backing model) to the hook INSTEAD of
+        # extracting rows locally — per-shard state is merged
+        # network-wide at the coordinator and extracted ONCE from the
+        # merged sketch. None (the default) keeps the single-worker
+        # behavior byte-identical.
+        self.capture = None
         # Ingest-runtime knob (engine.worker sets it in pipelined mode):
         # close windows as LazyWindowTop handles so extraction runs on
         # the background flusher instead of the update path. Only honored
@@ -92,6 +99,12 @@ class WindowedHeavyHitter:
             self.model.update(part)
 
     def _close(self) -> None:
+        if self.capture is not None:
+            # mesh member: ship the window's raw sketch state; no local
+            # row extraction (the coordinator extracts from the merge)
+            self.capture(self.current_slot, self.model)
+            self.model.reset()
+            return
         if self.lazy_extract and hasattr(self.model, "top_lazy"):
             self._pending.append(LazyWindowTop(
                 self.model.top_lazy(self.k), self.current_slot))

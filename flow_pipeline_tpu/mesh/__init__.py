@@ -1,0 +1,24 @@
+"""flowmesh: N-worker sharded sketch mesh with window-close merge and
+live rebalance (ROADMAP item 3).
+
+Flows shard by key-hash across bus partitions to N independent
+StreamWorker members; per-window sketch/wagg/top-K state merges
+network-wide at window close through the coordinator's monoid folds —
+`parallel/sharded.py`'s on-device collective merges lifted to a
+serialized exchange — and membership churn rebalances partitions with
+epoch fencing so no window is lost or double-counted
+(docs/ARCHITECTURE.md "flowmesh" states the contract).
+"""
+
+from .coordinator import MeshCoordinator, ModelSpec, spec_from_models
+from .member import MeshMember
+from .runtime import (InProcessMesh, SHARD_KEY_COLS, produce_sharded,
+                      shard_ids)
+from .server import (MemberStateServer, MeshCoordinatorServer,
+                     RemoteCoordinator)
+
+__all__ = [
+    "MeshCoordinator", "MeshMember", "ModelSpec", "spec_from_models",
+    "InProcessMesh", "SHARD_KEY_COLS", "produce_sharded", "shard_ids",
+    "MeshCoordinatorServer", "RemoteCoordinator", "MemberStateServer",
+]

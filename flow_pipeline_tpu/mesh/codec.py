@@ -1,0 +1,115 @@
+"""flowmesh merge codec: serialized per-window sketch/aggregate state.
+
+Contributions cross the mesh (member -> coordinator) as one framed byte
+envelope: a JSON structure tree plus an in-memory ``.npz`` archive of
+every array leaf — the same no-pickle split engine.checkpoint uses for
+durable snapshots, so a payload is safe to accept from another trust
+domain and survives encode -> decode BIT-exactly on the uint64
+envelope (dtype + shape + every word preserved; tests/test_mesh.py
+round-trips u64 extremes and hostsketch engine state).
+
+The canonical heavy-hitter payload keeps the CMS in **uint64** (the
+exact merge monoid — element sums cannot lose counts the way float
+addition can), converting device f32 sketches through hostsketch's
+proven clamp conversions. Table keys stay uint32, table values float32
+(the device accumulation dtype — merging sums them per key, which for
+key-hash-sharded streams is a disjoint union and therefore exact).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+
+import numpy as np
+
+from ..engine.checkpoint import _decode, _encode
+from ..hostsketch.state import HostHHState, _cms_to_u64
+
+MAGIC = b"FMSH1\n"
+
+
+def encode(obj) -> bytes:
+    """Nested dicts/lists/tuples/scalars/arrays -> framed bytes."""
+    arrays: dict[str, np.ndarray] = {}
+    meta = json.dumps(_encode(obj, arrays, "r")).encode()
+    buf = io.BytesIO()
+    # savez (uncompressed): payloads are hot-path window state, and the
+    # arrays (CMS planes) are incompressible counter noise anyway
+    np.savez(buf, **arrays)
+    return MAGIC + len(meta).to_bytes(8, "little") + meta + buf.getvalue()
+
+
+def decode(data: bytes):
+    """Framed bytes -> the original structure with numpy array leaves."""
+    if not data.startswith(MAGIC):
+        raise ValueError("not a flowmesh payload (bad magic)")
+    off = len(MAGIC)
+    meta_len = int.from_bytes(data[off:off + 8], "little")
+    off += 8
+    meta = json.loads(data[off:off + meta_len].decode())
+    blob = data[off + meta_len:]
+    arrays = np.load(io.BytesIO(blob)) if blob else {}
+    return _decode(meta, arrays)
+
+
+# ---- model-state capture --------------------------------------------------
+#
+# One payload shape per model kind, all plain numpy (no jax arrays cross
+# the mesh). ``kind`` tags dispatch the coordinator-side merge.
+
+
+def hh_payload(state) -> dict:
+    """Device/host HHState (or checkpoint field-dict) -> canonical
+    uint64-CMS payload. Accepts jax or numpy leaves; always copies."""
+    if isinstance(state, HostHHState):
+        # hostsketch engine state via its export seam: already uint64
+        return {"kind": "hh", "cms": state.cms.copy(),
+                "table_keys": state.table_keys.copy(),
+                "table_vals": state.table_vals.copy()}
+    if isinstance(state, dict):
+        cms, tk, tv = state["cms"], state["table_keys"], state["table_vals"]
+    else:
+        cms, tk, tv = state.cms, state.table_keys, state.table_vals
+    return {
+        "kind": "hh",
+        "cms": _cms_to_u64(cms),
+        "table_keys": np.ascontiguousarray(np.asarray(tk),
+                                           dtype=np.uint32).copy(),
+        "table_vals": np.ascontiguousarray(np.asarray(tv),
+                                           dtype=np.float32).copy(),
+    }
+
+
+def wagg_payload(store: dict) -> dict:
+    """One window-store dict {key tuple -> uint64 [values..., count]} ->
+    columnar (keys [G, L] uint32, vals [G, V] uint64) payload."""
+    if not store:
+        return {"kind": "wagg",
+                "keys": np.zeros((0, 0), np.uint32),
+                "vals": np.zeros((0, 0), np.uint64)}
+    lanes = len(next(iter(store)))
+    keys = np.fromiter((x for key in store for x in key), dtype=np.uint64,
+                       count=len(store) * lanes).reshape(len(store), lanes)
+    vals = np.stack([np.asarray(v, dtype=np.uint64)
+                     for v in store.values()])
+    return {"kind": "wagg", "keys": keys.astype(np.uint32), "vals": vals}
+
+
+def dense_payload(totals) -> dict:
+    """Dense accumulator planes -> payload (int64: the (lo, hi) int32
+    planes sum across members, and int64 headroom makes N-member merge
+    overflow a non-issue before renormalization)."""
+    return {"kind": "dense",
+            "totals": np.asarray(totals).astype(np.int64)}
+
+
+def capture_model(model) -> dict:
+    """State payload for one windowed model (the object WindowedHeavyHitter
+    wraps): dispatches on the model's snapshot_kind tag."""
+    kind = getattr(model, "snapshot_kind", None)
+    if kind == "windowed_hh":
+        return hh_payload(model.state)
+    if kind == "windowed_dense":
+        return dense_payload(model.totals)
+    raise TypeError(f"no mesh payload for model kind {kind!r}")

@@ -1,0 +1,552 @@
+"""flowmesh coordinator: membership, epoch-fenced partition ownership,
+and the window-close merge barrier.
+
+One coordinator owns the authoritative offset frontier of every bus
+partition and merges per-worker window state into the network-wide
+result (mesh/merge.py). The protocol is a miniature Kafka group
+coordinator with the merge barrier fused in:
+
+- **Membership**: members join, then heartbeat via ``sync()``. A member
+  that misses ``heartbeat_timeout`` is fenced (declared dead); its
+  partitions are released and the target assignment recomputed
+  (epoch + 1). ``fence()`` is the same path as an admin surface (and
+  the deterministic lever the churn tests use).
+
+- **Ownership**: partitions are assigned round-robin over the sorted
+  live member ids — the same deterministic rule as
+  ``parallel.multihost.reassign_lost_partitions`` (every observer can
+  recompute the map). A member whose owned set differs from its target
+  is told to RESYNC: it submits all of its state with ``release``,
+  drops its worker, and re-acquires its target set; a new owner
+  acquires a partition only after the previous owner released it (or
+  died), always resuming from the coordinator's ``covered`` frontier.
+
+- **Exactness**: a submission carries, per owned partition, the offset
+  range it consumed since its last accepted submission, and the state
+  of every window those rows touched (closed windows as final
+  contributions, the open window as a replaceable CARRY). Accept
+  requires each range to extend the frontier exactly; anything never
+  accepted is replayed by the successor from the frontier, anything
+  accepted is in exactly one contribution. Zombies are fenced: a
+  submission from a dead-declared member is rejected, so its
+  un-accepted rows are replayed by the new owner and never double
+  count. A window (model, slot) merges once every partition's
+  watermark passes slot + window (+ lateness) or is final — at which
+  point monoid-folding ALL its contributions reproduces the
+  single-worker oracle exactly (tests/test_mesh.py).
+"""
+
+from __future__ import annotations
+
+# flowlint: lock-checked
+# (member-facing methods run on N member threads plus HTTP handler
+# threads; every mutable attribute declares its lock below. Sink writes
+# and merge math deliberately run OUTSIDE the locks — only the ready-set
+# pop and the merged-rows ledger are serialized.)
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence
+
+from ..obs import REGISTRY, get_logger
+from . import codec
+from . import merge as merge_ops
+
+log = get_logger("mesh")
+
+# Buckets for the window-merge wall-time histogram (seconds): sub-ms
+# in-process folds up to multi-second cross-network merges.
+MERGE_SECONDS_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+# Merged-rows ledger retention, per model: the newest slots kept for
+# queries/tests/debugging. The SINKS are the durable home of merged
+# output; an unbounded ledger on an endless stream is a slow OOM
+# (days of 5-minute windows accumulate every historical row set).
+MERGED_LEDGER_SLOTS = 16
+
+# Metric name/help specs live here once; the deploy honesty test
+# resolves the Grafana mesh panels against a constructed coordinator.
+MESH_METRICS = {
+    "members": ("mesh_members", "live flowmesh members"),
+    "epoch": ("mesh_epoch", "current flowmesh assignment epoch"),
+    "partitions": ("mesh_partitions", "bus partitions under mesh control"),
+    "rebalance": ("mesh_rebalance_total",
+                  "mesh rebalances (label: reason=join|leave|death)"),
+    "merged": ("mesh_windows_merged_total",
+               "windows merged network-wide (label: model)"),
+    "merge_s": ("mesh_merge_seconds",
+                "window-close merge wall time (decode+fold+extract)"),
+    "flows": ("mesh_member_flows_total",
+              "flows ingested per member (label: member)"),
+    "submit": ("mesh_submit_total", "accepted member submissions"),
+    "rejected": ("mesh_submit_rejected_total",
+                 "rejected member submissions (label: reason)"),
+    "late": ("mesh_late_contribution_total",
+             "contributions that arrived after their window merged "
+             "(label: model)"),
+}
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """One mergeable model: name, kind tag, frozen config, extraction k,
+    window cadence. Built from a worker's models dict so the coordinator
+    merges exactly what the members compute."""
+
+    name: str
+    kind: str  # "wagg" | "hh" | "dense"
+    config: Any
+    k: int
+    window_seconds: int
+    allowed_lateness: int = 0
+
+
+def spec_from_models(models: dict) -> tuple[ModelSpec, ...]:
+    """Derive the mergeable model specs from a models dict (the same
+    dict cli._build_models produces). DDoS detectors are deliberately
+    absent: their per-dst rates are split across shards by the key
+    hash, so mesh mode keeps detection per-shard (the HashPipe model —
+    per-shard detection) and alerts flow through member sinks."""
+    from ..engine.windowed import WindowedHeavyHitter
+    from ..models.window_agg import WindowAggregator
+
+    out = []
+    for name, m in models.items():
+        if isinstance(m, WindowAggregator):
+            out.append(ModelSpec(
+                name, "wagg", m.config, 0, m.config.window_seconds,
+                m.config.allowed_lateness))
+        elif isinstance(m, WindowedHeavyHitter):
+            kind = ("hh" if m.model.snapshot_kind == "windowed_hh"
+                    else "dense")
+            out.append(ModelSpec(name, kind, m.config, m.k,
+                                 m.window_seconds))
+    return tuple(out)
+
+
+class _Member:
+    __slots__ = ("alive", "last_hb", "owned", "provider")
+
+    def __init__(self, provider=None):
+        self.alive = True
+        self.last_hb = 0.0
+        self.owned: set[int] = set()
+        self.provider = provider  # callable(model)->payload | state URL
+
+
+class MeshCoordinator:
+    """Coordinator + merge engine. Duck-type shared with
+    mesh.server.RemoteCoordinator so members run identically in-process
+    and over HTTP."""
+
+    def __init__(self, specs: Sequence[ModelSpec], n_partitions: int,
+                 sinks: Sequence[Any] = (),
+                 heartbeat_timeout: float = 5.0,
+                 time_fn: Callable[[], float] = time.monotonic):
+        self.specs = tuple(specs)
+        self._by_name = {s.name: s for s in self.specs}
+        self.n_partitions = int(n_partitions)
+        self.sinks = list(sinks)
+        self.heartbeat_timeout = heartbeat_timeout
+        self._time = time_fn
+        # flowlint: unguarded -- the locks themselves; bound once
+        self._lock = threading.Lock()
+        # flowlint: unguarded -- bound once (guards only the merged-rows ledger)
+        self._merge_lock = threading.Lock()
+        self.epoch = 0  # guarded-by: _lock
+        self._members: dict[str, _Member] = {}  # guarded-by: _lock
+        self._targets: dict[str, set[int]] = {}  # guarded-by: _lock
+        self._released: set[int] = set(range(self.n_partitions))  # guarded-by: _lock
+        self._covered = [0] * self.n_partitions  # guarded-by: _lock
+        self._wm = [0] * self.n_partitions  # guarded-by: _lock
+        self._final = [False] * self.n_partitions  # guarded-by: _lock
+        # (model, slot) -> list of decoded payloads awaiting the barrier
+        self._pending: dict[tuple[str, int], list] = {}  # guarded-by: _lock
+        # member -> latest open-window state {slot: {model: payload}};
+        # replaced on every accepted submission, promoted on death
+        self._carry: dict[str, dict] = {}  # guarded-by: _lock
+        self._merged_keys: set[tuple[str, int]] = set()  # guarded-by: _lock
+        # (model, slot) -> [rows emitted] (late wagg partials append)
+        self.merged: dict[tuple[str, int], list] = {}  # guarded-by: _merge_lock
+        # eager registration: /metrics carries every mesh family (as
+        # zeros) the moment a coordinator exists — the dashboard honesty
+        # test resolves the mesh panels against this surface
+        self._m = {k: (REGISTRY.histogram(*v, buckets=MERGE_SECONDS_BUCKETS)
+                       if k == "merge_s"
+                       else REGISTRY.gauge(*v) if k in
+                       ("members", "epoch", "partitions")
+                       else REGISTRY.counter(*v))
+                   for k, v in MESH_METRICS.items()}
+        self._m["partitions"].set(self.n_partitions)
+        self._m["members"].set(0)
+        self._m["epoch"].set(0)
+
+    # ---- membership -------------------------------------------------------
+
+    def join(self, member_id: str, provider=None) -> dict:
+        """Register (or re-register) a member. Returns {"epoch": e}.
+        A rejoin under an id that still owns partitions is treated as
+        death-then-join: the old incarnation's carry is promoted and its
+        partitions released (it crashed and came back before expiry)."""
+        with self._lock:
+            old = self._members.get(member_id)
+            fold = []
+            if old is not None and (old.owned or old.alive):
+                # fencing can complete a merge barrier (the promoted
+                # carry may be the last missing contribution) — the
+                # ready list must reach _run_merges or those windows
+                # are popped and silently lost
+                fold = self._fence_locked(member_id, "rejoin")
+            self._members[member_id] = m = _Member(provider)
+            m.last_hb = self._time()
+            self._rebalance_locked("join")
+            epoch = self.epoch
+        if fold:
+            self._run_merges(fold)
+        return {"epoch": epoch}
+
+    def leave(self, member_id: str) -> None:
+        """Graceful leave (after a release/final submission). A member
+        leaving while still owning non-final partitions is fenced
+        instead — its carry must be promoted and the partitions
+        reassigned; finished (final) partitions just release."""
+        fold = []
+        with self._lock:
+            m = self._members.get(member_id)
+            if m is None:
+                return
+            if m.owned and not all(self._final[p] for p in m.owned):
+                fold = self._fence_locked(member_id, "leave")
+            else:
+                self._released |= m.owned
+                m.owned = set()
+                m.alive = False
+                self._carry.pop(member_id, None)
+                self._rebalance_locked("leave")
+        if fold:
+            self._run_merges(fold)
+
+    def fence(self, member_id: str) -> None:
+        """Declare a member dead NOW (admin surface; the heartbeat
+        timeout calls the same path). Its carry is promoted, partitions
+        released, and any later submission from it rejected."""
+        fold = None
+        with self._lock:
+            fold = self._fence_locked(member_id, "death")
+        if fold:
+            self._run_merges(fold)
+
+    def expire(self, now: Optional[float] = None) -> list[str]:
+        """Fence every member whose heartbeat lapsed; returns their ids."""
+        now = self._time() if now is None else now
+        dead = []
+        fold = []
+        with self._lock:
+            for mid, m in list(self._members.items()):
+                if m.alive and now - m.last_hb > self.heartbeat_timeout:
+                    fold.extend(self._fence_locked(mid, "death") or [])
+                    dead.append(mid)
+        if fold:
+            self._run_merges(fold)
+        return dead
+
+    def _fence_locked(self, member_id: str, reason: str):
+        """Death path (caller holds _lock): promote carry into pending,
+        release partitions, rebalance. Returns ready merges to run."""
+        m = self._members.get(member_id)
+        if m is None:
+            return []
+        m.alive = False
+        self._released |= m.owned  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        m.owned = set()
+        carry = self._carry.pop(member_id, None)
+        if carry:
+            self._fold_windows_locked(carry)
+        self._rebalance_locked(reason)
+        log.warning("mesh member %s fenced (%s); epoch now %d",
+                    member_id, reason, self.epoch)
+        return self._pop_ready_locked()
+
+    def _rebalance_locked(self, reason: str) -> None:
+        self.epoch += 1  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        live = sorted(mid for mid, m in self._members.items() if m.alive)
+        self._targets = {mid: set() for mid in live}  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        for p in range(self.n_partitions):
+            if live:
+                self._targets[live[p % len(live)]].add(p)
+        self._m["rebalance"].inc(reason=reason)
+        self._m["members"].set(len(live))
+        self._m["epoch"].set(self.epoch)
+
+    # ---- heartbeat / assignment ------------------------------------------
+
+    def sync(self, member_id: str) -> dict:
+        """Heartbeat + assignment poll. Actions:
+
+        - ``run``    : keep going; ``assign`` carries {partition: resume
+                       offset} when ownership was (re)granted this call
+        - ``resync`` : owned != target — submit all state with
+                       ``release=True``, then sync again to re-acquire
+        - ``wait``   : target partitions not yet released by previous
+                       owners — idle and sync again
+        - ``rejoin`` : unknown or fenced — abandon un-submitted state
+                       (the successor replays it) and join() fresh
+        """
+        self.expire()
+        with self._lock:
+            m = self._members.get(member_id)
+            if m is None or not m.alive:
+                return {"epoch": self.epoch, "action": "rejoin",
+                        "assign": None}
+            m.last_hb = self._time()
+            target = self._targets.get(member_id, set())
+            if m.owned:
+                if m.owned == target:
+                    return {"epoch": self.epoch, "action": "run",
+                            "assign": None}
+                return {"epoch": self.epoch, "action": "resync",
+                        "assign": None}
+            if target and not (target <= self._released):
+                return {"epoch": self.epoch, "action": "wait",
+                        "assign": None}
+            # acquire the full target set atomically (possibly empty:
+            # more members than partitions -> this member idles)
+            m.owned = set(target)
+            self._released -= target
+            assign = {p: self._covered[p] for p in sorted(target)}
+            return {"epoch": self.epoch, "action": "run", "assign": assign}
+
+    # ---- submissions ------------------------------------------------------
+
+    def submit(self, member_id: str, payload) -> dict:
+        """Accept one member contribution (codec bytes or decoded dict).
+        Returns {"ok": True} or {"ok": False, "reason": ...}."""
+        if isinstance(payload, (bytes, bytearray)):
+            payload = codec.decode(bytes(payload))
+        fold = []
+        accepted = False
+        with self._lock:
+            m = self._members.get(member_id)
+            if m is None or not m.alive:
+                self._m["rejected"].inc(reason="fenced")
+                return {"ok": False, "reason": "fenced"}
+            m.last_hb = self._time()
+            ranges = payload.get("ranges", {})
+            for p, rng in ranges.items():
+                p = int(p)
+                if p not in m.owned or int(rng[0]) != self._covered[p] \
+                        or int(rng[1]) < int(rng[0]):
+                    # frontier mismatch: protocol violation or a zombie
+                    # with stale state — fence, force a clean rejoin
+                    self._m["rejected"].inc(reason="range")
+                    fold = self._fence_locked(member_id, "death")
+                    break
+            else:
+                fold = self._accept_locked(m, member_id, payload)
+                accepted = True
+        if fold:
+            self._run_merges(fold)
+        if accepted:
+            return {"ok": True}
+        return {"ok": False, "reason": "fenced"}
+
+    def _accept_locked(self, m: _Member, member_id: str, payload: dict):
+        for p, rng in payload.get("ranges", {}).items():
+            self._covered[int(p)] = int(rng[1])  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        wm = int(payload.get("watermark", 0))
+        for p in m.owned:
+            if wm > self._wm[p]:
+                self._wm[p] = wm  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        flows = int(payload.get("flows", 0))
+        if flows:
+            self._m["flows"].inc(flows, member=member_id)
+        self._m["submit"].inc()
+        self._fold_windows_locked({"windows": payload.get("closed", {})})
+        open_windows = payload.get("open", {})
+        if payload.get("release") or payload.get("final"):
+            # the member is resetting (resync) or done: its open state
+            # must not sit in a carry nobody will promote
+            self._fold_windows_locked({"windows": open_windows})
+            self._carry.pop(member_id, None)
+        else:
+            self._carry[member_id] = {"windows": open_windows}  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        if payload.get("final"):
+            for p in m.owned:
+                self._final[p] = True  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        if payload.get("release"):
+            self._released |= m.owned  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+            m.owned = set()
+        return self._pop_ready_locked()
+
+    def _fold_windows_locked(self, contribution: dict) -> None:
+        """Fold {slot: {model: payload}} into the pending barrier. A
+        contribution for an already-merged window is LATE: exact wagg
+        partials are emitted as additional rows (the single-worker late
+        semantics — merging sinks combine them); late sketch state has
+        no exact merge target left and is dropped, counted."""
+        for slot, models in contribution.get("windows", {}).items():
+            slot = int(slot)
+            for name, payload in models.items():
+                if name not in self._by_name:
+                    continue
+                key = (name, slot)
+                if key in self._merged_keys:
+                    self._m["late"].inc(model=name)
+                    if payload.get("kind") == "wagg":
+                        self._pending.setdefault(key, []).append(payload)
+                        self._merged_keys.discard(key)  # re-merge partial
+                    continue
+                self._pending.setdefault(key, []).append(payload)
+
+    def _pop_ready_locked(self) -> list:
+        """Detach every pending window whose barrier condition holds:
+        all partitions final, or watermark past slot + window (+
+        lateness). Marks them merged so later contributions register as
+        late."""
+        ready = []
+        for key in sorted(self._pending):
+            name, slot = key
+            spec = self._by_name[name]
+            limit = slot + spec.window_seconds + spec.allowed_lateness
+            if all(self._final[p] or self._wm[p] >= limit
+                   for p in range(self.n_partitions)):
+                ready.append((name, slot, self._pending.pop(key)))
+                self._merged_keys.add(key)
+        return ready
+
+    # ---- merging ----------------------------------------------------------
+
+    def _run_merges(self, ready: list) -> None:
+        """Fold + extract + emit each detached window. Runs on the
+        submitting thread with NO coordinator lock held (merge math and
+        sink writes must not serialize member heartbeats)."""
+        for name, slot, payloads in ready:
+            t0 = time.perf_counter()
+            spec = self._by_name[name]
+            rows = self._merge_one(spec, slot, payloads)
+            for sink in self.sinks:
+                sink.write(name, rows)
+            with self._merge_lock:
+                self.merged.setdefault((name, slot), []).append(rows)
+                # bounded retention (newest slots win); _merged_keys is
+                # NOT evicted — late-contribution detection must keep
+                # working for windows whose rows have aged out
+                slots = sorted(s for n, s in self.merged if n == name)
+                for s in slots[:-MERGED_LEDGER_SLOTS]:
+                    del self.merged[(name, s)]
+            self._m["merge_s"].observe(time.perf_counter() - t0)
+            self._m["merged"].inc(model=name)
+            log.info("mesh merged window model=%s slot=%d contribs=%d",
+                     name, slot, len(payloads))
+
+    @staticmethod
+    def _merge_one(spec: ModelSpec, slot: int, payloads: list) -> dict:
+        if spec.kind == "wagg":
+            from ..models.window_agg import rows_from_stores
+
+            store = merge_ops.merge_wagg(payloads)
+            return rows_from_stores(spec.config, [(slot, store)])
+        if spec.kind == "hh":
+            merged = merge_ops.merge_hh(payloads, spec.config)
+            return merge_ops.hh_top_rows(merged, spec.config, spec.k, slot)
+        totals = merge_ops.merge_dense(payloads)
+        return merge_ops.dense_top_rows(totals, spec.config, spec.k, slot)
+
+    # ---- live queries (mesh-aware /topk) ----------------------------------
+
+    def query_topk(self, model: Optional[str] = None,
+                   k: Optional[int] = None) -> dict:
+        """Fan the query to every live member's state provider and
+        answer from the merged open-window view — the network-wide
+        equivalent of QueryServer._topk's single-worker answer."""
+        spec = None
+        if model:
+            spec = self._by_name.get(model)
+            if spec is None or spec.kind == "wagg":
+                raise KeyError(f"no mesh top-K model named {model!r}")
+        else:
+            # default selection mirrors the single-worker QueryServer:
+            # the first model with a top-K surface, dense-backed included
+            spec = next((s for s in self.specs
+                         if s.kind in ("hh", "dense")), None)
+            if spec is None:
+                raise KeyError("no top-K model configured")
+        with self._lock:
+            providers = [(mid, m.provider)
+                         for mid, m in self._members.items()
+                         if m.alive and m.provider is not None]
+            # NOT the carries: every carry belongs to a LIVE member
+            # (death promotes them into _pending), and a live member's
+            # provider state is a superset of its own carry — folding
+            # both would double-count everything since its last
+            # submission. What CAN be missing from the providers is a
+            # dead member's promoted-but-unmerged contribution: that
+            # sits in _pending, disjoint from its successor's state
+            # (the successor resumed at the covered frontier).
+            pending = {slot: list(payloads)
+                       for (name, slot), payloads in self._pending.items()
+                       if name == spec.name}
+        states: list[tuple[int, dict]] = []
+        for mid, provider in providers:
+            try:
+                res = provider(spec.name)
+            except (OSError, ValueError) as e:
+                # a dying-but-not-yet-fenced member must DEGRADE the
+                # answer (its un-submitted open rows are missing until
+                # the fence promotes/replays), never black out /topk
+                log.warning("mesh /topk: member %s state fetch failed "
+                            "(%s); answering without it", mid, e)
+                continue
+            if isinstance(res, (bytes, bytearray)):
+                res = codec.decode(bytes(res))
+            if res and res.get("slot") is not None:
+                states.append((int(res["slot"]), res["payload"]))
+        slots = [s for s, _ in states] + list(pending)
+        if not slots:
+            return {"model": spec.name, "window_start": None, "rows": []}
+        slot = max(slots)
+        payloads = [p for s, p in states if s == slot] + \
+            pending.get(slot, [])
+        from ..sink.base import rows_to_records
+
+        kk = k or spec.k or spec.config.capacity
+        if spec.kind == "hh":
+            merged = merge_ops.merge_hh(payloads, spec.config)
+            rows = merge_ops.hh_top_rows(merged, spec.config, kk, slot)
+        else:
+            rows = merge_ops.dense_top_rows(
+                merge_ops.merge_dense(payloads), spec.config, kk, slot)
+        return {"model": spec.name, "window_start": slot,
+                "rows": rows_to_records(rows)}
+
+    # ---- introspection ----------------------------------------------------
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "epoch": self.epoch,
+                "partitions": self.n_partitions,
+                "members": {
+                    mid: {"alive": m.alive,
+                          "owned": sorted(m.owned),
+                          "target": sorted(self._targets.get(mid, ()))}
+                    for mid, m in self._members.items()
+                },
+                "covered": list(self._covered),
+                "watermarks": list(self._wm),
+                "final": list(self._final),
+                "pending_windows": sorted(
+                    f"{n}:{s}" for n, s in self._pending),
+            }
+
+    def merged_rows(self, name: str, slot: Optional[int] = None) -> list:
+        """Emitted merged rows for one model (all slots, or one) — the
+        test/debug ledger."""
+        with self._merge_lock:
+            if slot is not None:
+                return list(self.merged.get((name, slot), []))
+            return [rows for (n, _), rs in sorted(self.merged.items())
+                    if n == name for rows in rs]

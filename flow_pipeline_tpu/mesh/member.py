@@ -1,0 +1,369 @@
+"""flowmesh member: one StreamWorker under coordinator control.
+
+A member wraps a full StreamWorker (free to run the fused host
+dataplane — the models, pipelines, prefetch and flusher machinery are
+untouched) and adds the mesh contract around it:
+
+- window-close CAPTURE: the WindowAggregator / WindowedHeavyHitter
+  capture hooks hand raw per-window state to the member instead of
+  extracting rows locally; the member ships it to the coordinator as a
+  serialized contribution (mesh/codec.py) tagged with the per-partition
+  offset ranges it covers.
+- OPEN-window carry: every submission also snapshots the still-open
+  windows, so a member death costs its successor at most the rows since
+  the last accepted submission (``submit_every`` bounds that mid-window)
+  and never loses a window.
+- assignment lifecycle: ``sync()`` heartbeats the coordinator; on a
+  target change the member RESYNCs — final-submits everything with
+  ``release``, drops the worker, and rebuilds fresh on its new
+  partition set from the coordinator's offset frontier. A fenced
+  (zombie) member abandons its un-submitted state — the successor
+  replays those rows, which is exactly what keeps the merge exact.
+
+DDoS detectors (when configured) stay per-shard: their alerts flow
+through the member's own sinks, per the HashPipe per-shard-detection
+model (PAPERS.md 1611.04825).
+"""
+
+from __future__ import annotations
+
+# flowlint: lock-checked
+# (a member is single-threaded by construction: step()/run() execute on
+# ONE driver thread, and the capture hooks fire inside worker.run_once
+# on that same thread. The only cross-thread entry is the coordinator's
+# state-provider fan-out, which takes worker.lock and mutates nothing.)
+
+import time
+from typing import Any, Callable, Optional, Sequence
+
+from ..engine.prefetch import PrefetchConsumer
+from ..engine.windowed import WindowedHeavyHitter
+from ..engine.worker import StreamWorker, WorkerConfig
+from ..models.window_agg import WindowAggregator
+from ..obs import get_logger
+from . import codec
+
+log = get_logger("mesh")
+
+
+class MeshMember:
+    """Coordinator-driven StreamWorker shard."""
+
+    def __init__(self, member_id: str, coordinator,
+                 consumer_factory: Callable[[Sequence[int]], Any],
+                 model_factory: Callable[[], dict],
+                 config: WorkerConfig = WorkerConfig(),
+                 sinks: Sequence[Any] = (),
+                 submit_every: int = 0,
+                 sync_interval: float = 0.2):
+        self.member_id = member_id
+        self.coordinator = coordinator
+        self.consumer_factory = consumer_factory
+        self.model_factory = model_factory
+        self.config = config
+        self.sinks = list(sinks)
+        # >0: also submit a progress carry every N applied batches even
+        # without a window close — bounds a successor's replay (and the
+        # carry the coordinator can promote) to N batches mid-window
+        self.submit_every = submit_every
+        self.sync_interval = sync_interval
+        # flowlint: unguarded -- driver thread only (see module header)
+        self.worker: Optional[StreamWorker] = None
+        # flowlint: unguarded -- driver thread only
+        self._frontier: dict[int, int] = {}
+        # slot -> {model: payload}: closed windows since the last submit
+        # flowlint: unguarded -- driver thread only (capture hooks run inside run_once on this thread)
+        self._captured: dict[int, dict] = {}
+        # flowlint: unguarded -- driver thread only
+        self._flows_reported = 0
+        # flowlint: unguarded -- driver thread only
+        self._batches_since_submit = 0
+        # flowlint: unguarded -- driver thread only
+        self._last_sync = 0.0
+        # flowlint: unguarded -- driver thread only
+        self._joined = False
+        # flowlint: unguarded -- written by kill() (runtime thread) and read by run(); a plain latch flag
+        self._dead = False
+        # flowlint: unguarded -- written by the driver thread, read by the runtime's quiescence poll; a monotone-ish progress signal, not state
+        self.idle_streak = 0
+
+    # ---- capture hooks ----------------------------------------------------
+
+    def _install_hooks(self, models: dict) -> None:
+        for name, m in models.items():
+            if isinstance(m, WindowAggregator):
+                m.capture = self._wagg_capture(name)
+            elif isinstance(m, WindowedHeavyHitter):
+                m.capture = self._whh_capture(name)
+
+    def _wagg_capture(self, name: str):
+        def capture(popped):
+            for slot, store in popped:
+                self._captured.setdefault(int(slot), {})[name] = \
+                    codec.wagg_payload(store)
+        return capture
+
+    def _whh_capture(self, name: str):
+        def capture(slot, model):
+            self._captured.setdefault(int(slot), {})[name] = \
+                codec.capture_model(model)
+        return capture
+
+    # ---- assignment lifecycle --------------------------------------------
+
+    def _sync(self) -> None:
+        if not self._joined:
+            self.coordinator.join(self.member_id,
+                                  provider=self._query_state)
+            self._joined = True
+        resp = self.coordinator.sync(self.member_id)
+        action = resp.get("action")
+        if action == "rejoin":
+            # fenced: our un-submitted state is the successor's replay
+            self._abandon()
+            self._joined = False
+            return
+        if action == "resync":
+            self._resync()
+            # try to re-acquire immediately
+            resp = self.coordinator.sync(self.member_id)
+            action = resp.get("action")
+        if action == "run" and resp.get("assign") is not None:
+            self._start_worker(resp["assign"])
+
+    def _start_worker(self, assign: dict) -> None:
+        assign = {int(p): int(off) for p, off in assign.items()}
+        self._frontier = dict(assign)
+        self._captured = {}
+        if not assign:
+            self.worker = None
+            return
+        consumer = self.consumer_factory(sorted(assign))
+        if hasattr(consumer, "positions"):
+            for p, off in assign.items():
+                consumer.positions[p] = off
+        models = self.model_factory()
+        self._install_hooks(models)
+        self.worker = StreamWorker(consumer, models, self.sinks,
+                                   self.config)
+        self._flows_reported = 0
+        self._batches_since_submit = 0
+        # fresh ownership means fresh (possibly large) backlog: the
+        # runtime's quiescence poll must not read a stale idle streak
+        # from the waiting-for-assignment phase
+        self.idle_streak = 0
+        log.info("mesh member %s serving partitions %s",
+                 self.member_id, sorted(assign))
+
+    def _resync(self) -> None:
+        log.info("mesh member %s resyncing (assignment changed)",
+                 self.member_id)
+        if self.worker is not None:
+            w = self.worker
+            w.finalize()  # force-close -> capture hooks fire
+            self._submit(release=True)
+            self.worker = None
+            self._close_consumer(w)
+        else:
+            self.coordinator.submit(self.member_id, codec.encode({
+                "member": self.member_id, "ranges": {}, "watermark": 0,
+                "closed": {}, "open": {}, "flows": 0, "release": True,
+                "final": False}))
+        self._captured = {}
+        self._frontier = {}
+
+    def _abandon(self) -> None:
+        """Drop the worker WITHOUT submitting (we were fenced): stop its
+        threads; state is discarded — the successor replays our rows."""
+        w, self.worker = self.worker, None
+        self._captured = {}
+        self._frontier = {}
+        if w is not None:
+            self._stop_worker_threads(w)
+
+    @staticmethod
+    def _stop_worker_threads(w: StreamWorker) -> None:
+        if w.executor is not None:
+            w.executor.stop()
+        if w.flusher is not None:
+            w.flusher.stop()
+        if isinstance(w.consumer, PrefetchConsumer):
+            w.consumer.stop()
+        MeshMember._close_consumer(w)
+
+    @staticmethod
+    def _close_consumer(w: StreamWorker) -> None:
+        """Release the dropped worker's broker connection. Every
+        rebalance builds a fresh consumer, so a churny mesh would
+        otherwise leak one kafka-python connection per resync per
+        member; the in-process bus consumer has no close() and needs
+        none."""
+        raw = w.consumer
+        if isinstance(raw, PrefetchConsumer):
+            raw = raw.inner
+        close = getattr(raw, "close", None)
+        if close is not None:
+            close()
+
+    # ---- submissions ------------------------------------------------------
+
+    def _watermark(self, w: StreamWorker) -> int:
+        wm = 0
+        for m in w.models.values():
+            if isinstance(m, WindowAggregator):
+                wm = max(wm, int(m.watermark))
+            elif isinstance(m, WindowedHeavyHitter) and \
+                    m.current_slot is not None:
+                wm = max(wm, int(m.current_slot))
+        return wm
+
+    def _collect_open(self, w: StreamWorker) -> dict:
+        """{slot: {model: payload}} for every still-open window. Caller
+        holds worker.lock and has synced sketch states."""
+        out: dict[int, dict] = {}
+        for name, m in w.models.items():
+            if isinstance(m, WindowAggregator):
+                m._drain()
+                for slot, store in m.windows.items():
+                    out.setdefault(int(slot), {})[name] = \
+                        codec.wagg_payload(store)
+            elif isinstance(m, WindowedHeavyHitter) and \
+                    m.current_slot is not None:
+                out.setdefault(int(m.current_slot), {})[name] = \
+                    codec.capture_model(m.model)
+        return out
+
+    def _submit(self, final: bool = False, release: bool = False) -> bool:
+        w = self.worker
+        if w is None:
+            return True
+        closed, self._captured = self._captured, {}
+        with w.lock:
+            w.sync_sketch_states()
+            # final/release submissions follow a worker.finalize(): every
+            # window was force-closed into `closed` and nothing is open;
+            # a normal submission ships the open windows as the carry
+            open_windows = {} if (final or release) \
+                else self._collect_open(w)
+            ranges = {}
+            for p, start in self._frontier.items():
+                to = max(int(w._covered.get(p, start)), start)
+                ranges[p] = [start, to]
+            watermark = self._watermark(w)
+            flows = w.flows_seen
+        payload = {
+            "member": self.member_id,
+            "ranges": ranges,
+            "watermark": watermark,
+            "closed": closed,
+            "open": open_windows,
+            "flows": flows - self._flows_reported,
+            "final": final,
+            "release": release,
+        }
+        resp = self.coordinator.submit(self.member_id,
+                                       codec.encode(payload))
+        if not resp.get("ok"):
+            log.warning("mesh member %s submission rejected (%s); "
+                        "abandoning state and rejoining",
+                        self.member_id, resp.get("reason"))
+            self._abandon()
+            self._joined = False
+            return False
+        self._flows_reported = flows
+        self._batches_since_submit = 0
+        for p, rng in ranges.items():
+            self._frontier[p] = rng[1]
+        return True
+
+    # ---- driver loop ------------------------------------------------------
+
+    def step(self) -> bool:
+        """One poll/process/submit round. Returns False when idle."""
+        if self._dead:
+            return False
+        now = time.monotonic()
+        # unassigned members poll for an assignment faster than the
+        # heartbeat cadence, but still BOUNDED — an idle fleet must not
+        # hammer the coordinator with per-step sync round-trips
+        interval = self.sync_interval if self.worker is not None \
+            else min(self.sync_interval, 0.05)
+        if now - self._last_sync >= interval:
+            self._last_sync = now
+            self._sync()
+        w = self.worker  # kill() may null the attribute mid-step
+        if w is None or self._dead:
+            return False
+        progressed = w.run_once()
+        if progressed:
+            self._batches_since_submit += 1
+        if self._captured or (
+                self.submit_every
+                and self._batches_since_submit >= self.submit_every):
+            self._submit()
+        elif not progressed and self._batches_since_submit:
+            # going idle with consumed-but-unreported progress: flush it
+            # now, or this member's watermark never reaches the
+            # coordinator and every partition it owns stalls the
+            # mesh-wide merge barrier until the NEXT row arrives. (A
+            # shard that never saw a row at all still holds the barrier
+            # — there is no event time to report; see ARCHITECTURE.md
+            # "flowmesh" failure model.)
+            self._submit()
+        return progressed
+
+    def run(self, stop, idle_sleep: float = 0.01) -> None:
+        """Thread target: step until ``stop`` (threading.Event) is set."""
+        while not stop.is_set() and not self._dead:
+            try:
+                progressed = self.step()
+            except Exception:
+                if self._dead:
+                    return  # kill() tore the worker down mid-step
+                raise
+            if progressed:
+                self.idle_streak = 0
+            else:
+                self.idle_streak += 1
+                stop.wait(idle_sleep)
+
+    def finalize(self) -> None:
+        """End of stream: force-close everything, final-submit, leave."""
+        if self._dead:
+            return
+        if self.worker is not None:
+            w = self.worker
+            w.finalize()  # capture hooks grab all open windows
+            self._submit(final=True)
+            self.worker = None
+            self._close_consumer(w)
+        if self._joined:
+            self.coordinator.leave(self.member_id)
+            self._joined = False
+
+    def kill(self) -> None:
+        """Abrupt death (churn tests / emergency stop): no submission,
+        no leave — the coordinator fences us by heartbeat timeout (or
+        an explicit fence()) and promotes the last accepted carry."""
+        self._dead = True
+        w, self.worker = self.worker, None
+        if w is not None:
+            self._stop_worker_threads(w)
+
+    # ---- live-query provider (coordinator fan-out) ------------------------
+
+    def _query_state(self, model_name: str):
+        """Open-window sketch state for the mesh /topk fan-out. Runs on
+        the coordinator's thread; worker.lock gives it the same
+        consistent view QueryServer gets on a single worker."""
+        w = self.worker
+        if w is None:
+            return None
+        with w.lock:
+            m = w.models.get(model_name)
+            if not isinstance(m, WindowedHeavyHitter) or \
+                    m.current_slot is None:
+                return None
+            w.sync_sketch_states()
+            return {"slot": int(m.current_slot),
+                    "payload": codec.capture_model(m.model)}

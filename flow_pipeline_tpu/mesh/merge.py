@@ -1,0 +1,147 @@
+"""flowmesh window-close merges: the monoid algebra, host-side.
+
+These are the `parallel/sharded.py` collective merges lifted off the
+device mesh onto serialized payloads (PAPERS.md's data-plane HH model —
+HashPipe 1611.04825, 1902.06993: per-shard detection, network-wide
+exact merge):
+
+- exact window aggregates : per-key uint64 SUM (associative, exact)
+- CMS planes              : element-wise uint64 SUM — the count-min
+                            sketch is linear in the stream, so the sum
+                            of per-shard sketches IS the sketch of the
+                            union stream (bit-identical for the plain
+                            update; a valid, slightly looser upper
+                            bound under conservative update)
+- top-K candidate tables  : concat -> group-by-key sum -> rank by
+                            primary desc with the stable lexicographic
+                            tie-break (`jnp.argsort(-primary)`'s exact
+                            behavior — the same table-table fold
+                            ops.topk.topk_merge runs on device). With
+                            key-hash sharding the key sets are
+                            disjoint, so the per-key sum degenerates to
+                            a copy and the merged values are exact.
+- dense accumulators      : element-wise integer sum (the (lo, hi)
+                            planes recombine exactly at extraction)
+
+Pure numpy — the coordinator merges without touching a device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..hostsketch.engine import np_cms_query
+from ..models.heavy_hitter import HeavyHitterConfig, key_width
+from ..ops.hostgroup import _lex_regroup
+from ..schema.batch import lane_width
+
+_SENTINEL = np.uint32(0xFFFFFFFF)
+
+
+# ---- exact window aggregates ----------------------------------------------
+
+
+def merge_wagg(payloads: list[dict]) -> dict:
+    """Fold wagg payloads (keys [G, L] u32, vals [G, V] u64) into one
+    window-store dict {key tuple -> uint64 vec} — per-key sums, exact."""
+    real = [p for p in payloads if len(p["keys"])]
+    if not real:
+        return {}
+    keys = np.concatenate([p["keys"].astype(np.uint32) for p in real])
+    vals = np.concatenate([p["vals"].astype(np.uint64) for p in real])
+    order, starts = _lex_regroup(keys)
+    uniq = keys[order][starts]
+    sums = np.add.reduceat(vals[order], starts, axis=0)
+    return {tuple(int(x) for x in uniq[i]): sums[i]
+            for i in range(len(starts))}
+
+
+# ---- heavy-hitter sketch state --------------------------------------------
+
+
+def merge_hh(payloads: list[dict], config: HeavyHitterConfig) -> dict:
+    """Fold hh payloads into one merged {cms, table_keys, table_vals}.
+
+    CMS: uint64 element sum. Table: the table-table fold — every real
+    row from every table, grouped by key (lexicographic), per-key plane
+    sums, ranked by plane-0 descending with the stable lex tie-break,
+    truncated to capacity.
+    """
+    planes = len(config.value_cols) + 1
+    kw = key_width(config)
+    cms = np.zeros((planes, config.depth, config.width), np.uint64)
+    rows_k, rows_v = [], []
+    for p in payloads:
+        cms += p["cms"].astype(np.uint64)
+        tk = p["table_keys"].astype(np.uint32)
+        tv = p["table_vals"].astype(np.float32)
+        real = (tk != _SENTINEL).any(axis=1)
+        rows_k.append(tk[real])
+        rows_v.append(tv[real])
+    new_keys = np.full((config.capacity, kw), _SENTINEL, np.uint32)
+    new_vals = np.zeros((config.capacity, planes), np.float32)
+    keys = np.concatenate(rows_k) if rows_k else new_keys[:0]
+    vals = np.concatenate(rows_v) if rows_v else new_vals[:0]
+    if len(keys):
+        order, starts = _lex_regroup(keys)
+        uniq = keys[order][starts]
+        sums = np.add.reduceat(vals[order], starts,
+                               axis=0).astype(np.float32)
+        top = np.argsort(-sums[:, 0], kind="stable")[:config.capacity]
+        new_keys[:len(top)] = uniq[top]
+        new_vals[:len(top)] = sums[top]
+    return {"kind": "hh", "cms": cms, "table_keys": new_keys,
+            "table_vals": new_vals}
+
+
+def hh_top_rows(merged: dict, config: HeavyHitterConfig, k: int,
+                slot: int) -> dict[str, np.ndarray]:
+    """Columnar top-k rows from one merged hh payload — the numpy twin of
+    models.heavy_hitter._top_from_state plus the timeslot column
+    WindowedHeavyHitter stamps at window close, so merged output rows are
+    shape- and dtype-identical to a single worker's."""
+    k = min(k, config.capacity)
+    keys = merged["table_keys"][:k]
+    vals = merged["table_vals"][:k]
+    valid = (keys != _SENTINEL).any(axis=1)
+    ests = np_cms_query(merged["cms"], keys)[:k]
+    out: dict[str, np.ndarray] = {}
+    col = 0
+    for name in config.key_cols:
+        w = lane_width(name)
+        out[name] = keys[:, col:col + w] if w == 4 else keys[:, col]
+        col += w
+    for j, name in enumerate(config.value_cols):
+        out[name] = vals[:, j]
+        out[f"{name}_est"] = ests[:, j]
+    out["count"] = vals[:, -1]
+    out["count_est"] = ests[:, -1]
+    out["valid"] = valid
+    out["timeslot"] = np.full(len(valid), slot, dtype=np.uint64)
+    return out
+
+
+# ---- dense accumulators ---------------------------------------------------
+
+
+def merge_dense(payloads: list[dict]) -> np.ndarray:
+    """Element-wise int64 sum of dense (lo, hi) planes."""
+    out = payloads[0]["totals"].astype(np.int64).copy()
+    for p in payloads[1:]:
+        out += p["totals"].astype(np.int64)
+    return out
+
+
+def dense_top_rows(totals: np.ndarray, config, k: int,
+                   slot: int) -> dict[str, np.ndarray]:
+    """Top-k rows from merged dense totals, via the model's own exact
+    extraction (summed lo planes stay far below int32 before the exact
+    lo + (hi << 16) recombination)."""
+    from ..models.dense_top import DenseTopKModel
+
+    model = DenseTopKModel.__new__(DenseTopKModel)
+    model.config = config
+    model.totals = np.asarray(totals, dtype=np.int64).astype(np.int32)
+    top = model.top(k)
+    top["timeslot"] = np.full(len(top["valid"]), slot, dtype=np.uint64)
+    return top

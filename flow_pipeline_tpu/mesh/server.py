@@ -1,0 +1,237 @@
+"""flowmesh HTTP transport: the coordinator protocol across processes.
+
+The in-process objects (MeshCoordinator / MeshMember) speak plain
+method calls; this module carries the same calls over HTTP so the
+compose topology (deploy/compose/mesh.yml: coordinator + N worker
+containers) runs the identical protocol:
+
+    POST /mesh/join    {"member": id, "state_url": url|null}
+    POST /mesh/sync    {"member": id}
+    POST /mesh/submit?member=id   (octet-stream: mesh/codec envelope)
+    POST /mesh/leave   {"member": id}
+    GET  /topk?model=M&k=N        merged open-window view (fan-out)
+    GET  /healthz /state          liveness + protocol introspection
+
+``RemoteCoordinator`` duck-types MeshCoordinator for MeshMember, and
+``MemberStateServer`` is the member-side /meshstate endpoint the
+coordinator's /topk fan-out queries.
+"""
+
+from __future__ import annotations
+
+# flowlint: lock-checked
+# (handlers delegate to the coordinator/member objects, which carry
+# their own locking contracts; the servers themselves only bind
+# immutable attributes after __init__)
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..obs import get_logger
+from .coordinator import MeshCoordinator
+
+log = get_logger("mesh")
+
+
+def _url_provider(state_url: str):
+    """Wrap a member's /meshstate URL as a coordinator provider."""
+    def provider(model: str):
+        req = urllib.request.Request(
+            f"{state_url}?model={urllib.parse.quote(model)}")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            if resp.status == 204:
+                return None
+            return resp.read()
+    return provider
+
+
+class MeshCoordinatorServer:
+    """HTTP front of one MeshCoordinator + a background expiry sweep."""
+
+    def __init__(self, coordinator: MeshCoordinator, port: int = 8090,
+                 host: str = "127.0.0.1"):
+        self.coordinator = coordinator
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                url = urlparse(self.path)
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else b""
+                try:
+                    if url.path == "/mesh/submit":
+                        q = {k: v[0] for k, v in
+                             parse_qs(url.query).items()}
+                        out = outer.coordinator.submit(q["member"], body)
+                    elif url.path in ("/mesh/join", "/mesh/sync",
+                                      "/mesh/leave"):
+                        req = json.loads(body or b"{}")
+                        member = req["member"]
+                        if url.path == "/mesh/join":
+                            provider = (_url_provider(req["state_url"])
+                                        if req.get("state_url") else None)
+                            out = outer.coordinator.join(
+                                member, provider=provider)
+                        elif url.path == "/mesh/sync":
+                            out = outer.coordinator.sync(member)
+                        else:
+                            outer.coordinator.leave(member)
+                            out = {}
+                    else:
+                        self._reply(404, {"error": url.path})
+                        return
+                    self._reply(200, out)
+                except (KeyError, ValueError) as e:
+                    self._reply(400, {"error": str(e)})
+
+            def do_GET(self):  # noqa: N802
+                url = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(url.query).items()}
+                try:
+                    if url.path == "/topk":
+                        k = int(q["k"]) if "k" in q else None
+                        out = outer.coordinator.query_topk(
+                            q.get("model"), k)
+                    elif url.path == "/healthz":
+                        st = outer.coordinator.status()
+                        out = {"ok": True, "epoch": st["epoch"],
+                               "members": len(st["members"])}
+                    elif url.path == "/state":
+                        out = outer.coordinator.status()
+                    else:
+                        self._reply(404, {"error": url.path})
+                        return
+                    self._reply(200, out)
+                except (KeyError, ValueError) as e:
+                    # ValueError covers malformed query params
+                    # (e.g. /topk?k=abc) — 400, not a handler traceback
+                    self._reply(400, {"error": str(e)})
+
+            def _reply(self, code, obj):
+                body = json.dumps(obj, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="mesh-http",
+            daemon=True)
+        self._sweep_stop = threading.Event()
+        self._sweeper = threading.Thread(
+            target=self._sweep, name="mesh-expiry", daemon=True)
+
+    def _sweep(self) -> None:
+        period = max(0.5, self.coordinator.heartbeat_timeout / 2)
+        while not self._sweep_stop.wait(period):
+            for mid in self.coordinator.expire():
+                log.warning("mesh expiry: fenced silent member %s", mid)
+
+    def start(self) -> "MeshCoordinatorServer":
+        self._thread.start()
+        self._sweeper.start()
+        log.info("mesh coordinator on http://%s:%d", self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        self._sweep_stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RemoteCoordinator:
+    """MeshCoordinator duck type over HTTP (the member side)."""
+
+    def __init__(self, base_url: str, state_url: str | None = None,
+                 timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.state_url = state_url
+        self.timeout = timeout
+
+    def _post_json(self, path: str, obj: dict) -> dict:
+        req = urllib.request.Request(
+            self.base_url + path, data=json.dumps(obj).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read().decode())
+
+    def join(self, member_id: str, provider=None) -> dict:
+        # provider callables cannot cross HTTP; the member's state URL
+        # (served by MemberStateServer) plays that role remotely
+        return self._post_json("/mesh/join", {
+            "member": member_id, "state_url": self.state_url})
+
+    def sync(self, member_id: str) -> dict:
+        return self._post_json("/mesh/sync", {"member": member_id})
+
+    def leave(self, member_id: str) -> None:
+        self._post_json("/mesh/leave", {"member": member_id})
+
+    def submit(self, member_id: str, payload: bytes) -> dict:
+        req = urllib.request.Request(
+            f"{self.base_url}/mesh/submit?member="
+            f"{urllib.parse.quote(member_id)}",
+            data=payload,
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read().decode())
+
+
+class MemberStateServer:
+    """The member-side /meshstate endpoint for the /topk fan-out."""
+
+    def __init__(self, member, port: int = 0, host: str = "127.0.0.1"):
+        from . import codec
+
+        outer_member = member
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                url = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(url.query).items()}
+                if url.path != "/meshstate" or "model" not in q:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                state = outer_member._query_state(q["model"])
+                if state is None:
+                    self.send_response(204)
+                    self.end_headers()
+                    return
+                body = codec.encode(state)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self.url = f"http://{host}:{self.port}/meshstate"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="mesh-state",
+            daemon=True)
+
+    def start(self) -> "MemberStateServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -185,6 +185,12 @@ class WindowAggregator:
         # prove "nothing closable" without forcing a fold
         self._pending_host: list = []
         self._min_pending_slot: Optional[int] = None
+        # flowmesh capture seam (mesh/member.py): when set, pop_closed
+        # hands the popped (slot, store) pairs to the hook and reports
+        # nothing closable locally — per-shard partial stores merge
+        # network-wide at the coordinator. None keeps single-worker
+        # behavior byte-identical.
+        self.capture = None
 
     @property
     def store_key_lanes(self) -> int:
@@ -413,7 +419,11 @@ class WindowAggregator:
             return []
         self._drain()
         slots = sorted(self.windows) if force else self.closed_slots()
-        return [(slot, self.windows.pop(slot)) for slot in slots]
+        popped = [(slot, self.windows.pop(slot)) for slot in slots]
+        if self.capture is not None:
+            self.capture(popped)  # mesh member: stores merge upstream
+            return []
+        return popped
 
     def flush(self, force: bool = False) -> dict[str, np.ndarray]:
         """Pop finalized windows (all, if force) as columnar rows.

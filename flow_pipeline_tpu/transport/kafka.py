@@ -41,13 +41,15 @@ class KafkaProducerAdapter:
         self.fixedlen = fixedlen
         self.produced = 0
 
-    def send(self, msg) -> None:
+    def send(self, msg, partition: Optional[int] = None) -> None:
+        """``partition`` pins the message (the flowmesh key-hash shard
+        contract); None keeps the client's default partitioner."""
         data = (
             self._wire.encode_frame(msg)
             if self.fixedlen
             else self._wire.encode_message(msg)
         )
-        self._producer.send(self.topic, data)
+        self._producer.send(self.topic, data, partition=partition)
         self.produced += 1
 
     def flush(self) -> None:
@@ -63,7 +65,8 @@ class KafkaConsumerAdapter:
     """
 
     def __init__(self, brokers: str, topic: str = "flows",
-                 group: str = "tpu-processor", fixedlen: bool = False):
+                 group: str = "tpu-processor", fixedlen: bool = False,
+                 partitions: Optional[list[int]] = None):
         if not available():
             raise RuntimeError(
                 f"real Kafka transport unavailable ({_IMPORT_ERROR}); "
@@ -79,13 +82,35 @@ class KafkaConsumerAdapter:
         self.topic = topic
         self.fixedlen = fixedlen
         self._pending = deque()  # batches already fetched, not yet returned
-        self._consumer = _KC(
-            topic,
-            bootstrap_servers=brokers.split(","),
-            group_id=group,
-            enable_auto_commit=False,
-            auto_offset_reset="earliest",
-        )
+        # Explicit partition ownership (the flowmesh member path): assign()
+        # instead of the group-subscription rebalancer — the mesh
+        # coordinator IS the assignor, so the broker's own group protocol
+        # must not move partitions underneath it. ``positions`` mirrors
+        # transport.Consumer's resume seam: offsets written there before
+        # the first poll are seek()ed, letting the coordinator hand out
+        # its covered frontier as the resume point.
+        self.partitions = partitions
+        self.positions: dict[int, int] = {}
+        self._seeked = partitions is None
+        if partitions is None:
+            self._consumer = _KC(
+                topic,
+                bootstrap_servers=brokers.split(","),
+                group_id=group,
+                enable_auto_commit=False,
+                auto_offset_reset="earliest",
+            )
+        else:
+            from kafka import TopicPartition  # type: ignore
+
+            self._consumer = _KC(
+                bootstrap_servers=brokers.split(","),
+                group_id=group,
+                enable_auto_commit=False,
+                auto_offset_reset="earliest",
+            )
+            self._consumer.assign(
+                [TopicPartition(topic, p) for p in partitions])
 
     def poll(self, max_messages: int = 8192):
         """One per-partition batch per call. The broker poll may return
@@ -94,6 +119,12 @@ class KafkaConsumerAdapter:
         advanced its fetch positions past them)."""
         if self._pending:
             return self._pending.popleft()
+        if not self._seeked:
+            from kafka import TopicPartition  # type: ignore
+
+            for p, off in self.positions.items():
+                self._consumer.seek(TopicPartition(self.topic, p), off)
+            self._seeked = True
         records = self._consumer.poll(timeout_ms=200, max_records=max_messages)
         for tp, msgs in records.items():
             if not msgs:
@@ -109,6 +140,12 @@ class KafkaConsumerAdapter:
             batch.last_offset = msgs[-1].offset
             self._pending.append(batch)
         return self._pending.popleft() if self._pending else None
+
+    def close(self) -> None:
+        """Release the broker connection (the flowmesh member drops and
+        rebuilds consumers across rebalances; without this every resync
+        leaks a connection + fetch buffers)."""
+        self._consumer.close()
 
     def commit(self, partition: int, next_offset: int) -> None:
         from kafka import TopicPartition  # type: ignore

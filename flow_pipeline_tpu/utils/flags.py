@@ -28,7 +28,7 @@ KNOWN_FLAGS = frozenset({
     "loglevel", "kafka.topic", "kafka.brokers", "proto.fixedlen",
     # generator / mocker
     "produce.count", "produce.rate", "produce.seed", "produce.profile",
-    "produce.batch", "zipf.keys", "zipf.alpha", "out",
+    "produce.batch", "produce.shard", "zipf.keys", "zipf.alpha", "out",
     # processor
     "processor.backend", "processor.batch", "processor.mesh",
     "processor.fused", "processor.hostassist",
@@ -41,6 +41,9 @@ KNOWN_FLAGS = frozenset({
     "ingest.native_group", "ingest.fused",
     "checkpoint.path", "flush.count", "metrics.addr", "sink", "in",
     "listen.feed", "query.addr", "obs.trace",
+    # flowmesh (mesh/)
+    "mesh.workers", "mesh.role", "mesh.coordinator", "mesh.id",
+    "mesh.listen", "mesh.heartbeat",
     # inserter
     "postgres.dsn", "postgres.pass", "sqlite", "flush.dur",
     # topic admin

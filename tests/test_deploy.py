@@ -71,6 +71,35 @@ class TestCompose:
         assert any("8123" in p
                    for p in doc["services"]["clickhouse"]["ports"])
 
+    def test_mesh_topology_shape(self):
+        """mesh.yml: coordinator + 4 workers + sharded generator over an
+        8-partition topic (2 partitions per worker — a death rebalances
+        real sets); every worker names the coordinator, its own member
+        id, and explicit-partition Kafka consumption; the mocker
+        produces key-hash sharded."""
+        doc = load("compose/mesh.yml")
+        services = doc["services"]
+        workers = [n for n in services if n.startswith("worker-")]
+        assert len(workers) == 4
+        assert "coordinator" in services and "mocker" in services
+        init = services["kafka-init"]["command"]
+        assert "--topic flows" in init and "--partitions 8" in init
+        coord = services["coordinator"]["command"]
+        assert "-mesh.role coordinator" in coord
+        assert "-bus.partitions 8" in coord
+        assert "-query.addr" in coord  # the mesh-aware /topk surface
+        for w in workers:
+            cmd = services[w]["command"]
+            assert "-mesh.role member" in cmd
+            assert f"-mesh.id {w}" in cmd
+            assert "-mesh.coordinator http://coordinator:8090" in cmd
+            assert "-sketch.backend host" in cmd  # fused host dataplane
+        mock = services["mocker"]["command"]
+        assert "-produce.shard" in mock and "-bus.partitions 8" in mock
+        for name, svc in services.items():
+            if name != "kafka-init":
+                assert svc.get("restart") == "always", name
+
     def test_fixedlen_on_clickhouse_paths(self):
         for path in ("compose/clickhouse-mock.yml",
                      "compose/clickhouse-collect.yml"):
@@ -205,6 +234,28 @@ class TestGrafana:
         assert "flow_commit_watermark_seconds" in exprs
         assert "flow_sink_commit_latency_seconds_bucket" in exprs
 
+    def test_pipeline_dashboard_mesh_panels(self):
+        """Round-12 flowmesh panels: per-worker ingest rate (by the
+        member label), merge wall time off the aggregable histogram
+        buckets, and rebalance events by reason next to the live
+        membership/epoch gauges."""
+        with open(os.path.join(DEPLOY, "grafana", "dashboards",
+                               "pipeline.json")) as f:
+            dash = json.load(f)
+        panels = {p["title"]: p for p in dash["panels"]}
+        ingest = panels["Mesh per-worker ingest rate (flows/s)"]
+        assert "mesh_member_flows_total" in ingest["targets"][0]["expr"]
+        assert ingest["targets"][0]["legendFormat"] == "{{member}}"
+        merge = panels["Mesh window merge wall time (s)"]
+        exprs = " ".join(t["expr"] for t in merge["targets"])
+        assert "mesh_merge_seconds_bucket" in exprs
+        assert "by (le)" in exprs
+        assert "mesh_windows_merged_total" in exprs
+        reb = panels["Mesh rebalance events"]
+        exprs = " ".join(t["expr"] for t in reb["targets"])
+        assert "mesh_rebalance_total" in exprs
+        assert "mesh_members" in exprs and "mesh_epoch" in exprs
+
     def test_traffic_dashboards_have_four_topn_tables(self):
         # reference viz.json serves four top-N tables: src/dst IPs AND
         # src/dst ports — both dashboard variants must carry all four
@@ -280,11 +331,14 @@ class TestDashboardHonesty:
 
         from flow_pipeline_tpu.engine import Supervisor
 
+        from flow_pipeline_tpu.mesh import MeshCoordinator
+
         reg = MetricsRegistry()
         CollectorServer(None, CollectorConfig(netflow_addr=None,
                                               sflow_addr=None), registry=reg)
         StreamWorker(consumer=None, models={})  # registers on the global
         Supervisor(lambda: None)  # worker_restarts_total
+        MeshCoordinator([], 2)  # mesh_* families (eager registration)
         names = set(reg._metrics) | set(REGISTRY._metrics)
         for text in (reg.render(), REGISTRY.render()):
             for line in text.splitlines():
