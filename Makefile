@@ -4,7 +4,8 @@
 # additionally builds the native host-path library and runs the suite.
 
 .PHONY: all native test bench proto clean services-test lint native-san \
-	hostsketch-parity fused-parity fused-parity-traced mesh-parity
+	hostsketch-parity fused-parity fused-parity-traced mesh-parity \
+	mesh-parity-traced
 
 all: native
 
@@ -59,6 +60,15 @@ fused-parity:
 # round-trip suite (docs/ARCHITECTURE.md "flowmesh" states the contract).
 mesh-parity:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_mesh.py -v
+
+# The mesh parity + churn suite (and the meshscope observability suite)
+# with the flowtrace recorder at full retention — the mesh-layer mirror
+# of fused-parity-traced: span propagation, lineage accounting, and the
+# coordinator protocol spans must be purely observational, so merged
+# output stays bit-exact with instrumentation maximally on.
+mesh-parity-traced:
+	FLOWTPU_TRACE=always JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_mesh.py tests/test_meshscope.py -v
 
 # The same parity suite with the flowtrace recorder at full retention
 # (-obs.trace=always via the env fallback): span recording and the

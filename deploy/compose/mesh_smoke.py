@@ -41,6 +41,13 @@ def main() -> int:
     seen_members = 0
     while time.time() < deadline:
         try:
+            # liveness FIRST, from the dedicated endpoint (the compose
+            # healthchecks probe the same one) — a coordinator that
+            # serves /state but fails /healthz is a bug, not progress
+            hz = get(BASE + "/healthz")
+            if hz.get("ok") is not True:
+                time.sleep(5)
+                continue
             state = get(BASE + "/state")
             merged = merged_windows()
         except OSError:
@@ -56,9 +63,24 @@ def main() -> int:
               flush=True)
         if len(live) >= 4 and len(owned) == state["partitions"] \
                 and merged > 0:
-            topk = get(QUERY + "/topk?model=top_talkers&k=5")
+            try:
+                topk = get(QUERY + "/topk?model=top_talkers&k=5")
+                # meshscope: every merged window must be explainable
+                # after the fact — at least one merged lineage record
+                # naming its contributing members
+                lineage = get(BASE + "/debug/lineage")
+            except OSError:
+                # a coordinator blip right here must retry inside the
+                # deadline, not crash the smoke with a traceback
+                time.sleep(5)
+                continue
             print("mesh /topk rows:", len(topk["rows"]), flush=True)
-            if topk["rows"]:
+            merged_recs = [r for r in lineage
+                           if r.get("status") == "merged"
+                           and r.get("members")]
+            print(f"mesh lineage: {len(lineage)} records "
+                  f"({len(merged_recs)} merged)", flush=True)
+            if topk["rows"] and merged_recs:
                 print("MESH SMOKE OK", flush=True)
                 return 0
         time.sleep(5)

@@ -487,8 +487,15 @@ def _mesh_member_main(vals) -> int:
         shost, sport = _host_port(vals["mesh.listen"], 8091,
                                   default_host="0.0.0.0")
         state_url = f"http://{socket.gethostname()}:{sport}/meshstate"
+    trace_url = None
+    if vals["metrics.addr"]:
+        # meshscope: advertise this member's flight recorder so the
+        # coordinator's /debug/trace can aggregate one clock-aligned
+        # mesh-wide trace (the metrics server owns /debug/trace)
+        _, mport = _host_port(vals["metrics.addr"], 8081)
+        trace_url = f"http://{socket.gethostname()}:{mport}/debug/trace"
     coord = RemoteCoordinator(vals["mesh.coordinator"],
-                              state_url=state_url)
+                              state_url=state_url, trace_url=trace_url)
     member = MeshMember(
         member_id, coord, consumer_factory,
         model_factory=lambda: _build_models(vals),
@@ -497,7 +504,7 @@ def _mesh_member_main(vals) -> int:
         # progress carries every 64 batches: bounds a successor's replay
         # (and the promotable carry) mid-window — windows are minutes of
         # stream, a rebalance should not replay minutes of flows
-        submit_every=64, sync_interval=1.0)
+        submit_every=64, sync_interval=1.0, trace_url=trace_url)
     state = None
     if sport is not None:
         state = MemberStateServer(member, sport, shost).start()
@@ -788,6 +795,78 @@ def pipeline_main(argv=None) -> int:
     return 0
 
 
+def _fmt_lineage(rec: dict) -> str:
+    """One human line per window + one per contribution — the after-
+    the-fact answer to "which shard stalled / built / missed this
+    window"."""
+    carries = ",".join(rec.get("carries_promoted") or []) or "-"
+    members = ",".join(rec.get("members") or
+                       sorted({c["member"] for c in rec["contributions"]
+                               if c.get("member")})) or "-"
+    head = (f"{rec['model']} @ {rec['slot']} [{rec['status']}] "
+            f"members={members} contribs={len(rec['contributions'])} "
+            f"carries={carries} late={rec.get('late', 0)}")
+    if rec["status"] == "merged":
+        head += (f" rows={rec.get('rows')} "
+                 f"barrier_wait={rec.get('barrier_wait_s')}s "
+                 f"merge={rec.get('merge_wall_s')}s")
+    lines = [head]
+    for c in rec["contributions"]:
+        ranges = c.get("ranges")
+        rng = " ".join(f"{p}:[{r[0]},{r[1]})"
+                       for p, r in sorted((ranges or {}).items(),
+                                          key=lambda kv: int(kv[0])))
+        lag = ""
+        if c.get("accepted") is not None and c.get("submitted") is not None:
+            lag = f" xfer={c['accepted'] - c['submitted']:+.3f}s"
+        lines.append(f"    {c.get('member') or '?'} sub={c.get('sub')} "
+                     f"{c['kind']} chunk={c.get('chunk')} "
+                     f"{rng or 'ranges=-'}{lag}")
+    return "\n".join(lines)
+
+
+def lineage_main(argv=None) -> int:
+    """meshscope lineage query: ask a mesh coordinator's /debug/lineage
+    ledger which members built each merged window, from which offset
+    ranges, through which path (closed submission / promoted carry /
+    late partial), and how long the barrier and merge took."""
+    import json as _json
+    import urllib.parse
+    import urllib.request
+
+    fs = FlagSet("lineage")
+    fs.string("loglevel", "info", "Log level")
+    fs.string("mesh.coordinator", "http://127.0.0.1:8090",
+              "Mesh coordinator base URL to query")
+    fs.string("lineage.model", "", "Restrict to one model (empty = all)")
+    fs.integer("lineage.slot", -1, "Restrict to one window slot "
+                                   "(epoch seconds; -1 = all)")
+    fs.boolean("lineage.raw", False, "Print raw JSON records instead "
+                                     "of the summary lines")
+    vals = fs.parse(argv if argv is not None else sys.argv[2:])
+    set_level(vals["loglevel"])
+    params = {}
+    if vals["lineage.model"]:
+        params["model"] = vals["lineage.model"]
+    if vals["lineage.slot"] >= 0:
+        params["slot"] = str(vals["lineage.slot"])
+    url = vals["mesh.coordinator"].rstrip("/") + "/debug/lineage"
+    if params:
+        url += "?" + urllib.parse.urlencode(params)
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        records = _json.loads(resp.read().decode())
+    if vals["lineage.raw"]:
+        print(_json.dumps(records, indent=2, default=str))
+        return 0
+    if not records:
+        print("no lineage records (nothing merged or pending "
+              "in the retention window)")
+        return 0
+    for rec in records:
+        print(_fmt_lineage(rec))
+    return 0
+
+
 def collector_main(argv=None) -> int:
     """UDP flow collector (in-framework GoFlow replacement): listens for
     sFlow on 6343 and NetFlow/IPFIX on 2055, produces FlowMessages."""
@@ -859,6 +938,7 @@ _COMMANDS = {
     "inserter": inserter_main,
     "pipeline": pipeline_main,
     "collector": collector_main,
+    "lineage": lineage_main,
 }
 
 
@@ -866,7 +946,8 @@ def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if not argv or argv[0] in ("-h", "-help", "--help"):
         print("usage: flow_pipeline_tpu.cli <mocker|processor|inserter|"
-              "pipeline|collector> [-flags]\nRun '<cmd> -help' for flags.")
+              "pipeline|collector|lineage> [-flags]\n"
+              "Run '<cmd> -help' for flags.")
         return 0 if argv else 2
     cmd = _COMMANDS.get(argv[0])
     if cmd is None:
@@ -897,6 +978,10 @@ def pipeline_entry() -> None:
 
 def collector_entry() -> None:
     sys.exit(main(["collector"] + sys.argv[1:]))
+
+
+def lineage_entry() -> None:
+    sys.exit(main(["lineage"] + sys.argv[1:]))
 
 
 if __name__ == "__main__":

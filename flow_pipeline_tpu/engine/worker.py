@@ -90,6 +90,11 @@ class WorkerConfig:
     # pre-aggregated tables are the serving path; raw rows are for
     # drill-down/audit and cost one row per flow.
     archive_raw: bool = False
+    # The role this worker's flow_build_info identity gauge publishes
+    # under. A mesh member's INNER worker must identify as "member" —
+    # publishing a second role="worker" series next to the member's
+    # would give one process two identities (MeshMember rewrites this).
+    build_role: str = "worker"
 
 
 class StreamWorker:
@@ -251,6 +256,13 @@ class StreamWorker:
         REGISTRY.counter(*PHASE_COUNTERS["host_fused"])
         REGISTRY.counter(*ROWS_COUNTER)
         REGISTRY.counter(*GROUPS_COUNTER)
+        # runtime identity: what this worker ACTUALLY runs (native
+        # capability set, trace mode, sketch backend) — dashboards and
+        # bench artifacts join against it instead of trusting flags
+        from ..obs.buildinfo import publish_build_info
+
+        publish_build_info(config.build_role,
+                           sketch_backend=config.sketch_backend)
         # flowlint: unguarded -- written by whichever single thread runs _write_rows (worker inline, or the one flusher thread)
         self._commit_watermark = 0.0
         # flowlint: unguarded -- worker thread only (set per _process step, read when queueing flush jobs)

@@ -14,6 +14,7 @@ from .coordinator import MeshCoordinator, ModelSpec, spec_from_models
 from .member import MeshMember
 from .runtime import (InProcessMesh, SHARD_KEY_COLS, produce_sharded,
                       shard_ids)
+from .scope import ClockSync, TraceLane, aggregate_traces, estimate_offset
 from .server import (MemberStateServer, MeshCoordinatorServer,
                      RemoteCoordinator)
 
@@ -21,4 +22,5 @@ __all__ = [
     "MeshCoordinator", "MeshMember", "ModelSpec", "spec_from_models",
     "InProcessMesh", "SHARD_KEY_COLS", "produce_sharded", "shard_ids",
     "MeshCoordinatorServer", "RemoteCoordinator", "MemberStateServer",
+    "ClockSync", "TraceLane", "aggregate_traces", "estimate_offset",
 ]

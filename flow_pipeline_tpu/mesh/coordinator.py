@@ -50,6 +50,8 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
 from ..obs import REGISTRY, get_logger
+from ..obs.buildinfo import publish_build_info
+from ..obs.trace import TRACER
 from . import codec
 from . import merge as merge_ops
 
@@ -61,11 +63,30 @@ MERGE_SECONDS_BUCKETS = (
     0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+# Buckets for the meshscope SLO latencies (seconds): barrier waits and
+# submit->merge intervals span "every shard already past the close"
+# (ms) to "one shard stalled most of a window" (minutes).
+BARRIER_SECONDS_BUCKETS = (
+    0.01, 0.05, 0.25, 1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 3600.0,
+)
+
+# Buckets for rebalance durations (trigger -> every partition owned
+# again): in-process handoffs are ms; cross-process ones ride the
+# heartbeat cadence.
+REBALANCE_SECONDS_BUCKETS = (
+    0.01, 0.05, 0.25, 1.0, 2.5, 5.0, 15.0, 60.0, 300.0,
+)
+
 # Merged-rows ledger retention, per model: the newest slots kept for
 # queries/tests/debugging. The SINKS are the durable home of merged
 # output; an unbounded ledger on an endless stream is a slow OOM
 # (days of 5-minute windows accumulate every historical row set).
 MERGED_LEDGER_SLOTS = 16
+
+# Lineage-ledger retention, per model (same discipline as the merged
+# ledger, looser bound: a lineage record is a few hundred bytes of
+# metadata, not row sets, so more history fits the same budget).
+LINEAGE_SLOTS = 64
 
 # Metric name/help specs live here once; the deploy honesty test
 # resolves the Grafana mesh panels against a constructed coordinator.
@@ -87,6 +108,34 @@ MESH_METRICS = {
     "late": ("mesh_late_contribution_total",
              "contributions that arrived after their window merged "
              "(label: model)"),
+    # meshscope SLO families (r13): mesh-wide freshness + merge-path
+    # latency decomposition
+    "commit_wm": ("mesh_commit_watermark_seconds",
+                  "mesh-wide event-time watermark: min over live "
+                  "members' reported watermarks"),
+    "member_wm": ("mesh_member_watermark_seconds",
+                  "per-member event-time watermark (label: member)"),
+    "wm_skew": ("mesh_watermark_skew_seconds",
+                "per-member watermark lag behind the mesh leader "
+                "(label: member) — the stalled-shard signal"),
+    "barrier_s": ("mesh_barrier_wait_seconds",
+                  "window first-contribution -> barrier-release wait"),
+    "sub2merge_s": ("mesh_submit_to_merge_seconds",
+                    "contribution accept -> network-wide merge latency"),
+    "rebalance_s": ("mesh_rebalance_duration_seconds",
+                    "rebalance trigger -> every partition owned again "
+                    "(label: reason)"),
+}
+
+# Which MESH_METRICS keys register as what (everything else: counter).
+_MESH_GAUGES = frozenset(
+    {"members", "epoch", "partitions", "commit_wm", "member_wm",
+     "wm_skew"})
+_MESH_HISTOGRAMS = {
+    "merge_s": MERGE_SECONDS_BUCKETS,
+    "barrier_s": BARRIER_SECONDS_BUCKETS,
+    "sub2merge_s": BARRIER_SECONDS_BUCKETS,
+    "rebalance_s": REBALANCE_SECONDS_BUCKETS,
 }
 
 
@@ -128,13 +177,24 @@ def spec_from_models(models: dict) -> tuple[ModelSpec, ...]:
 
 
 class _Member:
-    __slots__ = ("alive", "last_hb", "owned", "provider")
+    __slots__ = ("alive", "last_hb", "owned", "provider", "trace_url",
+                 "clock_offset", "clock_rtt", "watermark")
 
-    def __init__(self, provider=None):
+    def __init__(self, provider=None, trace_url=None):
         self.alive = True
         self.last_hb = 0.0
         self.owned: set[int] = set()
         self.provider = provider  # callable(model)->payload | state URL
+        # meshscope: the member's /debug/trace URL (HTTP mesh; None
+        # in-process — everything already records into one TRACER)
+        self.trace_url = trace_url
+        # member_clock - coordinator_clock, heartbeat-estimated (NTP
+        # midpoint, min-RTT sample; mesh/scope.py); None until the
+        # member's first clock report
+        self.clock_offset: Optional[float] = None
+        self.clock_rtt: float = 0.0
+        # newest event-time watermark this member reported
+        self.watermark: int = 0
 
 
 class MeshCoordinator:
@@ -171,26 +231,43 @@ class MeshCoordinator:
         self._merged_keys: set[tuple[str, int]] = set()  # guarded-by: _lock
         # (model, slot) -> [rows emitted] (late wagg partials append)
         self.merged: dict[tuple[str, int], list] = {}  # guarded-by: _merge_lock
+        # meshscope lineage ledger: per (model, slot), who contributed
+        # what and when. Pending records ride the merge barrier next to
+        # _pending; merged records move to _lineage_done (retention-
+        # bounded like the merged-rows ledger — LINEAGE_SLOTS). Late
+        # annotations that land in the pop->seal gap (the merge runs
+        # lock-free between them) buffer in _lineage_orphans until the
+        # seal drains them.
+        self._lineage_pending: dict[tuple[str, int], dict] = {}  # guarded-by: _lock
+        self._lineage_done: dict[tuple[str, int], dict] = {}  # guarded-by: _lock
+        self._lineage_orphans: dict[tuple[str, int], list] = {}  # guarded-by: _lock
+        # rebalance-duration timeline: (wall t0, reason) of the oldest
+        # unsettled rebalance; cleared when every live member owns
+        # exactly its target set again
+        self._rebalance_start: Optional[tuple[float, str]] = None  # guarded-by: _lock
         # eager registration: /metrics carries every mesh family (as
         # zeros) the moment a coordinator exists — the dashboard honesty
         # test resolves the mesh panels against this surface
-        self._m = {k: (REGISTRY.histogram(*v, buckets=MERGE_SECONDS_BUCKETS)
-                       if k == "merge_s"
-                       else REGISTRY.gauge(*v) if k in
-                       ("members", "epoch", "partitions")
+        self._m = {k: (REGISTRY.histogram(*v,
+                                          buckets=_MESH_HISTOGRAMS[k])
+                       if k in _MESH_HISTOGRAMS
+                       else REGISTRY.gauge(*v) if k in _MESH_GAUGES
                        else REGISTRY.counter(*v))
                    for k, v in MESH_METRICS.items()}
         self._m["partitions"].set(self.n_partitions)
         self._m["members"].set(0)
         self._m["epoch"].set(0)
+        publish_build_info("coordinator")
 
     # ---- membership -------------------------------------------------------
 
-    def join(self, member_id: str, provider=None) -> dict:
+    def join(self, member_id: str, provider=None,
+             trace_url: Optional[str] = None) -> dict:
         """Register (or re-register) a member. Returns {"epoch": e}.
         A rejoin under an id that still owns partitions is treated as
         death-then-join: the old incarnation's carry is promoted and its
         partitions released (it crashed and came back before expiry)."""
+        fenced = False
         with self._lock:
             old = self._members.get(member_id)
             fold = []
@@ -200,12 +277,19 @@ class MeshCoordinator:
                 # ready list must reach _run_merges or those windows
                 # are popped and silently lost
                 fold = self._fence_locked(member_id, "rejoin")
-            self._members[member_id] = m = _Member(provider)
+                fenced = True
+            self._members[member_id] = m = _Member(provider, trace_url)
             m.last_hb = self._time()
             self._rebalance_locked("join")
             epoch = self.epoch
         if fold:
             self._run_merges(fold)
+        if fenced:
+            # crash-restart before expiry: the old incarnation died
+            # without a dump — leave the flight-recorder breadcrumb
+            # the post-mortem needs (same contract as a worker error)
+            self._dump_flight(f"member {member_id} rejoined while "
+                              "fenced-alive (crash-restart)")
         return {"epoch": epoch}
 
     def leave(self, member_id: str) -> None:
@@ -226,6 +310,11 @@ class MeshCoordinator:
                 m.alive = False
                 self._carry.pop(member_id, None)
                 self._rebalance_locked("leave")
+                # same stale-series discipline as the fence path: a
+                # departed laggard's frozen skew must not keep paging
+                self._m["member_wm"].remove(member=member_id)
+                self._m["wm_skew"].remove(member=member_id)
+                self._publish_watermarks_locked()
         if fold:
             self._run_merges(fold)
 
@@ -233,11 +322,16 @@ class MeshCoordinator:
         """Declare a member dead NOW (admin surface; the heartbeat
         timeout calls the same path). Its carry is promoted, partitions
         released, and any later submission from it rejected."""
-        fold = None
+        fold = []
+        fenced = False
         with self._lock:
+            m = self._members.get(member_id)
+            fenced = m is not None and (m.alive or bool(m.owned))
             fold = self._fence_locked(member_id, "death")
         if fold:
             self._run_merges(fold)
+        if fenced:
+            self._dump_flight(f"member {member_id} fenced")
 
     def expire(self, now: Optional[float] = None) -> list[str]:
         """Fence every member whose heartbeat lapsed; returns their ids."""
@@ -251,6 +345,9 @@ class MeshCoordinator:
                     dead.append(mid)
         if fold:
             self._run_merges(fold)
+        if dead:
+            self._dump_flight(
+                f"member(s) {', '.join(dead)} expired (heartbeat)")
         return dead
 
     def _fence_locked(self, member_id: str, reason: str):
@@ -259,13 +356,31 @@ class MeshCoordinator:
         m = self._members.get(member_id)
         if m is None:
             return []
+        now = time.time()
         m.alive = False
         self._released |= m.owned  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
         m.owned = set()
         carry = self._carry.pop(member_id, None)
+        TRACER.record("mesh_fence", now, time.time(), member=member_id,
+                      reason=reason, promoted=bool(carry))
         if carry:
-            self._fold_windows_locked(carry)
+            span = carry.get("span") or {}
+            windows = carry.get("windows", {})
+            self._fold_windows_locked(
+                windows, member=member_id, span=span,
+                ranges=carry.get("ranges"), accepted=now,
+                kind="carry-promoted")
+            TRACER.record("mesh_carry_promotion", now, time.time(),
+                          member=member_id, sub=span.get("sub"),
+                          slots=sorted(int(s) for s in windows))
         self._rebalance_locked(reason)
+        # the mesh watermark/skew must re-derive over the LIVE set —
+        # a dead laggard no longer holds the min down — and the dead
+        # member's own series must go away, or its frozen last skew
+        # reads as an eternally stalled shard on the dashboards
+        self._m["member_wm"].remove(member=member_id)
+        self._m["wm_skew"].remove(member=member_id)
+        self._publish_watermarks_locked()
         log.warning("mesh member %s fenced (%s); epoch now %d",
                     member_id, reason, self.epoch)
         return self._pop_ready_locked()
@@ -280,10 +395,50 @@ class MeshCoordinator:
         self._m["rebalance"].inc(reason=reason)
         self._m["members"].set(len(live))
         self._m["epoch"].set(self.epoch)
+        # rebalance-duration timeline: the clock starts at the FIRST
+        # unsettled trigger and keeps its original reason if another
+        # rebalance lands mid-flight (the duration then measures the
+        # whole disturbance, which is what an operator pages on)
+        if self._rebalance_start is None:
+            self._rebalance_start = (time.time(), reason)  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        self._check_rebalance_settled_locked()
+
+    def _check_rebalance_settled_locked(self) -> None:
+        """Close the rebalance timeline once every live member owns
+        exactly its target set (and every partition is owned)."""
+        if self._rebalance_start is None:
+            return
+        live = [(mid, m) for mid, m in self._members.items() if m.alive]
+        if not live:
+            return
+        owned = sum(len(m.owned) for _, m in live)
+        if owned != self.n_partitions:
+            return
+        if any(m.owned != self._targets.get(mid, set())
+               for mid, m in live):
+            return
+        t0, reason = self._rebalance_start
+        self._rebalance_start = None  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        now = time.time()
+        self._m["rebalance_s"].observe(now - t0, reason=reason)
+        TRACER.record("mesh_rebalance", t0, now, reason=reason,
+                      epoch=self.epoch)
+
+    def _dump_flight(self, why: str) -> None:
+        """Flight-recorder dump on a coordinator-side death/zombie event
+        (fence, expiry, crash-restart rejoin, rejected submission): the
+        member that died cannot leave its own breadcrumb, so the
+        coordinator's ring — which holds the protocol spans around the
+        event, including the rejected submission's span context — is
+        the post-mortem. Never raises; no-op when tracing is off."""
+        path = TRACER.dump_on_error("coordinator")
+        if path:
+            log.warning("meshscope: %s; flight recorder dumped to %s",
+                        why, path)
 
     # ---- heartbeat / assignment ------------------------------------------
 
-    def sync(self, member_id: str) -> dict:
+    def sync(self, member_id: str, clock: Optional[dict] = None) -> dict:
         """Heartbeat + assignment poll. Actions:
 
         - ``run``    : keep going; ``assign`` carries {partition: resume
@@ -294,30 +449,43 @@ class MeshCoordinator:
                        owners — idle and sync again
         - ``rejoin`` : unknown or fenced — abandon un-submitted state
                        (the successor replays it) and join() fresh
-        """
+
+        ``clock`` is the member's heartbeat-estimated clock report
+        ({"offset": coordinator-member s, "rtt": s}, mesh/scope.py);
+        every response carries ``now`` (this coordinator's wall clock)
+        so the member can keep estimating. Both are what lets
+        ``/debug/trace`` emit ONE clock-aligned mesh trace."""
         self.expire()
+        now_wall = time.time()
         with self._lock:
             m = self._members.get(member_id)
             if m is None or not m.alive:
                 return {"epoch": self.epoch, "action": "rejoin",
-                        "assign": None}
+                        "assign": None, "now": now_wall}
             m.last_hb = self._time()
+            if clock:
+                # the member measured coordinator_clock - member_clock;
+                # the aggregator wants member - coordinator
+                m.clock_offset = -float(clock.get("offset", 0.0))
+                m.clock_rtt = float(clock.get("rtt", 0.0))
             target = self._targets.get(member_id, set())
             if m.owned:
                 if m.owned == target:
                     return {"epoch": self.epoch, "action": "run",
-                            "assign": None}
+                            "assign": None, "now": now_wall}
                 return {"epoch": self.epoch, "action": "resync",
-                        "assign": None}
+                        "assign": None, "now": now_wall}
             if target and not (target <= self._released):
                 return {"epoch": self.epoch, "action": "wait",
-                        "assign": None}
+                        "assign": None, "now": now_wall}
             # acquire the full target set atomically (possibly empty:
             # more members than partitions -> this member idles)
             m.owned = set(target)
             self._released -= target
             assign = {p: self._covered[p] for p in sorted(target)}
-            return {"epoch": self.epoch, "action": "run", "assign": assign}
+            self._check_rebalance_settled_locked()
+            return {"epoch": self.epoch, "action": "run",
+                    "assign": assign, "now": now_wall}
 
     # ---- submissions ------------------------------------------------------
 
@@ -326,53 +494,88 @@ class MeshCoordinator:
         Returns {"ok": True} or {"ok": False, "reason": ...}."""
         if isinstance(payload, (bytes, bytearray)):
             payload = codec.decode(bytes(payload))
+        t_recv = time.time()
+        span = payload.get("span") or {}
         fold = []
         accepted = False
+        reject_reason = None
         with self._lock:
             m = self._members.get(member_id)
             if m is None or not m.alive:
                 self._m["rejected"].inc(reason="fenced")
-                return {"ok": False, "reason": "fenced"}
-            m.last_hb = self._time()
-            ranges = payload.get("ranges", {})
-            for p, rng in ranges.items():
-                p = int(p)
-                if p not in m.owned or int(rng[0]) != self._covered[p] \
-                        or int(rng[1]) < int(rng[0]):
-                    # frontier mismatch: protocol violation or a zombie
-                    # with stale state — fence, force a clean rejoin
-                    self._m["rejected"].inc(reason="range")
-                    fold = self._fence_locked(member_id, "death")
-                    break
+                reject_reason = "fenced"
             else:
-                fold = self._accept_locked(m, member_id, payload)
-                accepted = True
+                m.last_hb = self._time()
+                ranges = payload.get("ranges", {})
+                for p, rng in ranges.items():
+                    p = int(p)
+                    if p not in m.owned or int(rng[0]) != self._covered[p] \
+                            or int(rng[1]) < int(rng[0]):
+                        # frontier mismatch: protocol violation or a
+                        # zombie with stale state — fence, force a
+                        # clean rejoin
+                        self._m["rejected"].inc(reason="range")
+                        reject_reason = "range"
+                        fold = self._fence_locked(member_id, "death")
+                        break
+                else:
+                    fold = self._accept_locked(m, member_id, payload,
+                                               t_recv, span)
+                    accepted = True
         if fold:
             self._run_merges(fold)
         if accepted:
+            TRACER.record("mesh_submit_accept", t_recv, time.time(),
+                          member=member_id, sub=span.get("sub"),
+                          chunk=span.get("chunk"),
+                          windows=len(payload.get("closed", {})))
             return {"ok": True}
-        return {"ok": False, "reason": "fenced"}
+        # the rejected submission's span context goes INTO the ring
+        # before the dump: a zombie rejection is exactly the event the
+        # crash-restart post-mortem needs to see, with the member's
+        # own submission id / chunk / wall-clock anchor attached
+        TRACER.record("mesh_submit_reject", t_recv, time.time(),
+                      member=member_id, reason=reject_reason,
+                      sub=span.get("sub"), chunk=span.get("chunk"),
+                      sent=span.get("sent"))
+        self._dump_flight(
+            f"rejected submission from {member_id} ({reject_reason})")
+        # the honest cause: "range" (frontier mismatch — a protocol
+        # bug to debug) vs "fenced" (zombie — the expected churn path);
+        # the member's rejection log prints it
+        return {"ok": False, "reason": reject_reason}
 
-    def _accept_locked(self, m: _Member, member_id: str, payload: dict):
+    def _accept_locked(self, m: _Member, member_id: str, payload: dict,
+                       t_recv: float, span: dict):
         for p, rng in payload.get("ranges", {}).items():
             self._covered[int(p)] = int(rng[1])  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
         wm = int(payload.get("watermark", 0))
         for p in m.owned:
             if wm > self._wm[p]:
                 self._wm[p] = wm  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        if wm > m.watermark:
+            m.watermark = wm
+        self._publish_watermarks_locked()
         flows = int(payload.get("flows", 0))
         if flows:
             self._m["flows"].inc(flows, member=member_id)
         self._m["submit"].inc()
-        self._fold_windows_locked({"windows": payload.get("closed", {})})
+        ranges = {int(p): [int(r[0]), int(r[1])]
+                  for p, r in payload.get("ranges", {}).items()}
+        self._fold_windows_locked(
+            payload.get("closed", {}), member=member_id, span=span,
+            ranges=ranges, accepted=t_recv, kind="closed")
         open_windows = payload.get("open", {})
         if payload.get("release") or payload.get("final"):
             # the member is resetting (resync) or done: its open state
             # must not sit in a carry nobody will promote
-            self._fold_windows_locked({"windows": open_windows})
+            self._fold_windows_locked(
+                open_windows, member=member_id, span=span,
+                ranges=ranges, accepted=t_recv, kind="final-open")
             self._carry.pop(member_id, None)
         else:
-            self._carry[member_id] = {"windows": open_windows}  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+            self._carry[member_id] = {"windows": open_windows,  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+                                      "span": span, "ranges": ranges}
         if payload.get("final"):
             for p in m.owned:
                 self._final[p] = True  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
@@ -381,25 +584,112 @@ class MeshCoordinator:
             m.owned = set()
         return self._pop_ready_locked()
 
-    def _fold_windows_locked(self, contribution: dict) -> None:
+    def _publish_watermarks_locked(self) -> None:
+        """Mesh-wide freshness gauges: the commit watermark is the MIN
+        over live members' reported watermarks (the merge barrier can
+        never be past the slowest shard), and each member's skew is its
+        lag behind the mesh leader — the stalled-shard pager signal.
+        Members that have never reported (watermark 0: just joined, no
+        submission yet) are EXCLUDED — event time is epoch seconds, so
+        folding a 0 into the min would crater the watermark by ~56
+        years and report the newcomer's skew as the full epoch."""
+        wms = {mid: mm.watermark for mid, mm in self._members.items()
+               if mm.alive and mm.watermark > 0}
+        if not wms:
+            return
+        hi = max(wms.values())
+        self._m["commit_wm"].set(min(wms.values()))
+        for mid, w in wms.items():
+            self._m["member_wm"].set(w, member=mid)
+            self._m["wm_skew"].set(hi - w, member=mid)
+
+    def _fold_windows_locked(self, windows: dict, member=None,
+                             span=None, ranges=None, accepted=None,
+                             kind: str = "closed") -> None:
         """Fold {slot: {model: payload}} into the pending barrier. A
         contribution for an already-merged window is LATE: exact wagg
         partials are emitted as additional rows (the single-worker late
         semantics — merging sinks combine them); late sketch state has
-        no exact merge target left and is dropped, counted."""
-        for slot, models in contribution.get("windows", {}).items():
+        no exact merge target left and is dropped, counted.
+
+        The keyword context feeds the meshscope lineage ledger: every
+        pending window accumulates WHO contributed (member, submission
+        id, offset ranges, member send anchor vs coordinator accept
+        wall) and HOW (a closed window, a promoted carry, a late
+        partial) — the record `/debug/lineage` answers from."""
+        span = span or {}
+        for slot, models in windows.items():
             slot = int(slot)
             for name, payload in models.items():
                 if name not in self._by_name:
                     continue
                 key = (name, slot)
-                if key in self._merged_keys:
+                late = key in self._merged_keys
+                if late:
                     self._m["late"].inc(model=name)
-                    if payload.get("kind") == "wagg":
-                        self._pending.setdefault(key, []).append(payload)
-                        self._merged_keys.discard(key)  # re-merge partial
-                    continue
-                self._pending.setdefault(key, []).append(payload)
+                    if payload.get("kind") != "wagg":
+                        # dropped — but the lineage of the MERGED window
+                        # must still show the late arrival
+                        self._lineage_note_late_locked(key, member, span)
+                        continue
+                    self._pending.setdefault(key, []).append(payload)
+                    self._merged_keys.discard(key)  # re-merge partial
+                else:
+                    self._pending.setdefault(key, []).append(payload)
+                rec = self._lineage_pending.get(key)
+                if rec is None:
+                    rec = self._lineage_pending[key] = {  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+                        "model": name, "slot": slot, "status": "pending",
+                        "contributions": [], "carries_promoted": [],
+                        "late": 0,
+                        "first_contribution": accepted or time.time(),
+                    }
+                    if late:
+                        # the record is a RE-opening of a merged window
+                        # — mark it so the seal treats it as a re-merge
+                        # even when the prior lineage record was
+                        # retention-evicted (_merged_keys outlives
+                        # _lineage_done by design)
+                        rec["late_reopen"] = True
+                rec["contributions"].append({
+                    "member": member,
+                    "sub": span.get("sub"),
+                    "kind": "late" if late else kind,
+                    "ranges": ranges,
+                    "submitted": span.get("sent"),
+                    "accepted": accepted,
+                    "chunk": span.get("chunk"),
+                })
+                if late:
+                    rec["late"] += 1
+                if kind == "carry-promoted" and \
+                        member not in rec["carries_promoted"]:
+                    rec["carries_promoted"].append(member)
+
+    def _lineage_note_late_locked(self, key, member, span) -> None:
+        """A late contribution whose window's rows are final (dropped
+        sketch state): annotate the merged lineage record if sealed, or
+        buffer the annotation if the window is mid-merge (popped from
+        pending but not yet sealed — the merge itself runs without any
+        lock) so the seal drains it. Caller holds _lock."""
+        entry = {
+            "member": member, "sub": span.get("sub"),
+            "kind": "late-dropped", "ranges": None,
+            "submitted": span.get("sent"),
+            "accepted": time.time(), "chunk": span.get("chunk"),
+        }
+        rec = self._lineage_done.get(key)
+        if rec is not None:
+            rec["late"] += 1
+            rec["contributions"].append(entry)
+            return
+        self._lineage_orphans.setdefault(key, []).append(entry)
+        # bound the buffer: an orphan for a retention-EVICTED window
+        # (not mid-merge) has no seal left to drain it — drop the
+        # oldest slots past a small cap instead of leaking forever
+        while len(self._lineage_orphans) > 64:
+            del self._lineage_orphans[min(self._lineage_orphans,
+                                          key=lambda k: k[1])]
 
     def _pop_ready_locked(self) -> list:
         """Detach every pending window whose barrier condition holds:
@@ -407,13 +697,17 @@ class MeshCoordinator:
         lateness). Marks them merged so later contributions register as
         late."""
         ready = []
+        now = time.time()
         for key in sorted(self._pending):
             name, slot = key
             spec = self._by_name[name]
             limit = slot + spec.window_seconds + spec.allowed_lateness
             if all(self._final[p] or self._wm[p] >= limit
                    for p in range(self.n_partitions)):
-                ready.append((name, slot, self._pending.pop(key)))
+                lin = self._lineage_pending.pop(key, None)
+                if lin is not None:
+                    lin["barrier_released"] = now
+                ready.append((name, slot, self._pending.pop(key), lin))
                 self._merged_keys.add(key)
         return ready
 
@@ -423,12 +717,24 @@ class MeshCoordinator:
         """Fold + extract + emit each detached window. Runs on the
         submitting thread with NO coordinator lock held (merge math and
         sink writes must not serialize member heartbeats)."""
-        for name, slot, payloads in ready:
+        for name, slot, payloads, lin in ready:
             t0 = time.perf_counter()
+            t0_wall = time.time()
             spec = self._by_name[name]
             rows = self._merge_one(spec, slot, payloads)
+            t_merged = time.time()
+            TRACER.record("mesh_merge", t0_wall, t_merged, model=name,
+                          slot=slot, contribs=len(payloads))
             for sink in self.sinks:
                 sink.write(name, rows)
+            t_emitted = time.time()
+            n_rows = self._count_rows(rows)
+            TRACER.record("mesh_emit", t_merged, t_emitted, model=name,
+                          slot=slot, rows=n_rows)
+            # the new contributions BEFORE any re-merge fold-in: the
+            # submit->merge latency must only observe this round's
+            new_contribs = list(lin["contributions"]) if lin else []
+            remerge = False
             with self._merge_lock:
                 self.merged.setdefault((name, slot), []).append(rows)
                 # bounded retention (newest slots win); _merged_keys is
@@ -437,10 +743,107 @@ class MeshCoordinator:
                 slots = sorted(s for n, s in self.merged if n == name)
                 for s in slots[:-MERGED_LEDGER_SLOTS]:
                     del self.merged[(name, s)]
+            if lin is not None:
+                # sealing is cheap metadata work: it runs under _lock so
+                # late contributions can never fall between "marked
+                # merged" and "record sealed" unobserved (the orphan
+                # buffer catches the mid-merge gap)
+                with self._lock:
+                    remerge = self._finish_lineage_locked(
+                        name, slot, lin, t0_wall, t_merged, t_emitted,
+                        n_rows)
             self._m["merge_s"].observe(time.perf_counter() - t0)
             self._m["merged"].inc(model=name)
+            if lin is not None:
+                if not remerge:
+                    # a late-partial re-merge has no honest barrier
+                    # interval (its "first contribution" IS the late
+                    # arrival) — observing it would feed bogus ~0
+                    # samples into the SLO histogram. The interval ends
+                    # at BARRIER RELEASE (the _pop_ready_locked stamp),
+                    # not merge start: with several windows detached in
+                    # one batch, window B must not absorb window A's
+                    # merge+emit wall as "barrier wait".
+                    first = lin["first_contribution"]
+                    released = lin.get("barrier_released", t0_wall)
+                    self._m["barrier_s"].observe(
+                        max(0.0, released - first))
+                    TRACER.record("mesh_barrier_wait", first, released,
+                                  model=name, slot=slot,
+                                  contribs=len(new_contribs))
+                for c in new_contribs:
+                    if c.get("accepted") is not None:
+                        self._m["sub2merge_s"].observe(
+                            max(0.0, t_merged - c["accepted"]))
             log.info("mesh merged window model=%s slot=%d contribs=%d",
                      name, slot, len(payloads))
+
+    def _finish_lineage_locked(self, name: str, slot: int, lin: dict,
+                               t0_wall: float, t_merged: float,
+                               t_emitted: float, n_rows: int) -> bool:
+        """Seal a lineage record at merge time (caller holds _lock) and
+        age the per-model ledger (LINEAGE_SLOTS newest slots —
+        metadata-sized records, same discipline as the merged-rows
+        ledger). A late-partial RE-merge must not destroy the original
+        window's lineage — the prior sealed record's contributions,
+        first-contribution time, promoted carries and late count fold
+        into the new one — and orphaned late annotations buffered
+        during the lock-free merge gap drain here. Returns whether
+        this was a re-merge."""
+        key = (name, slot)
+        reopen = lin.pop("late_reopen", False)
+        prior = self._lineage_done.get(key)
+        if prior is None and reopen:
+            # re-merge of a retention-evicted window: nothing to fold,
+            # but it IS a re-merge — without this the evicted case
+            # would feed the bogus ~0 barrier sample the remerge
+            # exclusion exists to prevent
+            lin["remerges"] = lin.get("remerges", 0) + 1
+        if prior is not None:
+            lin["contributions"] = prior["contributions"] + \
+                lin["contributions"]
+            # min, not prior's: concurrent merges of the same slot may
+            # seal in either order
+            lin["first_contribution"] = min(prior["first_contribution"],
+                                            lin["first_contribution"])
+            lin["carries_promoted"] = prior["carries_promoted"] + [
+                m for m in lin["carries_promoted"]
+                if m not in prior["carries_promoted"]]
+            lin["late"] += prior["late"]
+            lin["remerges"] = prior.get("remerges", 0) + 1
+        orphans = self._lineage_orphans.pop(key, None)
+        if orphans:
+            lin["contributions"] = lin["contributions"] + orphans
+            lin["late"] += len(orphans)
+        lin["status"] = "merged"
+        lin["members"] = sorted({c["member"]
+                                 for c in lin["contributions"]
+                                 if c["member"] is not None})
+        lin["merge_started"] = t0_wall
+        lin["merged"] = t_merged
+        lin["emitted"] = t_emitted
+        lin["merge_wall_s"] = round(t_merged - t0_wall, 6)
+        lin["barrier_wait_s"] = round(
+            max(0.0, lin.get("barrier_released", t0_wall)
+                - lin["first_contribution"]), 6)
+        lin["rows"] = n_rows
+        self._lineage_done[key] = lin  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        slots = sorted(s for n, s in self._lineage_done if n == name)
+        for s in slots[:-LINEAGE_SLOTS]:
+            del self._lineage_done[(name, s)]
+        return prior is not None or reopen
+
+    @staticmethod
+    def _count_rows(rows) -> int:
+        """Emitted-row count for one merged window (lineage/emit span):
+        top-K dicts carry a validity mask, wagg dicts a timeslot
+        column, alert lists are plain."""
+        if isinstance(rows, dict):
+            if "valid" in rows:
+                return int(rows["valid"].sum())
+            ts = rows.get("timeslot")
+            return int(len(ts)) if ts is not None else 0
+        return len(rows)
 
     @staticmethod
     def _merge_one(spec: ModelSpec, slot: int, payloads: list) -> dict:
@@ -550,3 +953,36 @@ class MeshCoordinator:
                 return list(self.merged.get((name, slot), []))
             return [rows for (n, _), rs in sorted(self.merged.items())
                     if n == name for rows in rs]
+
+    def lineage(self, model: Optional[str] = None,
+                slot: Optional[int] = None) -> list[dict]:
+        """The meshscope window-lineage ledger (JSON-safe copies):
+        merged records first (newest-LINEAGE_SLOTS per model), then the
+        still-pending windows riding the barrier. Each record answers
+        "which members built this window, from which offset ranges,
+        when, and through which path (closed / promoted carry / late)"
+        — served at ``/debug/lineage`` and by the ``lineage`` CLI."""
+        def keep(n, s):
+            return (model is None or n == model) and \
+                (slot is None or s == slot)
+
+        with self._lock:
+            out = [dict(rec, contributions=list(rec["contributions"]))
+                   for (n, s), rec in sorted(self._lineage_done.items())
+                   if keep(n, s)]
+            out += [dict(rec, contributions=list(rec["contributions"]))
+                    for (n, s), rec in
+                    sorted(self._lineage_pending.items()) if keep(n, s)]
+        return out
+
+    def trace_sources(self) -> list[tuple]:
+        """(member_id, trace_url, clock_offset, clock_rtt) for every
+        live member that advertised a trace endpoint — the
+        ``/debug/trace`` fan-out list. ``clock_offset`` is
+        member_clock - coordinator_clock (None until the member's first
+        heartbeat clock report; the fan-out then estimates its own from
+        the fetch round-trip)."""
+        with self._lock:
+            return [(mid, m.trace_url, m.clock_offset, m.clock_rtt)
+                    for mid, m in self._members.items()
+                    if m.alive and m.trace_url]

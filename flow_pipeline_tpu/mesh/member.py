@@ -33,6 +33,7 @@ from __future__ import annotations
 # on that same thread. The only cross-thread entry is the coordinator's
 # state-provider fan-out, which takes worker.lock and mutates nothing.)
 
+import dataclasses
 import time
 from typing import Any, Callable, Optional, Sequence
 
@@ -41,7 +42,9 @@ from ..engine.windowed import WindowedHeavyHitter
 from ..engine.worker import StreamWorker, WorkerConfig
 from ..models.window_agg import WindowAggregator
 from ..obs import get_logger
+from ..obs.trace import TRACER
 from . import codec
+from .scope import ClockSync
 
 log = get_logger("mesh")
 
@@ -55,13 +58,19 @@ class MeshMember:
                  config: WorkerConfig = WorkerConfig(),
                  sinks: Sequence[Any] = (),
                  submit_every: int = 0,
-                 sync_interval: float = 0.2):
+                 sync_interval: float = 0.2,
+                 trace_url: Optional[str] = None):
         self.member_id = member_id
         self.coordinator = coordinator
         self.consumer_factory = consumer_factory
         self.model_factory = model_factory
         self.config = config
         self.sinks = list(sinks)
+        # meshscope: this member's /debug/trace URL, advertised at
+        # join() so the coordinator's mesh-wide /debug/trace can fan
+        # out to it (None in-process: one shared TRACER already holds
+        # every lane)
+        self.trace_url = trace_url
         # >0: also submit a progress carry every N applied batches even
         # without a window close — bounds a successor's replay (and the
         # carry the coordinator can promote) to N batches mid-window
@@ -86,6 +95,20 @@ class MeshMember:
         self._dead = False
         # flowlint: unguarded -- written by the driver thread, read by the runtime's quiescence poll; a monotone-ish progress signal, not state
         self.idle_streak = 0
+        # meshscope: per-member monotonic submission ids (the span
+        # context every submission carries)
+        # flowlint: unguarded -- driver thread only
+        self._sub_seq = 0
+        # heartbeat-fed clock offset estimator (mesh/scope.py): every
+        # sync() round-trip adds an NTP-midpoint sample; the best
+        # (min-RTT) estimate rides the next sync to the coordinator
+        # flowlint: unguarded -- driver thread only
+        self._clock = ClockSync()
+        # one identity per process: the inner StreamWorker publishes
+        # flow_build_info, and in a member process it must say so —
+        # a second role="worker" series would be a double identity
+        self.config = dataclasses.replace(self.config,
+                                          build_role="member")
 
     # ---- capture hooks ----------------------------------------------------
 
@@ -111,12 +134,28 @@ class MeshMember:
 
     # ---- assignment lifecycle --------------------------------------------
 
+    def _call_sync(self) -> dict:
+        """One heartbeat round-trip, clock-instrumented: the response's
+        ``now`` (coordinator wall clock) plus our t0/t1 stamps form an
+        NTP-midpoint offset sample; the best (min-RTT) estimate is
+        reported back on the next call so the coordinator always holds
+        a fresh per-member clock alignment for /debug/trace."""
+        t0 = time.time()
+        resp = self.coordinator.sync(self.member_id,
+                                     clock=self._clock.report())
+        t1 = time.time()
+        now = resp.get("now")
+        if now is not None:
+            self._clock.add(t0, t1, float(now))
+        return resp
+
     def _sync(self) -> None:
         if not self._joined:
             self.coordinator.join(self.member_id,
-                                  provider=self._query_state)
+                                  provider=self._query_state,
+                                  trace_url=self.trace_url)
             self._joined = True
-        resp = self.coordinator.sync(self.member_id)
+        resp = self._call_sync()
         action = resp.get("action")
         if action == "rejoin":
             # fenced: our un-submitted state is the successor's replay
@@ -126,7 +165,7 @@ class MeshMember:
         if action == "resync":
             self._resync()
             # try to re-acquire immediately
-            resp = self.coordinator.sync(self.member_id)
+            resp = self._call_sync()
             action = resp.get("action")
         if action == "run" and resp.get("assign") is not None:
             self._start_worker(resp["assign"])
@@ -168,7 +207,7 @@ class MeshMember:
             self.coordinator.submit(self.member_id, codec.encode({
                 "member": self.member_id, "ranges": {}, "watermark": 0,
                 "closed": {}, "open": {}, "flows": 0, "release": True,
-                "final": False}))
+                "final": False, "span": self._next_span((), ())}))
         self._captured = {}
         self._frontier = {}
 
@@ -233,6 +272,24 @@ class MeshMember:
                     codec.capture_model(m.model)
         return out
 
+    def _next_span(self, closed_slots, open_slots,
+                   chunk: int = -1) -> dict:
+        """Mint the span context one submission carries across the
+        process boundary: submission id, the window slots it touches,
+        the newest chunk id that fed it, and this member's wall-clock
+        send anchor — what ties the coordinator's submit-accept /
+        merge / carry-promotion spans back to the member spans that
+        produced the state."""
+        self._sub_seq += 1
+        return {
+            "sub": self._sub_seq,
+            "member": self.member_id,
+            "sent": time.time(),
+            "chunk": int(chunk),
+            "windows": sorted({int(s) for s in closed_slots} |
+                              {int(s) for s in open_slots}),
+        }
+
     def _submit(self, final: bool = False, release: bool = False) -> bool:
         w = self.worker
         if w is None:
@@ -251,6 +308,8 @@ class MeshMember:
                 ranges[p] = [start, to]
             watermark = self._watermark(w)
             flows = w.flows_seen
+            chunk = getattr(w, "_trace_chunk", -1)
+        span = self._next_span(closed, open_windows, chunk)
         payload = {
             "member": self.member_id,
             "ranges": ranges,
@@ -260,9 +319,14 @@ class MeshMember:
             "flows": flows - self._flows_reported,
             "final": final,
             "release": release,
+            "span": span,
         }
         resp = self.coordinator.submit(self.member_id,
                                        codec.encode(payload))
+        TRACER.record("mesh_submit", span["sent"], time.time(),
+                      member=self.member_id, sub=span["sub"],
+                      chunk=span["chunk"], ok=bool(resp.get("ok")),
+                      windows=len(closed))
         if not resp.get("ok"):
             log.warning("mesh member %s submission rejected (%s); "
                         "abandoning state and rejoining",

@@ -5,11 +5,16 @@ method calls; this module carries the same calls over HTTP so the
 compose topology (deploy/compose/mesh.yml: coordinator + N worker
 containers) runs the identical protocol:
 
-    POST /mesh/join    {"member": id, "state_url": url|null}
-    POST /mesh/sync    {"member": id}
+    POST /mesh/join    {"member": id, "state_url": url|null,
+                        "trace_url": url|null}
+    POST /mesh/sync    {"member": id, "clock": {offset, rtt}|null}
     POST /mesh/submit?member=id   (octet-stream: mesh/codec envelope)
     POST /mesh/leave   {"member": id}
     GET  /topk?model=M&k=N        merged open-window view (fan-out)
+    GET  /debug/lineage?model=M&slot=S   meshscope window lineage
+    GET  /debug/trace             ONE clock-aligned mesh-wide Chrome
+                                  trace (coordinator lane + fan-out to
+                                  every member's /debug/trace)
     GET  /healthz /state          liveness + protocol introspection
 
 ``RemoteCoordinator`` duck-types MeshCoordinator for MeshMember, and
@@ -26,11 +31,13 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..obs import get_logger
+from . import scope
 from .coordinator import MeshCoordinator
 
 log = get_logger("mesh")
@@ -74,9 +81,11 @@ class MeshCoordinatorServer:
                             provider = (_url_provider(req["state_url"])
                                         if req.get("state_url") else None)
                             out = outer.coordinator.join(
-                                member, provider=provider)
+                                member, provider=provider,
+                                trace_url=req.get("trace_url"))
                         elif url.path == "/mesh/sync":
-                            out = outer.coordinator.sync(member)
+                            out = outer.coordinator.sync(
+                                member, clock=req.get("clock"))
                         else:
                             outer.coordinator.leave(member)
                             out = {}
@@ -95,6 +104,12 @@ class MeshCoordinatorServer:
                         k = int(q["k"]) if "k" in q else None
                         out = outer.coordinator.query_topk(
                             q.get("model"), k)
+                    elif url.path == "/debug/lineage":
+                        slot = int(q["slot"]) if "slot" in q else None
+                        out = outer.coordinator.lineage(
+                            q.get("model"), slot)
+                    elif url.path == "/debug/trace":
+                        out = outer.aggregated_trace()
                     elif url.path == "/healthz":
                         st = outer.coordinator.status()
                         out = {"ok": True, "epoch": st["epoch"],
@@ -111,12 +126,9 @@ class MeshCoordinatorServer:
                     self._reply(400, {"error": str(e)})
 
             def _reply(self, code, obj):
-                body = json.dumps(obj, default=str).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                from ..obs.server import reply_json
+
+                reply_json(self, obj, code, default=str)
 
             def log_message(self, *args):
                 pass
@@ -137,6 +149,52 @@ class MeshCoordinatorServer:
             for mid in self.coordinator.expire():
                 log.warning("mesh expiry: fenced silent member %s", mid)
 
+    def aggregated_trace(self) -> dict:
+        """meshscope: ONE clock-aligned Chrome trace for the whole
+        mesh. The coordinator's own flight recorder is the reference
+        lane; every live member that advertised a trace_url at join is
+        fetched, its clock aligned by the heartbeat-estimated offset
+        (mesh/scope.py — falling back to an estimate from THIS fetch's
+        round-trip when no heartbeat sample exists yet), and an
+        unreachable member degrades the aggregate (logged, lane
+        skipped) rather than blacking it out."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..obs.trace import TRACER
+
+        def fetch(source):
+            mid, trace_url, offset, rtt = source
+            t0 = time.time()
+            try:
+                with urllib.request.urlopen(trace_url, timeout=5) as resp:
+                    tr = json.loads(resp.read().decode())
+            except (OSError, ValueError) as e:
+                log.warning("meshscope: member %s trace fetch failed "
+                            "(%s); aggregating without it", mid, e)
+                return None
+            t1 = time.time()
+            if offset is None:
+                now = (tr.get("otherData") or {}).get("now")
+                if now is not None:
+                    offset, rtt = scope.estimate_offset(t0, t1,
+                                                        float(now))
+                else:
+                    offset, rtt = 0.0, 0.0
+            return scope.TraceLane(mid, tr, offset, rtt)
+
+        lanes = [scope.TraceLane("coordinator", TRACER.chrome_trace())]
+        sources = self.coordinator.trace_sources()
+        if sources:
+            # concurrent fan-out: the fetches are independent, and the
+            # aggregate is wanted most during churn — exactly when some
+            # members are unreachable. Serial fetches would stack one
+            # 5s timeout per dead member onto the handler thread.
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(sources))) as pool:
+                lanes += [lane for lane in pool.map(fetch, sources)
+                          if lane is not None]
+        return scope.aggregate_traces(lanes)
+
     def start(self) -> "MeshCoordinatorServer":
         self._thread.start()
         self._sweeper.start()
@@ -153,9 +211,11 @@ class RemoteCoordinator:
     """MeshCoordinator duck type over HTTP (the member side)."""
 
     def __init__(self, base_url: str, state_url: str | None = None,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0,
+                 trace_url: str | None = None):
         self.base_url = base_url.rstrip("/")
         self.state_url = state_url
+        self.trace_url = trace_url
         self.timeout = timeout
 
     def _post_json(self, path: str, obj: dict) -> dict:
@@ -165,14 +225,19 @@ class RemoteCoordinator:
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             return json.loads(resp.read().decode())
 
-    def join(self, member_id: str, provider=None) -> dict:
+    def join(self, member_id: str, provider=None,
+             trace_url: str | None = None) -> dict:
         # provider callables cannot cross HTTP; the member's state URL
-        # (served by MemberStateServer) plays that role remotely
+        # (served by MemberStateServer) plays that role remotely, and
+        # the trace URL is where the coordinator's mesh-wide
+        # /debug/trace fans out to
         return self._post_json("/mesh/join", {
-            "member": member_id, "state_url": self.state_url})
+            "member": member_id, "state_url": self.state_url,
+            "trace_url": trace_url or self.trace_url})
 
-    def sync(self, member_id: str) -> dict:
-        return self._post_json("/mesh/sync", {"member": member_id})
+    def sync(self, member_id: str, clock: dict | None = None) -> dict:
+        return self._post_json("/mesh/sync",
+                               {"member": member_id, "clock": clock})
 
     def leave(self, member_id: str) -> None:
         self._post_json("/mesh/leave", {"member": member_id})
@@ -200,6 +265,13 @@ class MemberStateServer:
             def do_GET(self):  # noqa: N802
                 url = urlparse(self.path)
                 q = {k: v[0] for k, v in parse_qs(url.query).items()}
+                if url.path == "/healthz":
+                    # compose healthchecks probe liveness here instead
+                    # of inferring it from protocol traffic
+                    from ..obs.server import reply_json
+
+                    reply_json(self, {"ok": True})
+                    return
                 if url.path != "/meshstate" or "model" not in q:
                     self.send_response(404)
                     self.end_headers()

@@ -9,6 +9,7 @@ metrics port is meant to be scraped (the reference never adds :8081 to
 prometheus.yml).
 """
 
+from .buildinfo import publish_build_info
 from .metrics import (Counter, Gauge, Histogram, Summary, MetricsRegistry,
                       REGISTRY)
 from .server import MetricsServer
@@ -28,4 +29,5 @@ __all__ = [
     "next_chunk_id",
     "get_logger",
     "set_level",
+    "publish_build_info",
 ]

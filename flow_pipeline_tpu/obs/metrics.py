@@ -67,6 +67,15 @@ class Gauge(Counter):
             # flowlint: disable=lock-discipline -- _values is declared guarded-by _lock in Counter.__init__ (the checker is per-class and cannot see base-class annotations); this write holds that lock
             self._values[key] = value
 
+    def remove(self, **labels) -> None:
+        """Drop one label-set series. A gauge keyed by a dynamic entity
+        (e.g. a mesh member) would otherwise render its last value
+        forever after the entity dies — a frozen stale series that
+        mimics a live signal."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values.pop(key, None)
+
 
 class Summary:
     """Sliding-window summary with quantiles + running sum/count (the shape

@@ -12,6 +12,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .metrics import REGISTRY, MetricsRegistry
 
 
+def reply_json(handler: BaseHTTPRequestHandler, obj,
+               code: int = 200, default=None) -> None:
+    """Write one JSON response on a BaseHTTPRequestHandler — the
+    single copy of the status/headers/body sequence the obs and mesh
+    servers' JSON endpoints share. ``default`` passes through to
+    json.dumps for payloads with non-JSON leaves (numpy scalars in
+    protocol responses)."""
+    body = json.dumps(obj, default=default).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
 class MetricsServer:
     """Background /metrics server. Port 0 picks a free port (tests)."""
 
@@ -23,15 +38,21 @@ class MetricsServer:
             def do_GET(self):  # noqa: N802
                 if self.path == "/debug/trace":
                     # flight-recorder snapshot: the last ring's worth of
-                    # per-chunk spans across the pipeline threads
+                    # per-chunk spans across the pipeline threads. The
+                    # wall-clock stamp lets a meshscope aggregator
+                    # (mesh/server.py) estimate this process's clock
+                    # offset from the fetch round-trip's NTP midpoint.
+                    import time
+
                     from .trace import TRACER
 
-                    body = json.dumps(TRACER.chrome_trace()).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    doc = TRACER.chrome_trace()
+                    doc["otherData"]["now"] = time.time()
+                    reply_json(self, doc)
+                    return
+                if self.path == "/healthz":
+                    # liveness for compose healthchecks / orchestrators
+                    reply_json(self, {"ok": True})
                     return
                 if self.path not in ("/metrics", "/"):
                     self.send_response(404)

@@ -44,6 +44,8 @@ KNOWN_FLAGS = frozenset({
     # flowmesh (mesh/)
     "mesh.workers", "mesh.role", "mesh.coordinator", "mesh.id",
     "mesh.listen", "mesh.heartbeat",
+    # meshscope lineage CLI (the `lineage` subcommand)
+    "lineage.model", "lineage.slot", "lineage.raw",
     # inserter
     "postgres.dsn", "postgres.pass", "sqlite", "flush.dur",
     # topic admin

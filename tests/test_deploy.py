@@ -100,6 +100,19 @@ class TestCompose:
             if name != "kafka-init":
                 assert svc.get("restart") == "always", name
 
+    def test_mesh_topology_has_liveness_healthchecks(self):
+        """meshscope satellite: the coordinator and every worker declare
+        real /healthz healthchecks (the smoke driver previously had to
+        infer liveness from /state content). The coordinator's probes
+        its protocol port; workers probe their MetricsServer."""
+        doc = load("compose/mesh.yml")
+        services = doc["services"]
+        coord_hc = services["coordinator"]["healthcheck"]["test"]
+        assert "8090/healthz" in " ".join(coord_hc)
+        for w in (n for n in services if n.startswith("worker-")):
+            hc = services[w]["healthcheck"]["test"]
+            assert "8081/healthz" in " ".join(hc), w
+
     def test_fixedlen_on_clickhouse_paths(self):
         for path in ("compose/clickhouse-mock.yml",
                      "compose/clickhouse-collect.yml"):
@@ -255,6 +268,30 @@ class TestGrafana:
         exprs = " ".join(t["expr"] for t in reb["targets"])
         assert "mesh_rebalance_total" in exprs
         assert "mesh_members" in exprs and "mesh_epoch" in exprs
+
+    def test_pipeline_dashboard_meshscope_panels(self):
+        """Round-13 meshscope panels: per-member watermark skew (the
+        stalled-shard signal), barrier-wait p99 off the aggregable
+        buckets, and the lineage-derived submit->merge latency next to
+        the rebalance-duration p99."""
+        with open(os.path.join(DEPLOY, "grafana", "dashboards",
+                               "pipeline.json")) as f:
+            dash = json.load(f)
+        panels = {p["title"]: p for p in dash["panels"]}
+        skew = panels["Mesh watermark skew by member (s)"]
+        exprs = " ".join(t["expr"] for t in skew["targets"])
+        assert "mesh_watermark_skew_seconds" in exprs
+        assert "mesh_commit_watermark_seconds" in exprs
+        assert skew["targets"][0]["legendFormat"] == "{{member}}"
+        barrier = panels["Mesh barrier wait p99 (s)"]
+        exprs = " ".join(t["expr"] for t in barrier["targets"])
+        assert "mesh_barrier_wait_seconds_bucket" in exprs
+        assert "histogram_quantile(0.99" in exprs and "by (le)" in exprs
+        lat = panels["Mesh submit→merge latency (lineage, s)"]
+        exprs = " ".join(t["expr"] for t in lat["targets"])
+        assert "mesh_submit_to_merge_seconds_bucket" in exprs
+        assert "mesh_rebalance_duration_seconds_bucket" in exprs
+        assert "mesh_submit_total" in exprs
 
     def test_traffic_dashboards_have_four_topn_tables(self):
         # reference viz.json serves four top-N tables: src/dst IPs AND
