@@ -27,6 +27,9 @@ Modes (default ``hh`` is what the driver records):
                                  # host_fused in-kernel phase breakdown
     python bench.py sharded [n]  # n-device mesh rate + merge cost
     python bench.py mesh         # flowmesh 1/2/4-worker scaling curve
+    python bench.py serve        # flowserve: concurrent query load
+                                 # during full-rate ingest + paired
+                                 # serve-on/off ingest A/B
     python bench.py sweep        # batch x width x impl tuning sweep
     python bench.py trace [dir]  # jax.profiler device trace of the step
 """
@@ -880,6 +883,219 @@ def bench_mesh() -> None:
     }))
 
 
+SERVE_FLOWS = 800_000
+SERVE_PROCS = 2      # reader subprocesses (honest concurrency: no GIL
+SERVE_THREADS = 4    # sharing with the server) x connections each
+SERVE_PAIRS = 4
+
+
+def bench_serve() -> None:
+    """flowserve acceptance artifact (ROADMAP item 5): a closed-loop
+    8-connection query load (2 reader subprocesses x 4 keep-alive
+    connections — separate interpreters, so the measurement does not
+    throttle itself on the server's GIL) hammers /query/* WHILE the
+    worker ingests at full rate, and a paired serve-on / serve-off
+    ingest A/B (alternating leg order, the r11 methodology) measures
+    what serving costs the dataplane. The queries/sec value is the
+    sustained concurrent read rate DURING ingest — cache hits dominate
+    between publishes, which is the design (thousands of readers share
+    one extraction per snapshot)."""
+    import threading
+
+    global _NATIVE
+    _NATIVE = _ensure_native()
+    from flow_pipeline_tpu.cli import (_batch_frames, _build_models,
+                                       _common_flags, _gen_flags,
+                                       _make_generator, _processor_flags)
+    from flow_pipeline_tpu.engine import StreamWorker, WorkerConfig
+    from flow_pipeline_tpu.serve import ServeServer, attach_worker
+    from flow_pipeline_tpu.serve.loadgen import (run_load_procs,
+                                                 sample_ages, wait_ready)
+    from flow_pipeline_tpu.transport import Consumer, InProcessBus
+    from flow_pipeline_tpu.utils.flags import FlagSet
+
+    fs = _processor_flags(_gen_flags(_common_flags(FlagSet("bench"))))
+    # modeled rate 1000/s: the 800k-flow stream spans ~800s of event
+    # time, so windows CLOSE mid-leg — publishes exercise the
+    # window-close trigger and /query/range serves real closed rows
+    vals = fs.parse(["-produce.profile", "zipf",
+                     "-produce.rate", "1000"])
+
+    def make_bus():
+        bus = InProcessBus()
+        bus.create_topic("flows", 2)
+        gen = _make_generator(vals)
+        produced = 0
+        while produced < SERVE_FLOWS:
+            bus.produce_many("flows", _batch_frames(gen.batch(16384)))
+            produced += 16384
+        return bus
+
+    def run_leg(mode: str, load_s: float = 0.0):
+        """One full ingest leg. ``mode``: "off" = bare worker (the A/B
+        baseline); "pub" = flowserve wired (publisher in the batch
+        loop, snapshots publishing, server up) but NO readers — what
+        the serving MACHINERY costs the dataplane; "load" = "pub" plus
+        the reader processes for ``load_s`` inside the ingest window.
+        Returns (ingest flows/s, load stats | None, max age | None,
+        server | None — still running, for the idle-ceiling leg)."""
+        worker = StreamWorker(
+            Consumer(make_bus(), fixedlen=True), _build_models(vals), [],
+            WorkerConfig(poll_max=vals["processor.batch"],
+                         snapshot_every=0, ingest_native_group=True))
+        server = None
+        load = ages = None
+        if mode != "off":
+            # the SHIPPED refresh default: the A/B measures what a
+            # production deployment pays (window closes + 2s cadence)
+            pub = attach_worker(worker, refresh=2.0)
+            server = ServeServer(pub.store, port=0).start()
+        dt = {}
+
+        def ingest():
+            t0 = time.perf_counter()
+            worker.run(stop_when_idle=True)
+            dt["s"] = time.perf_counter() - t0
+
+        t = threading.Thread(target=ingest, daemon=True)
+        t.start()
+        if mode == "load":
+            assert wait_ready("127.0.0.1", server.port, timeout=60)
+            done = threading.Event()
+            sampler, ages = sample_ages("127.0.0.1", server.port, done)
+            load = run_load_procs("127.0.0.1", server.port,
+                                  procs=SERVE_PROCS,
+                                  threads=SERVE_THREADS,
+                                  duration=load_s)
+            done.set()
+            sampler.join(timeout=10)
+        t.join()
+        return (SERVE_FLOWS / dt["s"] if dt.get("s") else 0.0, load,
+                max(ages) if ages else None, server)
+
+    warm_rate, _, _, _ = run_leg("off")  # warm: XLA compile excluded
+    # load window sized to sit INSIDE the warm ingest wall (the qps
+    # value must be "during full-rate ingest", not "mostly idle")
+    load_s = min(10.0, max(1.0, 0.8 * SERVE_FLOWS / max(warm_rate, 1.0)))
+    # A/B 1 — the budgeted claim: serving MACHINERY (publisher hook,
+    # snapshot extraction + pointer swaps, server thread) vs bare
+    # worker, paired with alternating order (r11 methodology)
+    pub_rates, off_rates, pub_ratios = [], [], []
+    for i in range(SERVE_PAIRS):
+        if i % 2 == 0:
+            on, _, _, srv = run_leg("pub")
+            off, _, _, _ = run_leg("off")
+        else:
+            off, _, _, _ = run_leg("off")
+            on, _, _, srv = run_leg("pub")
+        srv.stop()
+        pub_rates.append(on)
+        off_rates.append(off)
+        if off:
+            pub_ratios.append(1 - on / off)
+    # A/B 2 — reader CONTENTION: the same ingest with 2 reader
+    # processes saturating the serving surface. On a box with spare
+    # cores this converges to A/B 1; on a 2-core box the readers and
+    # the dataplane share cores BY CONSTRUCTION and the delta is the
+    # box, not the architecture (the BENCH_r12 flat-curve precedent).
+    from flow_pipeline_tpu.obs import REGISTRY
+
+    loads, load_rates, max_ages = [], [], []
+    idle_server = None
+    # hits are diffed across exactly the load legs: the counter is
+    # process-global and the idle-ceiling leg below would otherwise
+    # inflate the ratio past 1.0
+    hits0 = REGISTRY.counter("serve_cache_hits_total").value()
+    for _ in range(2):
+        on, load, age, srv = run_leg("load", load_s)
+        if idle_server is not None:
+            idle_server.stop()
+        idle_server = srv  # the last leg's server feeds the idle leg
+        load_rates.append(on)
+        loads.append(load)
+        if age is not None:
+            max_ages.append(age)
+    hits = REGISTRY.counter("serve_cache_hits_total").value() - hits0
+    # idle-ceiling leg: the same readers against the (quiesced) server
+    # — what the serving path alone sustains on this box
+    idle = run_load_procs("127.0.0.1", idle_server.port,
+                          procs=SERVE_PROCS, threads=SERVE_THREADS,
+                          duration=2.0)
+    idle_server.stop()
+    qps = statistics.median(x["qps"] for x in loads)
+    codes: dict[str, int] = {}
+    for x in loads + [idle]:
+        for c, n in x["codes"].items():
+            codes[c] = codes.get(c, 0) + n
+    n5xx = sum(n for c, n in codes.items() if c.startswith("5"))
+    pub_overhead = 100 * statistics.median(pub_ratios) \
+        if pub_ratios else 0.0
+    off_med = statistics.median(off_rates) if off_rates else 0.0
+    contention = 100 * (1 - statistics.median(load_rates) / off_med) \
+        if off_med else 0.0
+    from flow_pipeline_tpu import native as native_lib
+
+    reqs = sum(x["requests"] for x in loads)
+    print(json.dumps({
+        "metric": "flowserve concurrent query serving during "
+                  "full-rate ingest",
+        "unit": "queries/sec",
+        "value": round(qps, 1),
+        "qps_target": 1000.0,
+        "qps_target_met": qps >= 1000.0,
+        "idle_qps": idle["qps"],
+        "idle_p50_ms": idle["p50_ms"],
+        "query_p50_ms": round(statistics.median(
+            x["p50_ms"] for x in loads), 3),
+        "query_p99_ms": round(statistics.median(
+            x["p99_ms"] for x in loads), 3),
+        "reader_procs": SERVE_PROCS,
+        "reader_connections": SERVE_PROCS * SERVE_THREADS,
+        "requests_total": reqs,
+        "codes": codes,
+        "zero_5xx": n5xx == 0,
+        "transport_errors": sum(x["errors"] for x in loads),
+        "cache_hit_ratio": round(hits / reqs, 3) if reqs else 0.0,
+        "snapshot_max_age_s": round(max(max_ages), 3) if max_ages
+        else None,
+        "flows_per_leg": SERVE_FLOWS,
+        "ingest_off_flows_per_sec": round(off_med, 1),
+        "ingest_serving_flows_per_sec": round(
+            statistics.median(pub_rates), 1),
+        "ingest_under_load_flows_per_sec": round(
+            statistics.median(load_rates), 1),
+        "serve_overhead_pct": round(pub_overhead, 2),
+        "serve_overhead_pairs_pct": [round(100 * r, 2)
+                                     for r in pub_ratios],
+        # the same overhead off the leg-rate MEDIANS (noise-robust on
+        # boxes where individual pairs spread wider than the effect)
+        "serve_overhead_medians_pct": round(
+            100 * (1 - statistics.median(pub_rates) / off_med)
+            if off_med else 0.0, 2),
+        "overhead_budget_pct": 2.0,
+        "within_budget": pub_overhead < 2.0,
+        "reader_contention_pct": round(contention, 2),
+        "native_capabilities": native_lib.capabilities(),
+        "native_decode": _NATIVE,
+        "platform": _PLATFORM,
+        "nproc": os.cpu_count(),
+        "load_window_s": round(load_s, 2),
+        "host_note": (
+            "serve_overhead_pct is the budgeted A/B (publisher + "
+            "snapshot publishing + server, NO readers; paired "
+            "alternating-order legs, r11 methodology — single legs on "
+            "throttled boxes spread 10-30% and the median per-pair "
+            "ratio can dip negative). reader_contention_pct and the "
+            "qps value add 2 reader processes x 4 keep-alive "
+            "connections INSIDE the ingest window: on this nproc-core "
+            "box readers and dataplane share cores by construction, "
+            "so both are box-bound (the BENCH_r12 flat-curve "
+            "precedent) — re-measure the 1k-qps target on a box with "
+            "spare cores for the readers; idle_qps is the serving "
+            "path's own ceiling here"),
+    }))
+
+
 def bench_sweep() -> None:
     """Tuning sweep for the flagship step: batch size x CMS width x impl
     x table prefilter x admission rule. One JSON line per point plus a
@@ -1180,6 +1396,8 @@ if __name__ == "__main__":
         bench_sharded(int(sys.argv[2]) if len(sys.argv) > 2 else 8)
     elif mode == "mesh":
         bench_mesh()
+    elif mode == "serve":
+        bench_serve()
     elif mode == "sweep":
         bench_sweep()
     elif mode == "trace":

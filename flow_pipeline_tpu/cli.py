@@ -295,6 +295,17 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
                                  "of Kafka")
     fs.string("query.addr", "", "Live query API host:port (O(K) top-K / "
                                 "open windows / alerts; empty disables)")
+    # flowserve (serve/): lock-free snapshot read serving — see
+    # docs/ARCHITECTURE.md "flowserve"
+    fs.string("serve.addr", "", "flowserve query host:port (/query/topk, "
+                                "/query/estimate, /query/range off "
+                                "versioned immutable snapshots — readers "
+                                "never touch the dataplane locks; empty "
+                                "disables)")
+    fs.number("serve.refresh", 2.0, "flowserve open-window snapshot "
+                                    "refresh cadence in seconds "
+                                    "(snapshots always publish at window "
+                                    "close; 0 = window-close only)")
     return fs
 
 
@@ -419,6 +430,34 @@ def _worker_config(vals) -> "WorkerConfig":
     )
 
 
+def _start_serve_worker(vals, worker):
+    """Wire flowserve onto a standalone worker when -serve.addr is set:
+    publisher into the batch loop + range-ledger sink, HTTP reader on
+    the requested address. Returns (server, store) or (None, None)."""
+    if not vals["serve.addr"]:
+        return None, None
+    from .serve import ServeServer, attach_worker
+
+    pub = attach_worker(worker, refresh=vals["serve.refresh"])
+    host, port = _host_port(vals["serve.addr"], 8083)
+    server = ServeServer(pub.store, port, host).start()
+    return server, pub.store
+
+
+def _start_serve_mesh(vals, coordinator):
+    """Wire flowserve onto a mesh coordinator when -serve.addr is set:
+    merged-view publisher thread + HTTP reader. Returns (server,
+    publisher) or (None, None)."""
+    if not vals["serve.addr"]:
+        return None, None
+    from .serve import ServeServer, attach_mesh
+
+    pub = attach_mesh(coordinator, refresh=vals["serve.refresh"])
+    host, port = _host_port(vals["serve.addr"], 8083)
+    server = ServeServer(pub.store, port, host).start()
+    return server, pub
+
+
 def _mesh_coordinator_main(vals) -> int:
     """flowmesh coordinator service: membership + merge barrier + the
     mesh-aware query surface. Consumes nothing itself."""
@@ -430,6 +469,7 @@ def _mesh_coordinator_main(vals) -> int:
     coord = MeshCoordinator(specs, vals["bus.partitions"],
                             sinks=_make_sinks(vals["sink"]),
                             heartbeat_timeout=vals["mesh.heartbeat"])
+    serve_srv, serve_pub = _start_serve_mesh(vals, coord)
     host, port = _host_port(vals["mesh.listen"] or ":8090", 8090,
                             default_host="0.0.0.0")
     server = MeshCoordinatorServer(coord, port, host).start()
@@ -448,6 +488,10 @@ def _mesh_coordinator_main(vals) -> int:
     finally:
         if query:
             query.stop()
+        if serve_pub:
+            serve_pub.stop()
+        if serve_srv:
+            serve_srv.stop()
         server.stop()
         if metrics:
             metrics.stop()
@@ -547,6 +591,7 @@ def processor_main(argv=None) -> int:
     feed = None
     server = None
     query = None
+    serve_srv = None
     try:
         if vals["in"]:
             bus = _load_frames_bus(vals["in"], vals["kafka.topic"])
@@ -580,11 +625,13 @@ def processor_main(argv=None) -> int:
             _make_sinks(vals["sink"]),
             _worker_config(vals),
         )
+        serve_srv, serve_store = _start_serve_worker(vals, worker)
         if vals["query.addr"]:
             from .engine.query_api import QueryServer
 
             qhost, qport = _host_port(vals["query.addr"], 8082)
-            query = QueryServer(worker, qport, qhost).start()
+            query = QueryServer(worker, qport, qhost,
+                                serve=serve_store).start()
         if vals["checkpoint.path"]:
             if worker.restore():
                 log.info("restored checkpoint from %s",
@@ -599,6 +646,8 @@ def processor_main(argv=None) -> int:
         # error), not just the run loop
         if query:
             query.stop()
+        if serve_srv:
+            serve_srv.stop()
         if feed:
             feed.stop()
         if server:
@@ -726,6 +775,7 @@ def _pipeline_mesh(vals) -> int:
         model_factory=lambda: _build_models(vals),
         config=_worker_config(vals), sinks=sinks, member_sinks=sinks,
         heartbeat_timeout=vals["mesh.heartbeat"])
+    serve_srv, serve_pub = _start_serve_mesh(vals, mesh.coordinator)
     query = None
     if vals["query.addr"]:
         qhost, qport = _host_port(vals["query.addr"], 8082)
@@ -738,6 +788,10 @@ def _pipeline_mesh(vals) -> int:
              elapsed, produced / max(elapsed, 1e-9), merged)
     if query:
         query.stop()
+    if serve_pub:
+        serve_pub.stop()
+    if serve_srv:
+        serve_srv.stop()
     if server:
         server.stop()
     return 0
@@ -777,12 +831,14 @@ def pipeline_main(argv=None) -> int:
         _make_sinks(vals["sink"]),
         _worker_config(vals),
     )
+    serve_srv, serve_store = _start_serve_worker(vals, worker)
     query = None
     if vals["query.addr"]:
         from .engine.query_api import QueryServer
 
         qhost, qport = _host_port(vals["query.addr"], 8082)
-        query = QueryServer(worker, qport, qhost).start()
+        query = QueryServer(worker, qport, qhost,
+                            serve=serve_store).start()
     t0 = time.perf_counter()
     worker.run(stop_when_idle=True)
     dt = time.perf_counter() - t0
@@ -790,6 +846,8 @@ def pipeline_main(argv=None) -> int:
              worker.flows_seen, dt, worker.flows_seen / max(dt, 1e-9))
     if query:
         query.stop()
+    if serve_srv:
+        serve_srv.stop()
     if server:
         server.stop()
     return 0

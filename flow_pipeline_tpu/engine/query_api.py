@@ -17,7 +17,6 @@ queries see consistent model state and never race a concurrent flush.
 
 from __future__ import annotations
 
-import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -25,6 +24,7 @@ from urllib.parse import parse_qs, urlparse
 from ..models.ddos import DDoSDetector
 from ..models.window_agg import WindowAggregator
 from ..obs import get_logger
+from ..obs.server import reply_json
 from ..sink.base import rows_to_records
 from .windowed import WindowedHeavyHitter
 
@@ -39,12 +39,20 @@ class QueryServer:
     live member's state provider and answers from the network-wide
     MERGED open-window view — the same monoid fold the window-close
     merge runs, so the answer equals a single worker seeing the whole
-    stream (tests/test_mesh.py pins the equality)."""
+    stream (tests/test_mesh.py pins the equality).
+
+    ``serve`` (a serve.SnapshotStore) lets /topk answer from the
+    flowserve snapshot WITHOUT the worker lock whenever the snapshot is
+    fresh — covers the exact consumed point (``flows_seen`` matches), so
+    the answer is bit-identical to the locked read
+    (tests/test_serve.py pins the parity); anything staler falls back to
+    the locked path."""
 
     def __init__(self, worker, port: int = 8082, host: str = "127.0.0.1",
-                 mesh=None):
+                 mesh=None, serve=None):
         self.worker = worker
         self.mesh = mesh
+        self.serve = serve
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -59,31 +67,36 @@ class QueryServer:
                         "/alerts": outer._alerts,
                     }.get(url.path)
                     if handler is None:
-                        self._reply(404, {"error": f"unknown path {url.path}"})
+                        reply_json(self, {"error":
+                                          f"unknown path {url.path}"}, 404)
                         return
+                    if url.path == "/topk" and outer.mesh is None \
+                            and outer.worker is not None:
+                        # flowserve fast path FIRST, outside the lock: a
+                        # fresh snapshot answers without stalling (or
+                        # being stalled by) the dataplane
+                        result = outer._topk_from_snapshot(q)
+                        if result is not None:
+                            reply_json(self, result, default=str)
+                            return
                     if outer.mesh is not None and url.path in (
                             "/topk", "/healthz"):
                         # mesh fan-out acquires MEMBER locks; it must
                         # not run under a co-resident worker's lock
                         result = handler(q)
                     elif outer.worker is None:
-                        self._reply(400, {"error":
-                                          "no worker behind this path"})
+                        reply_json(self, {"error":
+                                          "no worker behind this path"},
+                                   400)
                         return
                     else:
                         with outer.worker.lock:  # consistent view
                             result = handler(q)
-                    self._reply(200, result)
+                    reply_json(self, result, default=str)
                 except (KeyError, ValueError) as e:
-                    self._reply(400, {"error": str(e)})
-
-            def _reply(self, code, obj):
-                body = json.dumps(obj, default=str).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                    # malformed query params (/topk?k=abc) and unknown
+                    # models answer 400, never a handler traceback
+                    reply_json(self, {"error": str(e)}, 400)
 
             def log_message(self, *args):
                 pass
@@ -121,6 +134,38 @@ class QueryServer:
             if isinstance(model, want_type):
                 return name, model
         raise KeyError(f"no model of kind {want_type.__name__} configured")
+
+    def _topk_from_snapshot(self, q):
+        """Lock-free /topk off the flowserve snapshot, or None when the
+        snapshot cannot answer VERBATIM what the locked path would:
+        it must cover the exact consumed point (``flows_seen`` — reading
+        the worker's counter is one atomic attribute load), know the
+        requested model, and hold at least k extracted rows. The
+        returned dict is shaped exactly like the locked ``_topk`` (the
+        parity test compares them field-for-field)."""
+        if self.serve is None:
+            return None
+        snap = self.serve.current
+        if snap is None or snap.source != "worker" or \
+                snap.flows_seen != self.worker.flows_seen:
+            return None
+        name = q.get("model")
+        if name:
+            fam = snap.families.get(name)
+        else:
+            fam = next(iter(snap.families.values()), None)
+        k = int(q.get("k", 10))
+        if fam is None or k < 0 or k > fam.depth:
+            # the locked path serves (or errors) instead — a negative k
+            # would slice from the END here but not there, and the fast
+            # path must answer VERBATIM or not at all
+            return None
+        rows = {col: arr[:k] for col, arr in fam.rows.items()}
+        return {
+            "model": fam.name,
+            "window_start": fam.window_start,
+            "rows": rows_to_records(rows),
+        }
 
     def _topk(self, q) -> dict:
         if self.mesh is not None:

@@ -217,6 +217,11 @@ class StreamWorker:
         # Guards model/window state against concurrent readers (the live
         # query API); the worker holds it across each run_once step.
         self.lock = threading.Lock()
+        # flowserve hook (serve.WorkerServePublisher.attach): when set,
+        # every _process step lets it publish an immutable snapshot for
+        # the lock-free read path. Wired once before run() starts.
+        # flowlint: unguarded -- bound once at wiring (before the loop), then read on the worker thread only
+        self.serve = None
         self.m_flows = REGISTRY.counter("flows_processed_total",
                                         "flows decoded and aggregated")
         self.m_batches = REGISTRY.counter("batches_processed_total",
@@ -345,6 +350,11 @@ class StreamWorker:
             and self.batches_seen % self.config.snapshot_every == 0
         ):
             self.snapshot_and_commit()
+        if self.serve is not None:
+            # flowserve publish decision (window close / refresh due):
+            # runs HERE, under the lock the read path never takes —
+            # extraction cost is paid per publish, never per query
+            self.serve.on_batch(self)
         return True
 
     def run(self, max_batches: Optional[int] = None,
@@ -542,6 +552,10 @@ class StreamWorker:
         with self.lock:
             self.flush_closed(force=True)
             self.snapshot_and_commit()
+            if self.serve is not None:
+                # end-of-stream view: the final forced flush closed every
+                # window; readers keep getting answers after the loop ends
+                self.serve.publish(self)
         if hasattr(self.consumer, "lag"):
             self.m_lag.set(self.consumer.lag())
         if self.executor is not None:

@@ -57,10 +57,33 @@ def host_hh_init(config: HeavyHitterConfig) -> HostHHState:
 
 def _cms_to_u64(cms) -> np.ndarray:
     a = np.asarray(cms, dtype=np.float32)
+    # fast path: healthy sketches (finite, in [0, 2^64) — every cell the
+    # device path produces by construction) convert in ONE pass; NaN/inf
+    # comparisons are False, so any pathological cell routes to the
+    # clamping slow path below
+    lo, hi = a.min(initial=np.float32(0.0)), a.max(initial=np.float32(0.0))
+    if np.float32(0.0) <= lo and hi <= _U64_CAP:
+        return np.ascontiguousarray(a.astype(np.uint64))
     with np.errstate(invalid="ignore"):
         a = np.nan_to_num(a, nan=0.0, posinf=float(_U64_CAP), neginf=0.0)
         a = np.clip(a, np.float32(0.0), _U64_CAP)
     return np.ascontiguousarray(a.astype(np.uint64))
+
+
+def frozen_cms(state) -> np.ndarray:
+    """The CMS planes of any sketch-state form (device HHState, host
+    HostHHState, a checkpoint field-dict, or bare planes) as a FRESH
+    uint64 array — the canonical exact-monoid layout every
+    cross-boundary consumer shares (the flowmesh codec's merge
+    payloads, flowserve's frozen per-key-estimate planes). Always
+    copies: callers publish the result to readers that outlive the
+    engine's in-place mutation."""
+    if isinstance(state, HostHHState):
+        return state.cms.copy()
+    if isinstance(state, np.ndarray):
+        return _cms_to_u64(state)
+    cms = state["cms"] if isinstance(state, dict) else state.cms
+    return _cms_to_u64(cms)
 
 
 def from_device_state(state) -> HostHHState:

@@ -24,7 +24,7 @@ import json
 import numpy as np
 
 from ..engine.checkpoint import _decode, _encode
-from ..hostsketch.state import HostHHState, _cms_to_u64
+from ..hostsketch.state import HostHHState, frozen_cms
 
 MAGIC = b"FMSH1\n"
 
@@ -61,19 +61,19 @@ def decode(data: bytes):
 
 def hh_payload(state) -> dict:
     """Device/host HHState (or checkpoint field-dict) -> canonical
-    uint64-CMS payload. Accepts jax or numpy leaves; always copies."""
+    uint64-CMS payload. Accepts jax or numpy leaves; always copies
+    (frozen_cms is the shared hostsketch export seam)."""
     if isinstance(state, HostHHState):
-        # hostsketch engine state via its export seam: already uint64
-        return {"kind": "hh", "cms": state.cms.copy(),
+        return {"kind": "hh", "cms": frozen_cms(state),
                 "table_keys": state.table_keys.copy(),
                 "table_vals": state.table_vals.copy()}
     if isinstance(state, dict):
-        cms, tk, tv = state["cms"], state["table_keys"], state["table_vals"]
+        tk, tv = state["table_keys"], state["table_vals"]
     else:
-        cms, tk, tv = state.cms, state.table_keys, state.table_vals
+        tk, tv = state.table_keys, state.table_vals
     return {
         "kind": "hh",
-        "cms": _cms_to_u64(cms),
+        "cms": frozen_cms(state),
         "table_keys": np.ascontiguousarray(np.asarray(tk),
                                            dtype=np.uint32).copy(),
         "table_vals": np.ascontiguousarray(np.asarray(tv),

@@ -245,6 +245,11 @@ class MeshCoordinator:
         # unsettled rebalance; cleared when every live member owns
         # exactly its target set again
         self._rebalance_start: Optional[tuple[float, str]] = None  # guarded-by: _lock
+        # flowserve hook (serve.MeshServePublisher.attach): a completed
+        # merge wakes the publisher so the MERGED snapshot refreshes —
+        # readers then never fan out to members per query.
+        # flowlint: unguarded -- bound once at wiring (before members join), then read on merge threads only
+        self.serve = None
         # eager registration: /metrics carries every mesh family (as
         # zeros) the moment a coordinator exists — the dashboard honesty
         # test resolves the mesh panels against this surface
@@ -777,6 +782,10 @@ class MeshCoordinator:
                             max(0.0, t_merged - c["accepted"]))
             log.info("mesh merged window model=%s slot=%d contribs=%d",
                      name, slot, len(payloads))
+        if ready and self.serve is not None:
+            # wake the flowserve publisher (no lock held here); the
+            # fan-out/extract runs on ITS thread, never the submitter's
+            self.serve.on_merge()
 
     def _finish_lineage_locked(self, name: str, slot: int, lin: dict,
                                t0_wall: float, t_merged: float,
@@ -860,6 +869,62 @@ class MeshCoordinator:
 
     # ---- live queries (mesh-aware /topk) ----------------------------------
 
+    def open_window_payloads(self, name: str,
+                             ) -> tuple[Optional[int], list]:
+        """(newest open slot, its contribution payloads) for one top-K
+        model: every live member's provider state plus the slot's
+        pending barrier contributions. The coordinator lock covers only
+        the provider/pending SNAPSHOT — the member fan-out runs
+        lock-free, and an unreachable member degrades the answer
+        instead of blacking it out. Shared by the per-query ``/topk``
+        fan-out and the flowserve publisher (which amortizes one call
+        over every reader until the next publish)."""
+        with self._lock:
+            providers = [(mid, m.provider)
+                         for mid, m in self._members.items()
+                         if m.alive and m.provider is not None]
+            # NOT the carries: every carry belongs to a LIVE member
+            # (death promotes them into _pending), and a live member's
+            # provider state is a superset of its own carry — folding
+            # both would double-count everything since its last
+            # submission. What CAN be missing from the providers is a
+            # dead member's promoted-but-unmerged contribution: that
+            # sits in _pending, disjoint from its successor's state
+            # (the successor resumed at the covered frontier).
+            pending = {slot: list(payloads)
+                       for (n, slot), payloads in self._pending.items()
+                       if n == name}
+        states: list[tuple[int, dict]] = []
+        for mid, provider in providers:
+            try:
+                res = provider(name)
+            except (OSError, ValueError) as e:
+                # a dying-but-not-yet-fenced member must DEGRADE the
+                # answer (its un-submitted open rows are missing until
+                # the fence promotes/replays), never black out /topk
+                log.warning("mesh /topk: member %s state fetch failed "
+                            "(%s); answering without it", mid, e)
+                continue
+            if isinstance(res, (bytes, bytearray)):
+                res = codec.decode(bytes(res))
+            if res and res.get("slot") is not None:
+                states.append((int(res["slot"]), res["payload"]))
+        slots = [s for s, _ in states] + list(pending)
+        if not slots:
+            return None, []
+        slot = max(slots)
+        return slot, [p for s, p in states if s == slot] + \
+            pending.get(slot, [])
+
+    def commit_watermark(self) -> int:
+        """Mesh-wide event-time watermark: min over live members'
+        reported watermarks (never-reported newcomers excluded — the
+        same rule as the mesh_commit_watermark_seconds gauge)."""
+        with self._lock:
+            wms = [m.watermark for m in self._members.values()
+                   if m.alive and m.watermark > 0]
+        return min(wms) if wms else 0
+
     def query_topk(self, model: Optional[str] = None,
                    k: Optional[int] = None) -> dict:
         """Fan the query to every live member's state provider and
@@ -877,42 +942,9 @@ class MeshCoordinator:
                          if s.kind in ("hh", "dense")), None)
             if spec is None:
                 raise KeyError("no top-K model configured")
-        with self._lock:
-            providers = [(mid, m.provider)
-                         for mid, m in self._members.items()
-                         if m.alive and m.provider is not None]
-            # NOT the carries: every carry belongs to a LIVE member
-            # (death promotes them into _pending), and a live member's
-            # provider state is a superset of its own carry — folding
-            # both would double-count everything since its last
-            # submission. What CAN be missing from the providers is a
-            # dead member's promoted-but-unmerged contribution: that
-            # sits in _pending, disjoint from its successor's state
-            # (the successor resumed at the covered frontier).
-            pending = {slot: list(payloads)
-                       for (name, slot), payloads in self._pending.items()
-                       if name == spec.name}
-        states: list[tuple[int, dict]] = []
-        for mid, provider in providers:
-            try:
-                res = provider(spec.name)
-            except (OSError, ValueError) as e:
-                # a dying-but-not-yet-fenced member must DEGRADE the
-                # answer (its un-submitted open rows are missing until
-                # the fence promotes/replays), never black out /topk
-                log.warning("mesh /topk: member %s state fetch failed "
-                            "(%s); answering without it", mid, e)
-                continue
-            if isinstance(res, (bytes, bytearray)):
-                res = codec.decode(bytes(res))
-            if res and res.get("slot") is not None:
-                states.append((int(res["slot"]), res["payload"]))
-        slots = [s for s, _ in states] + list(pending)
-        if not slots:
+        slot, payloads = self.open_window_payloads(spec.name)
+        if slot is None:
             return {"model": spec.name, "window_start": None, "rows": []}
-        slot = max(slots)
-        payloads = [p for s, p in states if s == slot] + \
-            pending.get(slot, [])
         from ..sink.base import rows_to_records
 
         kk = k or spec.k or spec.config.capacity

@@ -1,0 +1,40 @@
+"""flowserve: versioned-snapshot query serving for heavy concurrent reads.
+
+Serving millions of users is reads, not just ingest (ROADMAP item 5).
+The dataplane's live query API (`engine/query_api.py`) answers under the
+worker's lock — correct, but every reader stalls the ingest loop and
+each other. flowserve decouples the two RCU-style:
+
+- the WRITE side (worker thread / mesh coordinator) publishes an
+  immutable :class:`~.snapshot.Snapshot` — extracted top-K rows per
+  family, frozen uint64 CMS planes for per-key estimates, the newest
+  closed exact-window rows, watermark — via a single atomic reference
+  swap at every window close and at a configurable open-window refresh
+  cadence (``-serve.refresh``);
+- the READ side (:class:`~.server.ServeServer`) loads the pointer and
+  answers ``/query/topk``, ``/query/estimate``, ``/query/range`` and
+  ``/query/version`` in O(K) without acquiring ANY dataplane lock
+  (tests/test_serve.py pins that), behind a response cache keyed by
+  ``(version, normalized query)`` with ETag/304 revalidation.
+
+In a mesh, the coordinator publishes the network-wide MERGED view at
+merge/refresh time, so the per-query member fan-out (the pre-r14
+``/topk mesh=`` path) disappears from the hot read path.
+"""
+
+from .publisher import (MeshServePublisher, WorkerServePublisher,
+                        attach_mesh, attach_worker)
+from .snapshot import FamilyView, RangeLedger, Snapshot, SnapshotStore
+from .server import ServeServer
+
+__all__ = [
+    "FamilyView",
+    "MeshServePublisher",
+    "RangeLedger",
+    "ServeServer",
+    "Snapshot",
+    "SnapshotStore",
+    "WorkerServePublisher",
+    "attach_mesh",
+    "attach_worker",
+]

@@ -1,0 +1,271 @@
+"""flowserve publishers: the write side of the snapshot swap.
+
+Two publishers share the store/ledger machinery:
+
+- :class:`WorkerServePublisher` rides the StreamWorker's batch loop
+  (``worker.serve`` hook, called under ``worker.lock`` on the worker
+  thread): it publishes on the first batch, whenever a window closed
+  since the last snapshot (a top-K slot advanced, or closed exact rows
+  reached the range ledger), and at the ``-serve.refresh`` cadence for
+  open-window freshness. Extraction cost (one device sync per top-K
+  family) is paid HERE, once per publish — never per query.
+
+- :class:`MeshServePublisher` runs its own thread next to the mesh
+  coordinator: a window merge wakes it (``coordinator.serve`` hook) and
+  the refresh cadence bounds open-window staleness between merges. It
+  fans out to member state providers exactly like the pre-r14 per-query
+  ``/topk mesh=`` path did — but per PUBLISH (one fan-out per top-K
+  family, the provider protocol being per-model), so thousands of
+  readers share one fan-out round instead of issuing one each.
+
+Lock order, publish side: the worker publisher runs under worker.lock
+and takes only the range ledger's lock inside it; the mesh publisher
+takes coordinator._lock only through ``open_window_payloads`` (released
+before any fan-out I/O). The READ side takes neither — that is the
+whole point.
+"""
+
+from __future__ import annotations
+
+# flowlint: lock-checked
+# (worker publisher state mutates on the worker thread only, under
+# worker.lock by construction of the `worker.serve` hook; the mesh
+# publisher's state mutates on its own publisher thread only. The
+# shared store/ledger carry their own contracts in serve/snapshot.py.)
+
+import threading
+import time
+from typing import Optional
+
+from ..engine.windowed import WindowedHeavyHitter
+from ..models.heavy_hitter import key_width
+from ..models.window_agg import WindowAggregator
+from ..obs import get_logger
+from .snapshot import FamilyView, RangeLedger, Snapshot, SnapshotStore
+
+log = get_logger("serve")
+
+
+def _family_from_model(name: str, m: WindowedHeavyHitter) -> FamilyView:
+    """Freeze one windowed top-K model into a read view. Caller holds
+    worker.lock and has synced sketch states, so ``m.model.state`` /
+    ``.totals`` are current; ``top(depth)`` is the SAME extraction the
+    locked query path runs, so a snapshot-served k-row answer is the
+    locked answer's exact prefix."""
+    depth = m.k
+    rows = m.model.top(depth)
+    if m.model.snapshot_kind == "windowed_hh":
+        import numpy as np
+
+        from ..hostsketch.state import frozen_cms
+        from .snapshot import FrozenCms
+
+        kind = "hh"
+        planes = m.model.state.cms
+        if not isinstance(planes, np.ndarray):
+            # device-backend jax array: hh_update DONATES its state arg,
+            # so the next batch deletes these buffers on TPU/GPU — the
+            # host copy must happen NOW, at publish. (Host-exported
+            # states are already fresh numpy and safe to hold: they are
+            # replaced, never mutated.) The expensive f32->u64 freeze
+            # stays lazy either way — first estimate reader pays it.
+            planes = np.asarray(planes)
+        cms = FrozenCms(lambda a=planes: frozen_cms(a))
+        lanes = key_width(m.config)
+    else:
+        kind, cms, lanes = "dense", None, 1
+    return FamilyView(
+        name=name, kind=kind,
+        window_start=(int(m.current_slot)
+                      if m.current_slot is not None else None),
+        depth=int(len(rows["valid"])), rows=rows, key_lanes=lanes,
+        cms=cms, value_cols=tuple(m.config.value_cols))
+
+
+class WorkerServePublisher:
+    """Publishes a single worker's snapshots from inside its batch loop."""
+
+    def __init__(self, store: Optional[SnapshotStore] = None,
+                 refresh: float = 2.0, range_slots: int = 0):
+        self.store = store or SnapshotStore()
+        self.refresh = refresh
+        self.ledger = RangeLedger(
+            (), **({"max_slots": range_slots} if range_slots else {}))
+        # flowlint: unguarded -- worker thread only (on_batch/publish run under worker.lock on that thread)
+        self._last_slots: dict[str, Optional[int]] = {}
+        # flowlint: unguarded -- worker thread only
+        self._last_gen = -1
+        # flowlint: unguarded -- worker thread only
+        self._last_publish = 0.0
+
+    def attach(self, worker) -> "WorkerServePublisher":
+        """Wire into a StreamWorker BEFORE it runs: the range ledger
+        becomes one of its sinks (closed exact-window rows flow through
+        the normal flush path) and the worker's per-batch hook points
+        here."""
+        self.ledger.tables |= {
+            name for name, m in worker.models.items()
+            if isinstance(m, WindowAggregator)}
+        worker.sinks.append(self.ledger)
+        worker.serve = self
+        return self
+
+    # ---- worker hooks (worker.lock held) -----------------------------------
+
+    def on_batch(self, worker) -> None:
+        """Per-batch publish decision: first snapshot, any window close
+        since the last one, or the refresh cadence coming due."""
+        gen = self.ledger.generation
+        closed = gen != self._last_gen or any(
+            m.current_slot != self._last_slots.get(name)
+            for name, m in worker.models.items()
+            if isinstance(m, WindowedHeavyHitter))
+        if self.store.current is None or closed or (
+                self.refresh > 0
+                and time.monotonic() - self._last_publish >= self.refresh):
+            self.publish(worker)
+
+    def publish(self, worker) -> Snapshot:
+        """Build + swap one snapshot. Caller holds worker.lock (the
+        worker calls this from its own loop; tests may call it on a
+        quiesced worker)."""
+        t0 = time.monotonic()
+        worker.sync_sketch_states()
+        families = {}
+        watermark = 0.0
+        for name, m in worker.models.items():
+            if isinstance(m, WindowedHeavyHitter):
+                fam = _family_from_model(name, m)
+                families[name] = fam
+                self._last_slots[name] = m.current_slot
+                if m.current_slot is not None:
+                    watermark = max(watermark, float(m.current_slot))
+            elif isinstance(m, WindowAggregator):
+                watermark = max(watermark, float(m.watermark))
+        self._last_gen = self.ledger.generation
+        snap = self.store.publish(
+            watermark=watermark, flows_seen=worker.flows_seen,
+            source="worker", families=families,
+            ranges=self.ledger.freeze())
+        self._last_publish = time.monotonic()
+        log.debug("flowserve published v%d (%.1f ms, %d families)",
+                  snap.version, (self._last_publish - t0) * 1e3,
+                  len(families))
+        return snap
+
+
+class MeshServePublisher:
+    """Publishes the mesh coordinator's MERGED view on its own thread."""
+
+    def __init__(self, coordinator, store: Optional[SnapshotStore] = None,
+                 refresh: float = 2.0, range_slots: int = 0):
+        self.coordinator = coordinator
+        self.store = store or SnapshotStore()
+        self.refresh = refresh
+        self.ledger = RangeLedger(
+            (), **({"max_slots": range_slots} if range_slots else {}))
+        # flowlint: unguarded -- the events themselves; bound once
+        self._wake = threading.Event()
+        self._stop = threading.Event()  # flowlint: unguarded -- bound once
+        # flowlint: unguarded -- publisher thread only after start(); attach() runs before it
+        self._thread: Optional[threading.Thread] = None
+
+    def attach(self) -> "MeshServePublisher":
+        """Wire into the coordinator BEFORE members join: merged exact
+        rows reach the range ledger through the coordinator's sink list;
+        a completed merge wakes the publisher thread."""
+        self.ledger.tables |= {s.name for s in self.coordinator.specs
+                               if s.kind == "wagg"}
+        self.coordinator.sinks.append(self.ledger)
+        self.coordinator.serve = self
+        return self
+
+    def on_merge(self) -> None:
+        """Coordinator hook (runs on the submitting member's thread, no
+        coordinator lock held): schedule a publish, don't do the fan-out
+        here — a member's submit path must not pay it."""
+        self._wake.set()
+
+    # ---- publisher thread --------------------------------------------------
+
+    def start(self) -> "MeshServePublisher":
+        self._thread = threading.Thread(
+            target=self._run, name="serve-publish", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.publish_now()
+            except Exception:  # noqa: BLE001 -- serving must outlive a flaky member fetch
+                log.exception("flowserve mesh publish failed; retrying "
+                              "at the next wake")
+            self._wake.wait(self.refresh if self.refresh > 0 else None)
+            self._wake.clear()
+
+    def publish_now(self) -> Snapshot:
+        """One fan-out PER TOP-K FAMILY (the provider protocol is
+        per-model) + merge + extract + swap — amortized over every
+        reader until the next publish, where the pre-r14 path paid a
+        fan-out per QUERY."""
+        from ..mesh import merge as merge_ops
+
+        coord = self.coordinator
+        families = {}
+        for spec in coord.specs:
+            if spec.kind == "wagg":
+                continue
+            slot, payloads = coord.open_window_payloads(spec.name)
+            depth = spec.k or spec.config.capacity
+            if spec.kind == "hh":
+                from .snapshot import FrozenCms
+
+                merged = merge_ops.merge_hh(payloads, spec.config)
+                rows = merge_ops.hh_top_rows(merged, spec.config, depth,
+                                             slot or 0)
+                # the merge already materialized the u64 planes
+                cms = FrozenCms(value=merged["cms"])
+                lanes = key_width(spec.config)
+            else:
+                totals = (merge_ops.merge_dense(payloads) if payloads
+                          else None)
+                rows = merge_ops.dense_top_rows(
+                    totals, spec.config, depth, slot or 0) \
+                    if totals is not None else None
+                cms, lanes = None, 1
+            if rows is None:
+                continue
+            families[spec.name] = FamilyView(
+                name=spec.name, kind=spec.kind, window_start=slot,
+                depth=int(len(rows["valid"])), rows=rows,
+                key_lanes=lanes, cms=cms,
+                value_cols=tuple(spec.config.value_cols))
+        return self.store.publish(
+            watermark=float(coord.commit_watermark()), flows_seen=None,
+            source="mesh", families=families, ranges=self.ledger.freeze())
+
+
+def attach_worker(worker, refresh: float = 2.0,
+                  store: Optional[SnapshotStore] = None,
+                  ) -> WorkerServePublisher:
+    """One-call wiring for a standalone worker (the cli path)."""
+    return WorkerServePublisher(store, refresh=refresh).attach(worker)
+
+
+def attach_mesh(coordinator, refresh: float = 2.0,
+                store: Optional[SnapshotStore] = None,
+                start: bool = True) -> MeshServePublisher:
+    """One-call wiring for a mesh coordinator (the cli path). ``start``
+    launches the publisher thread; tests pass False and drive
+    ``publish_now`` deterministically."""
+    pub = MeshServePublisher(coordinator, store, refresh=refresh).attach()
+    if start:
+        pub.start()
+    return pub

@@ -41,6 +41,8 @@ KNOWN_FLAGS = frozenset({
     "ingest.native_group", "ingest.fused",
     "checkpoint.path", "flush.count", "metrics.addr", "sink", "in",
     "listen.feed", "query.addr", "obs.trace",
+    # flowserve (serve/)
+    "serve.addr", "serve.refresh",
     # flowmesh (mesh/)
     "mesh.workers", "mesh.role", "mesh.coordinator", "mesh.id",
     "mesh.listen", "mesh.heartbeat",

@@ -88,6 +88,10 @@ class TestCompose:
         assert "-mesh.role coordinator" in coord
         assert "-bus.partitions 8" in coord
         assert "-query.addr" in coord  # the mesh-aware /topk surface
+        # flowserve: the merged-snapshot read surface (lock-free /query/*)
+        assert "-serve.addr" in coord
+        assert any("8083" in p for p in
+                   services["coordinator"]["ports"])
         for w in workers:
             cmd = services[w]["command"]
             assert "-mesh.role member" in cmd
@@ -293,6 +297,30 @@ class TestGrafana:
         assert "mesh_rebalance_duration_seconds_bucket" in exprs
         assert "mesh_submit_total" in exprs
 
+    def test_pipeline_dashboard_serve_panels(self):
+        """Round-14 flowserve panels: query rate by endpoint, query
+        latency quantiles off the aggregable le buckets, and snapshot
+        age/freshness (live age from the publish timestamp, plus the
+        publish rate)."""
+        with open(os.path.join(DEPLOY, "grafana", "dashboards",
+                               "pipeline.json")) as f:
+            dash = json.load(f)
+        panels = {p["title"]: p for p in dash["panels"]}
+        rate = panels["Serve query rate (req/s)"]
+        exprs = " ".join(t["expr"] for t in rate["targets"])
+        assert "serve_queries_total" in exprs
+        assert "serve_cache_hits_total" in exprs
+        assert rate["targets"][0]["legendFormat"] == "{{endpoint}}"
+        lat = panels["Serve query latency p99 (s)"]
+        exprs = " ".join(t["expr"] for t in lat["targets"])
+        assert "serve_query_seconds_bucket" in exprs
+        assert "histogram_quantile(0.99" in exprs and "by (le)" in exprs
+        age = panels["Serve snapshot age (s)"]
+        exprs = " ".join(t["expr"] for t in age["targets"])
+        assert "serve_snapshot_timestamp_seconds" in exprs
+        assert "serve_snapshot_age_seconds" in exprs
+        assert "serve_snapshots_published_total" in exprs
+
     def test_traffic_dashboards_have_four_topn_tables(self):
         # reference viz.json serves four top-N tables: src/dst IPs AND
         # src/dst ports — both dashboard variants must carry all four
@@ -369,6 +397,7 @@ class TestDashboardHonesty:
         from flow_pipeline_tpu.engine import Supervisor
 
         from flow_pipeline_tpu.mesh import MeshCoordinator
+        from flow_pipeline_tpu.serve import SnapshotStore
 
         reg = MetricsRegistry()
         CollectorServer(None, CollectorConfig(netflow_addr=None,
@@ -376,6 +405,7 @@ class TestDashboardHonesty:
         StreamWorker(consumer=None, models={})  # registers on the global
         Supervisor(lambda: None)  # worker_restarts_total
         MeshCoordinator([], 2)  # mesh_* families (eager registration)
+        SnapshotStore()  # serve_* families (eager registration)
         names = set(reg._metrics) | set(REGISTRY._metrics)
         for text in (reg.render(), REGISTRY.render()):
             for line in text.splitlines():

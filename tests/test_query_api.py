@@ -98,3 +98,16 @@ class TestQueryAPI:
         with pytest.raises(urllib.error.HTTPError) as e:
             get(server, "/topk?model=ghost")
         assert e.value.code == 400
+
+    @pytest.mark.parametrize("path", ["/topk?k=abc", "/alerts?limit=x",
+                                      "/topk?k=1.5"])
+    def test_malformed_query_params_are_400_json(self, served_worker,
+                                                 path):
+        """Malformed query params answer a 400 JSON error, never a
+        handler traceback — the same contract the mesh server got in
+        r12 (the reply path is the shared obs.server.reply_json)."""
+        worker, server = served_worker
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(server, path)
+        assert e.value.code == 400
+        assert "error" in json.loads(e.value.read())

@@ -1,0 +1,578 @@
+"""flowserve tests: versioned-snapshot query serving (serve/).
+
+The contracts pinned here, per docs/ARCHITECTURE.md "flowserve":
+
+- snapshot-served /query/topk and /query/range are BIT-EXACT against
+  the locked-path answer / the sink-committed rows at the same consumed
+  point — single worker AND merged mesh;
+- the read path acquires NO dataplane lock (worker.lock, coordinator
+  _lock/_merge_lock are instrumented and must count zero);
+- the legacy /topk serves lock-free from a fresh snapshot and falls
+  back to the locked path the moment the snapshot is stale;
+- snapshots are immutable and versions monotone under churn: 8 reader
+  threads hammering /query/* during live ingest (and a mesh member
+  kill) never see a torn response or a 5xx.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.engine import (StreamWorker, WindowedHeavyHitter,
+                                      WorkerConfig)
+from flow_pipeline_tpu.engine.query_api import QueryServer
+from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+from flow_pipeline_tpu.models import (DenseTopConfig, DenseTopKModel,
+                                      HeavyHitterConfig, WindowAggConfig,
+                                      WindowAggregator)
+from flow_pipeline_tpu.serve import (RangeLedger, ServeServer,
+                                     SnapshotStore, attach_mesh,
+                                     attach_worker)
+from flow_pipeline_tpu.sink import MemorySink
+from flow_pipeline_tpu.sink.base import rows_to_records
+from flow_pipeline_tpu.transport import Consumer, InProcessBus, Producer
+
+T0 = 1_699_999_800  # window-aligned stream start
+
+
+def _fill_bus(batches=8, per=500, rate=5.0, seed=91, partitions=1):
+    """Pre-produced stream spanning several 5-minute windows (rate=5
+    flows/s of modeled time -> 8x500 flows cover ~800s = 2 closed + 1
+    open window)."""
+    bus = InProcessBus()
+    bus.create_topic("flows", partitions)
+    gen = FlowGenerator(ZipfProfile(n_keys=100, alpha=1.3), seed=seed,
+                        t0=T0, rate=rate)
+    prod = Producer(bus, fixedlen=True)
+    for _ in range(batches):
+        prod.send_many(gen.batch(per).to_messages())
+    return bus
+
+
+def _models():
+    return {
+        "flows_5m": WindowAggregator(WindowAggConfig(batch_size=512)),
+        "top_talkers": WindowedHeavyHitter(
+            HeavyHitterConfig(batch_size=512, width=1 << 12, capacity=64),
+            k=10),
+        "top_src_ports": WindowedHeavyHitter(
+            DenseTopConfig(key_col="src_port", batch_size=512), k=10,
+            model_cls=DenseTopKModel),
+    }
+
+
+class _LockProbe:
+    """Context-manager lock wrapper counting acquisitions — the
+    read-path-takes-no-dataplane-lock instrument."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.count = 0
+
+    def __enter__(self):
+        self.count += 1
+        return self.inner.__enter__()
+
+    def __exit__(self, *a):
+        return self.inner.__exit__(*a)
+
+    def acquire(self, *a, **kw):
+        self.count += 1
+        return self.inner.acquire(*a, **kw)
+
+    def release(self):
+        return self.inner.release()
+
+
+def _get(port, path):
+    return json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}").read())
+
+
+@pytest.fixture(scope="module")
+def served():
+    """Quiesced worker + publisher + flowserve server + locked-path
+    query server, with the final snapshot published at the exact
+    consumed point."""
+    sink = MemorySink()
+    worker = StreamWorker(
+        Consumer(_fill_bus(), fixedlen=True), _models(), [sink],
+        WorkerConfig(snapshot_every=0, poll_max=512))
+    pub = attach_worker(worker, refresh=0.0)  # window-close only
+    while worker.run_once():
+        pass
+    with worker.lock:
+        pub.publish(worker)
+    serve = ServeServer(pub.store, port=0).start()
+    query = QueryServer(worker, port=0, serve=pub.store).start()
+    yield worker, pub, serve, query, sink
+    serve.stop()
+    query.stop()
+
+
+# ---- unit: store + ledger --------------------------------------------------
+
+
+class TestSnapshotStore:
+    def test_versions_monotone_and_swap_atomic(self):
+        store = SnapshotStore()
+        assert store.current is None
+        s1 = store.publish(watermark=1.0, flows_seen=10, source="worker",
+                           families={}, ranges={})
+        s2 = store.publish(watermark=2.0, flows_seen=20, source="worker",
+                           families={}, ranges={})
+        assert (s1.version, s2.version) == (1, 2)
+        assert store.current is s2
+        assert s1.flows_seen == 10  # published objects never mutate
+
+    def test_range_ledger_splits_retains_and_freezes(self):
+        led = RangeLedger(["flows_5m"], max_slots=2)
+        def rows(slots, base):
+            return {"timeslot": np.asarray(slots, np.uint64),
+                    "bytes": np.asarray(base, np.uint64)}
+        led.write("flows_5m", rows([100, 100, 400], [1, 2, 3]))
+        led.write("flows_5m", rows([400], [4]))       # late partial
+        led.write("top_talkers", rows([100], [9]))    # not a range table
+        led.write("flows_5m", rows([700], [5]))       # evicts slot 100
+        frozen = led.freeze()
+        assert list(frozen) == ["flows_5m"]
+        slots = dict(frozen["flows_5m"])
+        assert sorted(slots) == [400, 700]
+        assert slots[400]["bytes"].tolist() == [3, 4]  # partials concat
+        assert led.generation == 3
+
+
+# ---- single worker ---------------------------------------------------------
+
+
+class TestWorkerServe:
+    def test_version_endpoint(self, served):
+        worker, pub, serve, _, _ = served
+        v = _get(serve.port, "/query/version")
+        assert v["version"] == pub.store.current.version
+        assert v["flows_seen"] == worker.flows_seen
+        assert v["source"] == "worker"
+        assert v["models"]["top_talkers"]["kind"] == "hh"
+        assert v["models"]["top_src_ports"]["kind"] == "dense"
+        assert v["ranges"]["flows_5m"]  # closed windows are served
+
+    @pytest.mark.parametrize("qs", ["?k=1", "?k=5", "?k=10",
+                                    "?model=top_src_ports&k=7"])
+    def test_topk_bit_exact_vs_locked_path(self, served, qs):
+        """Acceptance: the snapshot-served answer equals the locked
+        read at the same consumed point, for every k and family kind."""
+        worker, _, serve, query, _ = served
+        snap_ans = _get(serve.port, f"/query/topk{qs}")
+        with worker.lock:
+            worker.sync_sketch_states()
+            name = snap_ans["model"]
+            m = worker.models[name]
+            locked = rows_to_records({
+                k: v[:snap_ans["k"]] for k, v in m.model.top(10).items()})
+        assert snap_ans["rows"] == locked
+        assert snap_ans["window_start"] == m.current_slot
+        # and over HTTP: the legacy endpoint's locked-shape answer
+        legacy = _get(query.port, f"/topk{qs}")
+        assert legacy["rows"] == snap_ans["rows"]
+
+    def test_cms_capture_is_host_resident_and_released(self, served):
+        """Donation safety: hh_update donates its state buffers, so the
+        published capture must be HOST numpy pulled at publish time (a
+        lazily-read device array would be deleted by the next batch on
+        TPU/GPU — invisible on CPU, where donation is ignored); after
+        the first freeze the capture is released."""
+        worker, pub, serve, _, _ = served
+        with worker.lock:
+            pub.publish(worker)
+        fam = pub.store.current.families["top_talkers"]
+        captured = fam.cms._thunk.__defaults__[0]
+        assert isinstance(captured, np.ndarray)
+        frozen = fam.cms.get()
+        assert frozen.dtype == np.uint64
+        assert fam.cms._thunk is None  # capture released after freeze
+        assert fam.cms.get() is frozen  # memoized
+
+    def test_estimate_is_the_frozen_cms_query(self, served):
+        from flow_pipeline_tpu.hostsketch.engine import np_cms_query_u64
+
+        _, pub, serve, _, _ = served
+        fam = pub.store.current.families["top_talkers"]
+        lanes = np.concatenate([np.atleast_1d(fam.rows["src_addr"][0]),
+                                np.atleast_1d(fam.rows["dst_addr"][0])])
+        key = ",".join(str(int(x)) for x in lanes)
+        est = _get(serve.port, f"/query/estimate?key={key}")
+        want = np_cms_query_u64(
+            fam.cms.get(), np.asarray([lanes], np.uint32))[0]
+        assert est["estimates"] == {"bytes": int(want[0]),
+                                    "packets": int(want[1]),
+                                    "count": int(want[2])}
+        # CMS estimates upper-bound the table's observed sums
+        assert est["estimates"]["bytes"] >= int(fam.rows["bytes"][0])
+
+    def test_range_bit_exact_vs_sink_rows(self, served):
+        """Acceptance: /query/range returns exactly what the sinks were
+        given for the same closed slots."""
+        _, _, serve, _, sink = served
+        r = _get(serve.port, "/query/range")
+        assert r["model"] == "flows_5m" and len(r["slots"]) >= 2
+        for slot in r["slots"]:
+            got = [x for x in r["rows"] if x["timeslot"] == slot]
+            want = [x for x in sink.tables["flows_5m"]
+                    if x["timeslot"] == slot]
+            assert got == want and want
+        # slot filtering
+        lo = r["slots"][-1]
+        one = _get(serve.port, f"/query/range?from={lo}&to={lo + 300}")
+        assert one["slots"] == [lo]
+        assert one["rows"] == [x for x in r["rows"]
+                               if x["timeslot"] == lo]
+
+    def test_read_path_acquires_no_dataplane_lock(self, served):
+        """Acceptance: readers never touch worker.lock — instrumented."""
+        worker, _, serve, _, _ = served
+        probe = _LockProbe(worker.lock)
+        worker.lock = probe
+        try:
+            fam = _get(serve.port, "/query/version")
+            for path in ("/query/topk?k=10", "/query/range",
+                         "/query/version", "/healthz",
+                         "/query/topk?model=top_src_ports&k=3"):
+                for _ in range(3):
+                    _get(serve.port, path)
+        finally:
+            worker.lock = probe.inner
+        assert probe.count == 0
+        assert fam["version"] >= 1
+
+    def test_legacy_topk_fresh_snapshot_skips_the_lock(self, served):
+        worker, _, _, query, _ = served
+        probe = _LockProbe(worker.lock)
+        worker.lock = probe
+        try:
+            ans = _get(query.port, "/topk?k=5")
+        finally:
+            worker.lock = probe.inner
+        assert probe.count == 0
+        assert len(ans["rows"]) == 5
+
+    def test_legacy_topk_stale_snapshot_falls_back_locked(self, served):
+        """Freshness is the consumed point: any unpublished progress
+        must route /topk back through the lock (and the two answers
+        still agree once re-published)."""
+        worker, pub, _, query, _ = served
+        worker.flows_seen += 1  # simulate un-published progress
+        probe = _LockProbe(worker.lock)
+        worker.lock = probe
+        try:
+            ans = _get(query.port, "/topk?k=5")
+        finally:
+            worker.lock = probe.inner
+            worker.flows_seen -= 1
+        assert probe.count == 1  # the locked path served it
+        assert len(ans["rows"]) == 5
+        # k beyond the snapshot depth also falls back (served locked)
+        deep = _get(query.port, "/topk?k=11")
+        assert len(deep["rows"]) == 11
+
+    def test_cache_etag_and_304(self, served):
+        worker, pub, serve, _, _ = served
+        hits0 = pub.store.m_cache_hits.value()
+        url = f"http://127.0.0.1:{serve.port}/query/topk?k=4"
+        r1 = urllib.request.urlopen(url)
+        etag = r1.headers["ETag"]
+        body1 = r1.read()
+        r2 = urllib.request.urlopen(url)
+        assert r2.headers["ETag"] == etag and r2.read() == body1
+        assert pub.store.m_cache_hits.value() > hits0
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(urllib.request.Request(
+                url, headers={"If-None-Match": etag}))
+        assert e.value.code == 304
+        # a new publish swaps the pointer -> cache invalidates wholesale
+        with worker.lock:
+            pub.publish(worker)
+        r3 = urllib.request.urlopen(url)
+        assert r3.headers["ETag"] != etag
+        # same data (consumed point unchanged), new version stamp
+        doc1, doc3 = json.loads(body1), json.loads(r3.read())
+        assert doc3["version"] > doc1["version"]
+        assert doc3["rows"] == doc1["rows"]
+
+    def test_errors(self, served):
+        _, _, serve, query, _ = served
+        for path, code in (("/nope", 404),
+                           ("/query/topk?k=abc", 400),
+                           ("/query/topk?k=-1", 400),
+                           ("/query/topk?model=ghost", 400),
+                           ("/query/estimate?key=1", 400),
+                           ("/query/estimate", 400),
+                           # out-of-range lanes: a numpy OverflowError
+                           # must not abort the keep-alive connection
+                           ("/query/estimate?key=-1,2,3,4,5,6,7,8",
+                            400),
+                           ("/query/estimate?key=4294967296,2,3,4,5,"
+                            "6,7,8", 400),
+                           ("/query/estimate?model=top_src_ports"
+                            "&key=1", 400),
+                           ("/query/range?model=ghost", 400),
+                           ("/query/range?from=abc", 400)):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(serve.port, path)
+            assert e.value.code == code, path
+        # satellite regression: malformed k on the LEGACY endpoint is a
+        # 400 JSON error, not a handler traceback
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(query.port, "/topk?k=abc")
+        assert e.value.code == 400
+        assert "error" in json.loads(e.value.read())
+
+    def test_503_before_first_publish(self):
+        store = SnapshotStore()
+        serve = ServeServer(store, port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(serve.port, "/query/topk")
+            assert e.value.code == 503
+            assert _get(serve.port, "/healthz")["version"] == 0
+        finally:
+            serve.stop()
+
+    def test_worker_publishes_at_window_close_and_finalize(self):
+        """The on_batch trigger: one publish per window close (plus the
+        first batch and the finalize view) without any refresh cadence."""
+        worker = StreamWorker(
+            Consumer(_fill_bus(seed=17), fixedlen=True), _models(), [],
+            WorkerConfig(snapshot_every=0, poll_max=512))
+        pub = attach_worker(worker, refresh=0.0)
+        worker.run(stop_when_idle=True)  # incl. finalize
+        snap = pub.store.current
+        # first batch + >=2 window closes + finalize
+        assert snap.version >= 4
+        assert snap.flows_seen == worker.flows_seen
+        # finalize force-closed every window: all slots are served
+        assert len(snap.ranges["flows_5m"]) >= 3
+
+
+# ---- churn: snapshot immutability under concurrent readers -----------------
+
+
+def _reader(port, stop, out, paths):
+    """Hammer /query/* until stop; record (version per response,
+    status codes, consistency violations)."""
+    last_version = 0
+    i = 0
+    while not stop.is_set():
+        path = paths[i % len(paths)]
+        i += 1
+        try:
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10)
+            doc = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            if e.code >= 500:
+                out["errors"].append(f"{path}: {e.code}")
+            continue
+        except OSError as e:  # noqa: PERF203 -- server teardown race at stop is fine
+            if not stop.is_set():
+                out["errors"].append(f"{path}: {e}")
+            continue
+        v = doc.get("version", 0)
+        if v < last_version:
+            out["errors"].append(
+                f"{path}: version went backwards {last_version}->{v}")
+        last_version = v
+        if "rows" in doc and "k" in doc and len(doc["rows"]) > doc["k"]:
+            out["errors"].append(f"{path}: more rows than k")
+        if path.startswith("/query/range"):
+            bad = [r for r in doc["rows"]
+                   if r["timeslot"] not in doc["slots"]]
+            if bad:
+                out["errors"].append(f"{path}: row outside slots")
+        out["n"] += 1
+
+
+class TestChurn:
+    def test_worker_ingest_with_8_readers(self):
+        """8 reader threads hammer every endpoint while the worker
+        ingests and publishes full-rate: every response is internally
+        one version, versions are monotone per reader, zero 5xx."""
+        worker = StreamWorker(
+            Consumer(_fill_bus(batches=24, per=500, seed=23),
+                     fixedlen=True),
+            _models(), [],
+            WorkerConfig(snapshot_every=0, poll_max=512))
+        pub = attach_worker(worker, refresh=0.05)
+        serve = ServeServer(pub.store, port=0).start()
+        with worker.lock:
+            pub.publish(worker)  # readers never see bootstrap 503s
+        stop = threading.Event()
+        out = {"errors": [], "n": 0}
+        paths = ("/query/topk?k=10", "/query/version", "/query/range",
+                 "/query/topk?model=top_src_ports&k=5")
+        readers = [threading.Thread(target=_reader,
+                                    args=(serve.port, stop, out, paths),
+                                    daemon=True) for _ in range(8)]
+        for t in readers:
+            t.start()
+        try:
+            worker.run(stop_when_idle=True)
+        finally:
+            stop.set()
+            for t in readers:
+                t.join(timeout=30)
+            serve.stop()
+        assert not out["errors"], out["errors"][:5]
+        assert out["n"] > 50  # the load was real
+        assert pub.store.current.version > 1  # publishes kept landing
+
+
+# ---- merged mesh -----------------------------------------------------------
+
+
+def _mesh_models():
+    return {
+        "flows_5m": WindowAggregator(WindowAggConfig(batch_size=512)),
+        "top_talkers": WindowedHeavyHitter(
+            HeavyHitterConfig(
+                key_cols=("src_addr", "dst_addr", "src_port",
+                          "dst_port", "proto"),
+                batch_size=512, width=1 << 12, capacity=128),
+            k=10),
+    }
+
+
+def _mesh_bus(partitions=4, flows=8000, rate=40.0, seed=7):
+    from flow_pipeline_tpu.mesh import produce_sharded
+
+    bus = InProcessBus()
+    bus.create_topic("flows", partitions)
+    gen = FlowGenerator(ZipfProfile(n_keys=200, alpha=1.3), seed=seed,
+                        t0=1_700_000_000, rate=rate)
+    done = 0
+    while done < flows:
+        done += produce_sharded(bus, "flows", gen.batch(2048), partitions)
+    return bus
+
+
+class TestMeshServe:
+    def test_merged_snapshot_parity_and_no_coordinator_lock(self):
+        """Acceptance, mesh leg: the published MERGED snapshot answers
+        /query/topk bit-exact vs the per-query fan-out (query_topk) and
+        /query/range bit-exact vs the coordinator's sink rows — and the
+        read path takes neither coordinator lock."""
+        from flow_pipeline_tpu.mesh import InProcessMesh
+
+        sink = MemorySink()
+        mesh = InProcessMesh(
+            _mesh_bus(), "flows", 2, model_factory=_mesh_models,
+            config=WorkerConfig(poll_max=2048, snapshot_every=0),
+            sinks=[sink])
+        pub = attach_mesh(mesh.coordinator, refresh=0.2, start=False)
+        mesh.start()
+        serve = ServeServer(pub.store, port=0).start()
+        try:
+            mesh.wait_idle()
+            snap = pub.publish_now()
+            direct = mesh.coordinator.query_topk("top_talkers", 10)
+            # stop the member threads (their heartbeats legitimately
+            # take _lock — the instrument below must see READERS only)
+            mesh._stop.set()
+            for th in mesh._threads:
+                th.join(timeout=60)
+            c = mesh.coordinator
+            probes = {"_lock": _LockProbe(c._lock),
+                      "_merge_lock": _LockProbe(c._merge_lock)}
+            c._lock, c._merge_lock = probes["_lock"], \
+                probes["_merge_lock"]
+            try:
+                t = _get(serve.port, "/query/topk?model=top_talkers"
+                                     "&k=10")
+                r = _get(serve.port, "/query/range")
+                _get(serve.port, "/query/version")
+            finally:
+                c._lock = probes["_lock"].inner
+                c._merge_lock = probes["_merge_lock"].inner
+            assert t["rows"] == direct["rows"] and t["rows"]
+            assert t["window_start"] == direct["window_start"]
+            assert snap.source == "mesh"
+            for slot in r["slots"]:
+                got = [x for x in r["rows"] if x["timeslot"] == slot]
+                want = [x for x in sink.tables["flows_5m"]
+                        if x["timeslot"] == slot]
+                assert got == want and want
+            assert probes["_lock"].count == 0
+            assert probes["_merge_lock"].count == 0
+        finally:
+            serve.stop()
+            mesh.finalize()
+
+    def test_mesh_churn_kill_member_with_8_readers(self):
+        """Satellite: 8 readers hammer the merged serving surface while
+        the mesh ingests AND one member is killed mid-stream — zero
+        5xx, versions monotone, merges keep publishing after the
+        rebalance."""
+        from flow_pipeline_tpu.mesh import InProcessMesh
+
+        mesh = InProcessMesh(
+            _mesh_bus(flows=16000, rate=25.0, seed=11), "flows", 2,
+            model_factory=_mesh_models,
+            config=WorkerConfig(poll_max=1024, snapshot_every=0),
+            sinks=[], submit_every=2)
+        pub = attach_mesh(mesh.coordinator, refresh=0.05, start=True)
+        serve = ServeServer(pub.store, port=0).start()
+        import time as _time
+
+        stop = threading.Event()
+        out = {"errors": [], "n": 0}
+        readers = []
+        paths = ("/query/topk?model=top_talkers&k=10", "/query/version",
+                 "/query/range")
+        try:
+            mesh.start()
+            # first publish before the readers go (no bootstrap 503s)
+            deadline = _time.monotonic() + 30
+            while pub.store.current is None and \
+                    _time.monotonic() < deadline:
+                _time.sleep(0.01)
+            assert pub.store.current is not None
+            readers = [threading.Thread(
+                target=_reader, args=(serve.port, stop, out, paths),
+                daemon=True) for _ in range(8)]
+            for t in readers:
+                t.start()
+            _time.sleep(0.5)  # readers overlap live ingest
+            mesh.kill_member(1)  # fence + rebalance under read load
+            mesh.wait_idle()
+            v_before = pub.store.current.version
+            pub.publish_now()
+            assert pub.store.current.version > v_before
+        finally:
+            stop.set()
+            mesh.finalize()
+            pub.stop()
+            serve.stop()
+        for t in readers:
+            t.join(timeout=30)
+        assert not out["errors"], out["errors"][:5]
+        assert out["n"] > 50
+        assert mesh.coordinator._m["rebalance"].value(
+            reason="death") >= 1.0
+
+
+# ---- flags -----------------------------------------------------------------
+
+
+def test_serve_flags_registered_and_parsed():
+    from flow_pipeline_tpu.cli import (_common_flags, _gen_flags,
+                                       _processor_flags)
+    from flow_pipeline_tpu.utils.flags import KNOWN_FLAGS, FlagSet
+
+    assert {"serve.addr", "serve.refresh"} <= KNOWN_FLAGS
+    fs = _processor_flags(_gen_flags(_common_flags(FlagSet("t"))))
+    vals = fs.parse(["-serve.addr", ":0", "-serve.refresh", "0.5"])
+    assert vals["serve.addr"] == ":0"
+    assert vals["serve.refresh"] == 0.5

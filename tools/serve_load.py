@@ -1,0 +1,106 @@
+"""flowserve CI smoke: short load-gen leg against a live ingesting worker.
+
+`make serve-load` runs this. An in-process pipeline ingests a zipf
+stream spanning several 5-minute windows while the closed-loop load
+generator (serve/loadgen.py, 8 keep-alive reader threads) hammers
+/query/*. PASS requires:
+
+- nonzero qps (the serving path actually answered under ingest load),
+- zero 5xx responses and zero torn reads (every body parses, versions
+  monotone per connection — the load generator would surface transport
+  errors),
+- bounded snapshot age: the publisher kept refreshing while ingest ran
+  (max observed age < AGE_BOUND_S).
+
+Prints one JSON summary line; exits nonzero on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+FLOWS = 60_000
+THREADS = 8
+AGE_BOUND_S = 10.0
+
+
+def main() -> int:
+    from flow_pipeline_tpu.cli import (_batch_frames, _build_models,
+                                       _common_flags, _gen_flags,
+                                       _make_generator, _processor_flags)
+    from flow_pipeline_tpu.engine import StreamWorker, WorkerConfig
+    from flow_pipeline_tpu.serve import ServeServer, attach_worker
+    from flow_pipeline_tpu.serve.loadgen import (run_load, sample_ages,
+                                                 wait_ready)
+    from flow_pipeline_tpu.transport import Consumer, InProcessBus
+    from flow_pipeline_tpu.utils.flags import FlagSet
+
+    fs = _processor_flags(_gen_flags(_common_flags(FlagSet("serve-load"))))
+    # modeled 100 flows/s -> the 60k-flow stream spans ~600s of event
+    # time: windows close mid-run, so publishes exercise both triggers
+    vals = fs.parse(["-produce.profile", "zipf",
+                     "-produce.rate", "100"])
+    bus = InProcessBus()
+    bus.create_topic("flows", 2)
+    gen = _make_generator(vals)
+    produced = 0
+    while produced < FLOWS:
+        bus.produce_many("flows", _batch_frames(gen.batch(8192)))
+        produced += 8192
+    worker = StreamWorker(
+        Consumer(bus, fixedlen=True), _build_models(vals), [],
+        WorkerConfig(poll_max=8192, snapshot_every=0,
+                     ingest_native_group=True))
+    pub = attach_worker(worker, refresh=0.25)
+    server = ServeServer(pub.store, port=0).start()
+
+    stop = threading.Event()
+    t = threading.Thread(target=worker.run,
+                         kwargs={"stop_when_idle": True}, daemon=True)
+    t.start()
+    ok = wait_ready("127.0.0.1", server.port, timeout=60)
+    sampler, ages = sample_ages("127.0.0.1", server.port, stop)
+    threading.Thread(target=lambda: (t.join(), stop.set()),
+                     daemon=True).start()
+    load = run_load("127.0.0.1", server.port, threads=THREADS,
+                    duration=600.0, stop=stop)
+    t.join(timeout=600)
+    sampler.join(timeout=10)
+    server.stop()
+
+    n5xx = sum(n for c, n in load["codes"].items() if c.startswith("5"))
+    max_age = max(ages) if ages else None
+    checks = {
+        "server_ready": ok,
+        "nonzero_qps": load["qps"] > 0,
+        "zero_5xx": n5xx == 0,
+        "zero_transport_errors": load["errors"] == 0,
+        "snapshot_age_bounded": max_age is not None
+        and max_age < AGE_BOUND_S,
+        "snapshots_published": pub.store.current is not None
+        and pub.store.current.version > 1,
+    }
+    summary = {
+        "flows": FLOWS,
+        "flows_ingested": worker.flows_seen,
+        **load,
+        "snapshot_max_age_s": round(max_age, 3)
+        if max_age is not None else None,
+        "age_bound_s": AGE_BOUND_S,
+        "snapshot_version": pub.store.current.version
+        if pub.store.current else 0,
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
