@@ -5,7 +5,7 @@
 
 .PHONY: all native test bench proto clean services-test lint native-san \
 	hostsketch-parity fused-parity fused-parity-traced mesh-parity \
-	mesh-parity-traced serve-load
+	mesh-parity-traced serve-load audit-parity
 
 all: native
 
@@ -79,6 +79,16 @@ fused-parity-traced:
 	$(MAKE) -C native
 	FLOWTPU_TRACE=always JAX_PLATFORMS=cpu \
 		python -m pytest tests/test_fusedplane.py tests/test_flowtrace.py -v
+
+# sketchwatch (obs/audit.py): the accuracy-observability suite — the
+# audit must be purely observational (audit-on vs audit-off sink rows
+# bit-exact, single worker AND 4-worker mesh churn), per-member audit
+# partials must merge at the coordinator bit-equal to a single-worker
+# oracle's cohort (the same stream, the same deterministic key sample),
+# and the uint64-exact envelope must hold past 2^53
+# (docs/OBSERVABILITY.md "sketchwatch" states the contract).
+audit-parity:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_audit.py -v
 
 # flowserve smoke (serve/): an in-process worker ingests at full rate
 # while the 8-thread closed-loop load generator hammers /query/* —

@@ -25,6 +25,8 @@ Modes (default ``hh`` is what the driver records):
     python bench.py fused        # ingest.fused=off|on host-backend A/B
     python bench.py flowtrace    # -obs.trace=off|ring overhead A/B +
                                  # host_fused in-kernel phase breakdown
+    python bench.py audit        # -obs.audit=off|sample overhead A/B +
+                                 # sketchwatch error-vs-fill sweep
     python bench.py sharded [n]  # n-device mesh rate + merge cost
     python bench.py mesh         # flowmesh 1/2/4-worker scaling curve
     python bench.py serve        # flowserve: concurrent query load
@@ -413,7 +415,8 @@ def _phase_breakdown(before: dict, after: dict,
 def _run_e2e(n_flows: int, samples: int = 5,
              ingest_mode: str = "pipelined",
              sketch_backend: str = "device",
-             ingest_fused: str = "off") -> dict:
+             ingest_fused: str = "off",
+             obs_audit: str = "off") -> dict:
     """Shared e2e measurement: stats + per-stage budget (VERDICT r3 #1).
 
     The budget diffs the stage summaries across the timed samples and
@@ -461,7 +464,8 @@ def _run_e2e(n_flows: int, samples: int = 5,
                          ingest_mode=ingest_mode,
                          sketch_backend=sketch_backend,
                          ingest_native_group=True,
-                         ingest_fused=ingest_fused),
+                         ingest_fused=ingest_fused,
+                         obs_audit=obs_audit),
         )
         t0 = time.perf_counter()
         worker.run(stop_when_idle=True)  # incl. finalize: closes + flushes
@@ -738,6 +742,165 @@ def bench_flowtrace() -> None:
         **_host_conditions(),
     }))
     TRACER.configure(os.environ.get("FLOWTPU_TRACE", "ring"))
+
+
+AUDIT_PAIRS = 4
+AUDIT_SWEEP_WIDTHS = (1 << 16, 1 << 10, 1 << 7)
+AUDIT_SWEEP_KEYS = 4096
+AUDIT_SWEEP_CHUNKS = 8
+
+
+def _audit_fill_sweep() -> list[dict]:
+    """Error-vs-fill curve: the SAME zipf key stream through one hh
+    family at shrinking CMS widths, audited in full mode. As fill
+    grows the count-min epsilon bound loosens and the sampled-cohort
+    relative error must grow with it; at the widest point (fill ~
+    keys/width << 1, conservative update) the audit must report the
+    exact regime — error 0. This is the live analogue of HashPipe's
+    accuracy curves (1611.04825) and the standing acceptance instrument
+    for new sketch families."""
+    import numpy as np
+
+    from flow_pipeline_tpu.hostsketch.engine import HostSketchEngine
+    from flow_pipeline_tpu.models.heavy_hitter import HeavyHitterConfig
+    from flow_pipeline_tpu.obs.audit import SketchAudit
+
+    rng = np.random.default_rng(7)
+    # zipf-ish key universe with two uint32 lanes, integer byte counts
+    zipf = rng.zipf(1.2, size=AUDIT_SWEEP_KEYS * AUDIT_SWEEP_CHUNKS)
+    key_ids = (zipf % AUDIT_SWEEP_KEYS).astype(np.uint32)
+    lanes_all = np.stack([key_ids * np.uint32(2654435761),
+                          key_ids ^ np.uint32(0x9E3779B9)], axis=1)
+    vals_all = rng.integers(40, 1500, size=len(key_ids)).astype(
+        np.float32)
+    points = []
+    for width in AUDIT_SWEEP_WIDTHS:
+        cfg = HeavyHitterConfig(key_cols=("src_as", "dst_as"),
+                                batch_size=AUDIT_SWEEP_KEYS,
+                                width=width, capacity=256)
+        engine = HostSketchEngine([cfg], use_native="numpy")
+        engine.reset(0)
+        audit = SketchAudit({"sweep": (cfg, 64)}, mode="full")
+        for c in range(AUDIT_SWEEP_CHUNKS):
+            sl = slice(c * AUDIT_SWEEP_KEYS, (c + 1) * AUDIT_SWEEP_KEYS)
+            lanes, vals = lanes_all[sl], vals_all[sl]
+            # group the chunk exactly like the prepare half would
+            order = np.lexsort(lanes.T[::-1])
+            sk = lanes[order]
+            bound = np.ones(len(sk), bool)
+            bound[1:] = (sk[1:] != sk[:-1]).any(axis=1)
+            starts = np.flatnonzero(bound)
+            uniq = np.ascontiguousarray(sk[starts])
+            vsum = np.add.reduceat(vals[order].astype(np.float64),
+                                   starts).astype(np.float32)
+            cnt = np.diff(np.append(starts, len(sk))).astype(np.float32)
+            sums = np.stack([vsum, vsum, cnt], axis=1)  # bytes/packets/n
+            engine.update(0, uniq, sums, len(uniq))
+            audit.observe_grouped("sweep", uniq, sums, len(uniq))
+        part = audit.take_partial("sweep")
+        from flow_pipeline_tpu.obs.audit import audit_report
+
+        report = audit_report(part["keys"], part["vals"],
+                              engine.states[0], cfg, 64, scale=1)
+        report.pop("_cms_ratios", None)
+        report.pop("_table_ratios", None)
+        points.append({
+            "width": width,
+            "fill_ratio": report["fill_ratio"][-1],
+            "cms_err_p50": report["cms_err"]["p50"],
+            "cms_err_p99": report["cms_err"]["p99"],
+            "sampled_keys": report["sampled_keys"],
+            "recall_at_k": report["recall_at_k"],
+        })
+    return points
+
+
+def bench_audit() -> None:
+    """sketchwatch acceptance artifact (BENCH_r15): (1) paired
+    audit-off vs audit-sample e2e A/B on the fastest dataplane —
+    alternating leg order, the r11 methodology; budget <2% like
+    flowtrace, because an accuracy watch that taxes the hot path does
+    not stay always-on; (2) the error-vs-fill sweep — sampled-cohort
+    CMS relative error must GROW with fill and report 0 in the exact
+    regime, matching the analytic epsilon-bound direction."""
+    global _NATIVE
+    _NATIVE = _ensure_native()
+    from flow_pipeline_tpu import native as native_lib
+
+    fused_mode = "on" if native_lib.fused_available() else "off"
+    off_rates, on_rates, ratios, shares = [], [], [], []
+
+    def leg(mode):
+        return _run_e2e(E2E_FLOWS, samples=1, sketch_backend="host",
+                        ingest_fused=fused_mode, obs_audit=mode)
+
+    for i in range(AUDIT_PAIRS):
+        if i % 2 == 0:
+            off, on = leg("off"), leg("sample")
+        else:
+            on, off = leg("sample"), leg("off")
+        off_rates.append(off["value"])
+        on_rates.append(on["value"])
+        # the budget statistic: the audit is timed as its own pipeline
+        # stage, so its share of wall is measured WITHIN each audited
+        # leg — robust to the cross-leg frequency drift that dominates
+        # 2-core bench boxes (the r06/r12 caveat; observed >40% swings
+        # BETWEEN legs against a ~1% effect)
+        shares.append(on["stages"].get("sketch_audit",
+                                       {}).get("share_pct", 0.0))
+        if off["value"]:
+            ratios.append(1 - on["value"] / off["value"])
+    overhead = 100 * statistics.median(ratios) if ratios else 0.0
+    share = statistics.median(shares) if shares else 0.0
+    # the close evaluation is a once-per-window lump (CMS freeze + fill
+    # scan + report): reported as total wall over the leg — this stream
+    # packs ONE 300s window per hh family into ~a second of bench wall,
+    # so charging it as a share would overstate production cost ~300x
+    audit_close_ms = round(
+        on["stages"].get("sketch_audit_close", {}).get("us_per_kflow",
+                                                       0.0)
+        * E2E_FLOWS / 1000 / 1000, 2)
+    sweep = _audit_fill_sweep()
+    errs = [p["cms_err_p99"] for p in sweep]
+    fills = [p["fill_ratio"] for p in sweep]
+    print(json.dumps({
+        "metric": "e2e sketchwatch audit overhead A/B "
+                  "(-obs.audit=off vs sample) + error-vs-fill sweep",
+        "unit": "flows/sec",
+        "value": round(statistics.median(on_rates), 1),
+        "off_flows_per_sec": round(statistics.median(off_rates), 1),
+        "sample_flows_per_sec": round(statistics.median(on_rates), 1),
+        "audit_share_pct": round(share, 2),
+        "audit_share_pairs_pct": [round(s, 2) for s in shares],
+        "audit_close_ms_per_leg": audit_close_ms,
+        "audit_overhead_pct": round(overhead, 2),
+        "audit_overhead_pairs_pct": [round(100 * r, 2) for r in ratios],
+        "overhead_budget_pct": 2.0,
+        "within_budget": share < 2.0,
+        "error_vs_fill": sweep,
+        # the two acceptance directions: error grows as fill grows
+        # (widths shrink left to right), and the widest point is the
+        # exact regime (error 0)
+        "error_monotone_with_fill": errs == sorted(errs)
+        and fills == sorted(fills),
+        "exact_regime_error_zero": errs[0] == 0.0,
+        "ingest_fused": fused_mode,
+        "native_capabilities": native_lib.capabilities(),
+        "platform": _PLATFORM,
+        "host_note": (
+            "audit_share_pct is the budget statistic: the CONTINUOUS "
+            "per-chunk observation cost, timed as its own stage INSIDE "
+            "each audited leg — immune to the cross-leg frequency "
+            "drift this 2-core box class shows (legs observed swinging "
+            ">40% both directions against a ~1% effect; r06/r12 "
+            "caveat). audit_close_ms_per_leg is the once-per-WINDOW "
+            "close evaluation (one 300s window per hh family packed "
+            "into ~a second of bench wall here — in production it "
+            "amortizes over the window). The paired A/B is recorded "
+            "for completeness; the sweep's error direction is "
+            "box-independent"),
+        **_host_conditions(),
+    }))
 
 
 def bench_e2e() -> None:
@@ -1392,6 +1555,8 @@ if __name__ == "__main__":
         bench_fused()
     elif mode == "flowtrace":
         bench_flowtrace()
+    elif mode == "audit":
+        bench_audit()
     elif mode == "sharded":
         bench_sharded(int(sys.argv[2]) if len(sys.argv) > 2 else 8)
     elif mode == "mesh":

@@ -266,6 +266,12 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
               "flowtrace per-chunk span recorder: ring (flight recorder, "
               "<2% overhead — dump via /debug/trace or on worker error) "
               "| always (retain every span; CI/diagnostics only) | off")
+    fs.string("obs.audit", "sample",
+              "sketchwatch sampled exact shadow audit (sketch accuracy "
+              "observability): sample (deterministic ~1/256 key cohort, "
+              "<2% overhead — error/recall/saturation metrics per "
+              "window close, /query/audit on flowserve) | full (every "
+              "key; tests and sweeps) | off")
     fs.string("sink", "stdout", "stdout | sqlite:PATH | postgres:DSN | "
                                 "clickhouse:URL (comma separated)")
     # flowmesh (mesh/): N-worker sharded sketch mesh with window-close
@@ -427,6 +433,7 @@ def _worker_config(vals) -> "WorkerConfig":
         ingest_flush_queue=vals["ingest.flush_queue"],
         ingest_native_group=vals["ingest.native_group"],
         ingest_fused=vals["ingest.fused"],
+        obs_audit=vals["obs.audit"],
     )
 
 

@@ -105,6 +105,11 @@ class PreparedChunk(NamedTuple):
     # AND sketch updates all happen in ONE native pass at apply time, so
     # no hh group tables are materialized here. None = staged path.
     fused_in: Optional[list] = None
+    # sketchwatch pre-extraction (obs/audit.py): per hh family
+    # (name, (sampled rows, u64 addends) | None), computed on the
+    # GROUP thread (pure hash+mask work) so the worker thread only pays
+    # the uint64 fold. None = audit off, or an unsplit caller.
+    audit_in: Optional[list] = None
 
 
 class PreparedBatch(NamedTuple):
@@ -209,9 +214,29 @@ class HostGroupPipeline(FusedPipeline):
 
     def __init__(self, models: dict, shards: int = 0,
                  native_group: bool = False,
-                 pool: Optional[ShardPool] = None):
+                 pool: Optional[ShardPool] = None,
+                 audit: str = "off"):
         super().__init__(models)
         self.stages = StageTimer()
+        # sketchwatch (-obs.audit, obs/audit.py): the sampled exact
+        # shadow audit rides the host-grouped pipelines — observation
+        # consumes the group tables (staged) or raw lanes (fused) this
+        # pipeline already materializes, and window closes seal the
+        # cohort via the wrapped models' audit_hook. Purely
+        # observational: `make audit-parity` pins audit-on/off sink
+        # rows bit-exact.
+        if audit not in ("off", "sample", "full"):
+            raise ValueError(
+                f"audit must be off|sample|full, got {audit!r}")
+        self.audit = None
+        if audit != "off" and self._hh:
+            from ..obs.audit import SketchAudit
+
+            self.audit = SketchAudit(
+                {name: (w.config, w.k) for name, w in self._hh},
+                mode=audit)
+            for name, w in self._hh:
+                w.audit_hook = self._audit_close_hook(name)
         # Grouping backends (ingest runtime knobs): shards=1 disables the
         # sharded path entirely; 0 sizes it to the pool. native_group
         # requests the C hash-group kernel and quietly degrades to numpy
@@ -309,7 +334,14 @@ class HostGroupPipeline(FusedPipeline):
             return PreparedChunk(wagg, None, None, None)
         fams = (self._group_families(cols)
                 if (self._hh or self._ddos) else None)
-        return PreparedChunk(wagg, *self._prep_device(cols, fams, n))
+        prep = PreparedChunk(wagg, *self._prep_device(cols, fams, n))
+        if self.audit is not None and prep.hh_in is not None:
+            # audit pre-extraction rides the prepare half (group
+            # thread) exactly like the tables it samples from
+            prep = prep._replace(audit_in=[
+                (name, self.audit.prepare_grouped(name, u, s, g))
+                for (name, _), (u, s, g) in zip(self._hh, prep.hh_in)])
+        return prep
 
     def _group(self, lanes, planes, exact):
         return group_by_key_sharded(lanes, planes, self._pool,
@@ -459,12 +491,56 @@ class HostGroupPipeline(FusedPipeline):
                 if not (do_hh or do_dd):
                     continue  # late part: device models take nothing
                 self._timed_apply_chunk(ch, do_hh, do_dd)
+                if do_hh and self.audit is not None:
+                    # after the fold, mirroring the sketch's own gating:
+                    # the shadow cohort covers exactly the rows the
+                    # sketches took (late parts fold nowhere). Timed as
+                    # its own stage: the audit's budget is measured
+                    # in-run (share of wall), not inferred from paired
+                    # A/B legs a 2-core box's frequency drift swamps
+                    self._audit_chunk_timed(ch)
         for _, m in self._waggs:
             if prep.watermark > m.watermark:
                 m.watermark = prep.watermark
 
     def update(self, batch: FlowBatch) -> None:
         self.apply(self.prepare(batch))
+
+    # ---- sketchwatch hooks -------------------------------------------------
+
+    def _audit_close_hook(self, name: str):
+        """Per-family window-close hook handed to the wrapped model:
+        seals the sampled cohort against the closing state (or ships it
+        to the mesh member's capture)."""
+        def hook(slot, model):
+            # its own stage, separate from the per-chunk observation:
+            # the close evaluation (CMS freeze + fill scan + report) is
+            # a once-per-WINDOW lump, not a continuous hot-path tax —
+            # budgeting them together would charge a 300s window's
+            # close against whatever wall the bench stream compressed
+            # that window into
+            with self.stages.stage("sketch_audit_close"):
+                self.audit.on_close(name, slot, model)
+        return hook
+
+    def _audit_chunk_timed(self, ch: PreparedChunk) -> None:
+        with self.stages.stage("sketch_audit"):
+            self._audit_chunk(ch)
+
+    def _audit_chunk(self, ch: PreparedChunk) -> None:
+        """Feed one applied chunk to the shadow audit: fold the
+        pre-extracted cohort rows when the prepare half supplied them,
+        else extract here (serial/unsplit callers). The staged tables
+        carry group-summed planes; the audit's uint64 fold makes the
+        granularity irrelevant on the exact envelope."""
+        if ch.audit_in is not None:
+            for name, prepared in ch.audit_in:
+                self.audit.fold_prepared(name, prepared)
+            return
+        if ch.hh_in is None:
+            return
+        for (name, _), (u, s, g) in zip(self._hh, ch.hh_in):
+            self.audit.observe_grouped(name, u, s, g)
 
     def _timed_apply_chunk(self, ch: PreparedChunk, do_hh: bool,
                            do_dd: bool) -> None:

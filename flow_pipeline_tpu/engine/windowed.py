@@ -59,6 +59,12 @@ class WindowedHeavyHitter:
         # merged sketch. None (the default) keeps the single-worker
         # behavior byte-identical.
         self.capture = None
+        # sketchwatch seam (obs/audit.py): when set, a window close
+        # first hands (closing slot, backing model) to the audit so the
+        # sampled exact shadow cohort is sealed against EXACTLY the
+        # state being closed — before capture/extraction/reset. Fires
+        # on every close path (slot roll, forced flush, mesh resync).
+        self.audit_hook = None
         # Ingest-runtime knob (engine.worker sets it in pipelined mode):
         # close windows as LazyWindowTop handles so extraction runs on
         # the background flusher instead of the update path. Only honored
@@ -99,6 +105,8 @@ class WindowedHeavyHitter:
             self.model.update(part)
 
     def _close(self) -> None:
+        if self.audit_hook is not None:
+            self.audit_hook(self.current_slot, self.model)
         if self.capture is not None:
             # mesh member: ship the window's raw sketch state; no local
             # row extraction (the coordinator extracts from the merge)

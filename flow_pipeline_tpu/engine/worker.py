@@ -90,6 +90,14 @@ class WorkerConfig:
     # pre-aggregated tables are the serving path; raw rows are for
     # drill-down/audit and cost one row per flow.
     archive_raw: bool = False
+    # sketchwatch (-obs.audit, obs/audit.py): the sampled exact shadow
+    # audit measuring how wrong the sketches are. "sample" keeps exact
+    # uint64 counts for a deterministic ~1/256 key cohort and publishes
+    # relative-error/recall/saturation metrics at every window close;
+    # "full" audits every key (tests, the error-vs-fill sweep); "off"
+    # disables. Needs the host-grouped pipeline (CPU backend or
+    # -processor.hostassist on) — elsewhere it quietly stays off.
+    obs_audit: str = "sample"
     # The role this worker's flow_build_info identity gauge publishes
     # under. A mesh member's INNER worker must identify as "member" —
     # publishing a second role="worker" series next to the member's
@@ -133,6 +141,10 @@ class StreamWorker:
             raise ValueError(
                 "ingest_fused='on' requires sketch_backend='host' — the "
                 "fused pass updates the host sketch engine in place")
+        if config.obs_audit not in ("off", "sample", "full"):
+            raise ValueError(
+                f"obs_audit must be off|sample|full, "
+                f"got {config.obs_audit!r}")
         self.fused = None
         if config.fused and models:
             from .fused import FusedPipeline
@@ -146,7 +158,8 @@ class StreamWorker:
                     self.fused = HostSketchPipeline(
                         models, shards=config.ingest_shards,
                         native_group=config.ingest_native_group,
-                        fused=config.ingest_fused)
+                        fused=config.ingest_fused,
+                        audit=config.obs_audit)
                 elif config.sketch_backend == "host":
                     # the host engine consumes the host-grouped prepare
                     # tables; without them there is nothing to feed it
@@ -158,7 +171,8 @@ class StreamWorker:
                 elif host_grouped:
                     self.fused = HostGroupPipeline(
                         models, shards=config.ingest_shards,
-                        native_group=config.ingest_native_group)
+                        native_group=config.ingest_native_group,
+                        audit=config.obs_audit)
                 else:
                     self.fused = FusedPipeline(models)
             else:
@@ -261,6 +275,38 @@ class StreamWorker:
         REGISTRY.counter(*PHASE_COUNTERS["host_fused"])
         REGISTRY.counter(*ROWS_COUNTER)
         REGISTRY.counter(*GROUPS_COUNTER)
+        # the degradation gauge likewise: the NativePathDegraded alert
+        # must resolve against every worker's /metrics, not only those
+        # whose pipeline selection happened to touch a native feature
+        from .hostfused import _DEGRADED_GAUGE
+
+        REGISTRY.gauge(*_DEGRADED_GAUGE)
+        # sketchwatch families likewise registered eagerly (as zeros) on
+        # every worker — the dashboard/alert honesty tests resolve the
+        # sketch-health surface against this registration
+        from ..obs.audit import register_audit_metrics
+
+        register_audit_metrics()
+        if config.obs_audit != "off" and \
+                getattr(self.fused, "audit", None) is None and models:
+            has_hh = any(
+                isinstance(m, WindowedHeavyHitter)
+                and getattr(m.model, "snapshot_kind", None)
+                == "windowed_hh" for m in models.values())
+            if not has_hh:
+                # nothing sketch-backed to audit (dense/exact models
+                # only) — flipping pipeline knobs would not change that
+                log.info("obs.audit=%s: no sketch-backed families in "
+                         "the model set; nothing to audit",
+                         config.obs_audit)
+            else:
+                # the audit consumes the host-grouped pipelines'
+                # tables; the device-sorted/per-model paths have
+                # nothing to feed it
+                log.info("obs.audit=%s needs the host-grouped pipeline "
+                         "(CPU backend or -processor.hostassist on); "
+                         "sketch accuracy audit is off for this worker",
+                         config.obs_audit)
         # runtime identity: what this worker ACTUALLY runs (native
         # capability set, trace mode, sketch backend) — dashboards and
         # bench artifacts join against it instead of trusting flags

@@ -107,9 +107,10 @@ class HostSketchPipeline(HostGroupPipeline):
                  native_group: bool = False,
                  pool: Optional[ShardPool] = None,
                  sketch_native: str = "auto",
-                 fused: str = "auto"):
+                 fused: str = "auto",
+                 audit: str = "off"):
         super().__init__(models, shards=shards, native_group=native_group,
-                         pool=pool)
+                         pool=pool, audit=audit)
         self._engine = HostSketchEngine(
             [w.config for _, w in self._hh], use_native=sketch_native)
         if not self._engine.native and sketch_native != "numpy":
@@ -139,6 +140,8 @@ class HostSketchPipeline(HostGroupPipeline):
         # half (fused pass / staged engine) runs on the worker thread,
         # the prepare half (ff_group_sum) on the ingest group thread —
         # sharing one buffer would race the accumulation.
+        # flowlint: unguarded -- worker thread only (audited chunk counter for the throttled churn probe)
+        self._audit_chunks = 0
         # flowlint: unguarded -- worker thread only (apply half)
         self._apply_stats = None
         # flowlint: unguarded -- group thread only (prepare half)
@@ -270,8 +273,34 @@ class HostSketchPipeline(HostGroupPipeline):
                 _value_planes_np(cols, cfg.value_cols, cfg.scale_col),
                 dtype=np.float32)
             fused_in.append((lanes, vals))
+        audit_in = None
+        if self.audit is not None:
+            # audit pre-extraction on the prepare half (group thread):
+            # the per-family hash+mask over raw lanes is the audit's
+            # whole hot-path cost, and it overlaps the worker here
+            audit_in = [(name, self.audit.prepare_rows(name, fl, vals))
+                        for tree, (lanes, vals) in zip(self._fused_trees,
+                                                       fused_in)
+                        for name, fl in self._audit_family_lanes(tree,
+                                                                 lanes)]
         return PreparedChunk(wagg, None, self._prep_dense(cols, n),
-                             ddos_in, fused_in)
+                             ddos_in, fused_in, audit_in)
+
+    def _audit_family_lanes(self, tree, lanes: np.ndarray):
+        """Yield (family name, key-lane view) for every member of one
+        fused tree: the root consumes the raw lanes, each cascade
+        member its (possibly chained) parent's lane projection. Strided
+        VIEWS only — the audit copies just the sampled subset. The ONE
+        definition of the projection rule, shared by the prepare-half
+        pre-extraction and the unsplit _audit_chunk fallback."""
+        ms, plan = tree
+        proj = [lanes]
+        for k, fam in enumerate(ms):
+            if k > 0:
+                sel = [int(x) for x in plan.sel[
+                    int(plan.sel_off[k]):int(plan.sel_off[k + 1])]]
+                proj.append(proj[int(plan.parent[k])][:, sel])
+            yield self._hh[fam][0], proj[k]
 
     def _group_exact_planes(self, lanes: np.ndarray, planes: np.ndarray):
         if self._fused:
@@ -383,6 +412,36 @@ class HostSketchPipeline(HostGroupPipeline):
                     w.model.totals = tot
             for (_, d), st in zip(self._ddos, new_ddos):
                 d.state = st
+
+    # ---- sketchwatch hooks -------------------------------------------------
+
+    def _audit_chunk(self, ch: PreparedChunk) -> None:
+        """Fused chunks carry RAW rows (no group tables surface to
+        Python): the root family audits the lanes directly, cascade
+        members audit their parent's lane projection — each raw row
+        contributes its per-row uint64 addend plus count 1, which on
+        the exact envelope telescopes to the same totals the staged
+        group tables fold (obs/audit.py states the argument). The
+        prepare half normally pre-extracts (ch.audit_in, group
+        thread); the raw-rows path below covers unsplit callers."""
+        if ch.audit_in is not None or ch.fused_in is None:
+            super()._audit_chunk(ch)
+        else:
+            for tree, (lanes, vals) in zip(self._fused_trees,
+                                           ch.fused_in):
+                for name, fl in self._audit_family_lanes(tree, lanes):
+                    self.audit.observe_rows(name, fl, vals)
+        # admission-churn probe off the host-resident tables (the
+        # engine's buffers — current after the fold above, no sync).
+        # Every 8th chunk: churn is a rate signal, not part of the
+        # exactness envelope, and hashing capacity rows per family per
+        # chunk is pure audit overhead otherwise
+        self._audit_chunks += 1
+        if self._audit_chunks % 8 == 1:
+            for i, (name, _) in enumerate(self._hh):
+                st = self._engine.states[i]
+                if st is not None:
+                    self.audit.note_table(name, st.table_keys)
 
     # ---- state synchronization --------------------------------------------
 

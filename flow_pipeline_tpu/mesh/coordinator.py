@@ -50,6 +50,8 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
 from ..obs import REGISTRY, get_logger
+from ..obs.audit import (audit_report, publish_report,
+                         register_audit_metrics)
 from ..obs.buildinfo import publish_build_info
 from ..obs.trace import TRACER
 from . import codec
@@ -87,6 +89,12 @@ MERGED_LEDGER_SLOTS = 16
 # ledger, looser bound: a lineage record is a few hundred bytes of
 # metadata, not row sets, so more history fits the same budget).
 LINEAGE_SLOTS = 64
+
+# Merged-audit-cohort retention, per model (sketchwatch): the newest
+# slots' merged sampled-exact counters, kept for the mesh-vs-oracle
+# bit-equality gate and /query/audit debugging. Cohorts are ~1/256 of
+# keys — small, but still bounded like every other ledger here.
+AUDIT_LEDGER_SLOTS = 16
 
 # Metric name/help specs live here once; the deploy honesty test
 # resolves the Grafana mesh panels against a constructed coordinator.
@@ -262,6 +270,15 @@ class MeshCoordinator:
         self._m["partitions"].set(self.n_partitions)
         self._m["members"].set(0)
         self._m["epoch"].set(0)
+        # sketchwatch: merged-cohort audit state. Metrics registered
+        # eagerly (the coordinator process publishes the NETWORK-WIDE
+        # sketch_* families; members keep their own processes' series).
+        # flowlint: unguarded -- registered once here, read-only after
+        self._audit_m = register_audit_metrics()
+        # (model, slot) -> merged audit partial {keys, vals, evictions}
+        self.audit_merged: dict[tuple[str, int], dict] = {}  # guarded-by: _merge_lock
+        # model -> newest JSON-safe network-wide audit report
+        self._audit_reports: dict[str, dict] = {}  # guarded-by: _merge_lock
         publish_build_info("coordinator")
 
     # ---- membership -------------------------------------------------------
@@ -319,6 +336,7 @@ class MeshCoordinator:
                 # departed laggard's frozen skew must not keep paging
                 self._m["member_wm"].remove(member=member_id)
                 self._m["wm_skew"].remove(member=member_id)
+                self._m["sub2merge_s"].remove(member=member_id)
                 self._publish_watermarks_locked()
         if fold:
             self._run_merges(fold)
@@ -385,6 +403,7 @@ class MeshCoordinator:
         # reads as an eternally stalled shard on the dashboards
         self._m["member_wm"].remove(member=member_id)
         self._m["wm_skew"].remove(member=member_id)
+        self._m["sub2merge_s"].remove(member=member_id)
         self._publish_watermarks_locked()
         log.warning("mesh member %s fenced (%s); epoch now %d",
                     member_id, reason, self.epoch)
@@ -778,8 +797,14 @@ class MeshCoordinator:
                                   contribs=len(new_contribs))
                 for c in new_contribs:
                     if c.get("accepted") is not None:
+                        # labeled by member: a slow shard's submit->merge
+                        # tail is its own series (and is REMOVED when
+                        # the member is fenced/leaves — Histogram.remove
+                        # mirrors the r13 Gauge.remove fix, so a dead
+                        # member's frozen latency never pages)
                         self._m["sub2merge_s"].observe(
-                            max(0.0, t_merged - c["accepted"]))
+                            max(0.0, t_merged - c["accepted"]),
+                            member=str(c.get("member") or "unknown"))
             log.info("mesh merged window model=%s slot=%d contribs=%d",
                      name, slot, len(payloads))
         if ready and self.serve is not None:
@@ -854,8 +879,7 @@ class MeshCoordinator:
             return int(len(ts)) if ts is not None else 0
         return len(rows)
 
-    @staticmethod
-    def _merge_one(spec: ModelSpec, slot: int, payloads: list) -> dict:
+    def _merge_one(self, spec: ModelSpec, slot: int, payloads: list) -> dict:
         if spec.kind == "wagg":
             from ..models.window_agg import rows_from_stores
 
@@ -863,9 +887,49 @@ class MeshCoordinator:
             return rows_from_stores(spec.config, [(slot, store)])
         if spec.kind == "hh":
             merged = merge_ops.merge_hh(payloads, spec.config)
+            audit = merged.get("audit")
+            if audit is not None:
+                self._audit_merged_window(spec, slot, merged, audit)
             return merge_ops.hh_top_rows(merged, spec.config, spec.k, slot)
         totals = merge_ops.merge_dense(payloads)
         return merge_ops.dense_top_rows(totals, spec.config, spec.k, slot)
+
+    def _audit_merged_window(self, spec: ModelSpec, slot: int,
+                             merged: dict, audit: dict) -> None:
+        """sketchwatch, network-wide: the members shipped per-shard
+        sampled exact cohorts inside their hh payloads; merge_hh folded
+        them (uint64 per-key sums — the same linearity as the CMS).
+        Audit the MERGED sketch against the MERGED cohort, so the error
+        metrics this coordinator publishes describe the network-wide
+        answer — not any one shard's."""
+        report = audit_report(audit["keys"], audit["vals"], merged,
+                              spec.config, spec.k or spec.config.capacity,
+                              slot=slot, scale=int(audit.get("scale", 1)))
+        evictions = int(audit.get("evictions", 0))
+        if evictions:
+            self._audit_m["evictions"].inc(evictions, family=spec.name)
+        report["evictions"] = evictions
+        report = publish_report(spec.name, report,
+                                metrics=self._audit_m)
+        with self._merge_lock:
+            self._audit_reports[spec.name] = report
+            self.audit_merged[(spec.name, slot)] = audit
+            slots = sorted(s for n, s in self.audit_merged
+                           if n == spec.name)
+            for s in slots[:-AUDIT_LEDGER_SLOTS]:
+                del self.audit_merged[(spec.name, s)]
+
+    def audit_reports(self) -> dict:
+        """{model: newest network-wide audit report} — the flowserve
+        snapshot's /query/audit view of the mesh."""
+        with self._merge_lock:
+            return dict(self._audit_reports)
+
+    def audit_cohort(self, name: str, slot: int) -> Optional[dict]:
+        """Merged audit partial for one (model, slot) — the ledger the
+        mesh-vs-oracle bit-equality gate reads."""
+        with self._merge_lock:
+            return self.audit_merged.get((name, slot))
 
     # ---- live queries (mesh-aware /topk) ----------------------------------
 

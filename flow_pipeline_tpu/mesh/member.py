@@ -83,6 +83,12 @@ class MeshMember:
         # slot -> {model: payload}: closed windows since the last submit
         # flowlint: unguarded -- driver thread only (capture hooks run inside run_once on this thread)
         self._captured: dict[int, dict] = {}
+        # sketchwatch: slot -> {model: audit partial} sealed at the same
+        # window closes — attached INSIDE the hh payloads at submit so
+        # per-member exact cohorts merge at the coordinator as uint64
+        # sums (network-wide accuracy, not per-shard)
+        # flowlint: unguarded -- driver thread only (audit capture fires inside run_once on this thread)
+        self._audit_captured: dict[int, dict] = {}
         # flowlint: unguarded -- driver thread only
         self._flows_reported = 0
         # flowlint: unguarded -- driver thread only
@@ -131,6 +137,11 @@ class MeshMember:
             self._captured.setdefault(int(slot), {})[name] = \
                 codec.capture_model(model)
         return capture
+
+    def _audit_capture(self, name: str, slot: int, part: dict) -> None:
+        """SketchAudit capture hook — fires inside the model's close,
+        immediately before the model capture for the same window."""
+        self._audit_captured.setdefault(int(slot), {})[name] = part
 
     # ---- assignment lifecycle --------------------------------------------
 
@@ -185,6 +196,13 @@ class MeshMember:
         self._install_hooks(models)
         self.worker = StreamWorker(consumer, models, self.sinks,
                                    self.config)
+        self._audit_captured = {}
+        aud = getattr(self.worker.fused, "audit", None)
+        if aud is not None:
+            # mesh citizenship: closes ship the sealed cohort here
+            # instead of evaluating per-shard — the coordinator audits
+            # the MERGED sketch against the MERGED cohort
+            aud.capture = self._audit_capture
         self._flows_reported = 0
         self._batches_since_submit = 0
         # fresh ownership means fresh (possibly large) backlog: the
@@ -209,6 +227,7 @@ class MeshMember:
                 "closed": {}, "open": {}, "flows": 0, "release": True,
                 "final": False, "span": self._next_span((), ())}))
         self._captured = {}
+        self._audit_captured = {}
         self._frontier = {}
 
     def _abandon(self) -> None:
@@ -216,6 +235,7 @@ class MeshMember:
         threads; state is discarded — the successor replays our rows."""
         w, self.worker = self.worker, None
         self._captured = {}
+        self._audit_captured = {}
         self._frontier = {}
         if w is not None:
             self._stop_worker_threads(w)
@@ -268,8 +288,16 @@ class MeshMember:
                         codec.wagg_payload(store)
             elif isinstance(m, WindowedHeavyHitter) and \
                     m.current_slot is not None:
-                out.setdefault(int(m.current_slot), {})[name] = \
-                    codec.capture_model(m.model)
+                payload = codec.capture_model(m.model)
+                aud = getattr(w.fused, "audit", None)
+                if aud is not None and payload.get("kind") == "hh":
+                    # the carry must snapshot the open cohort too:
+                    # a promoted carry's audit partial has to cover
+                    # exactly the rows its sketch state covers
+                    part = aud.peek_partial(name)
+                    if part is not None:
+                        payload["audit"] = part
+                out.setdefault(int(m.current_slot), {})[name] = payload
         return out
 
     def _next_span(self, closed_slots, open_slots,
@@ -295,6 +323,13 @@ class MeshMember:
         if w is None:
             return True
         closed, self._captured = self._captured, {}
+        audit_closed, self._audit_captured = self._audit_captured, {}
+        for slot, models in closed.items():
+            for name, model_payload in models.items():
+                part = audit_closed.get(slot, {}).get(name)
+                if part is not None and \
+                        model_payload.get("kind") == "hh":
+                    model_payload["audit"] = part
         with w.lock:
             w.sync_sketch_states()
             # final/release submissions follow a worker.finalize(): every

@@ -90,8 +90,37 @@ def merge_hh(payloads: list[dict], config: HeavyHitterConfig) -> dict:
         top = np.argsort(-sums[:, 0], kind="stable")[:config.capacity]
         new_keys[:len(top)] = uniq[top]
         new_vals[:len(top)] = sums[top]
-    return {"kind": "hh", "cms": cms, "table_keys": new_keys,
-            "table_vals": new_vals}
+    out = {"kind": "hh", "cms": cms, "table_keys": new_keys,
+           "table_vals": new_vals}
+    # sketchwatch: per-member sampled exact cohorts ride inside the hh
+    # payloads; their fold is the same uint64 per-key sum the CMS
+    # linearity argument rests on — the merged cohort IS the cohort a
+    # single worker seeing the whole stream would have built
+    audits = [p["audit"] for p in payloads if p.get("audit") is not None]
+    if audits:
+        out["audit"] = merge_audit(audits)
+    return out
+
+
+def merge_audit(parts: list[dict]) -> dict:
+    """Fold audit partials ({keys [K, W] u32, vals [K, P+1] u64}) into
+    one: per-key uint64 sums, keys in lexicographic order (the same
+    canonical order members serialize, so merge(one part) == the part
+    bit-for-bit and the mesh-vs-oracle equality is array equality)."""
+    real = [p for p in parts if len(p["keys"])]
+    evictions = int(sum(int(p.get("evictions", 0)) for p in parts))
+    scale = int(max(int(p.get("scale", 1)) for p in parts))
+    if not real:
+        first = parts[0]
+        return {"keys": first["keys"][:0].astype(np.uint32),
+                "vals": first["vals"][:0].astype(np.uint64),
+                "evictions": evictions, "scale": scale}
+    keys = np.concatenate([p["keys"].astype(np.uint32) for p in real])
+    vals = np.concatenate([p["vals"].astype(np.uint64) for p in real])
+    order, starts = _lex_regroup(keys)
+    return {"keys": np.ascontiguousarray(keys[order][starts]),
+            "vals": np.add.reduceat(vals[order], starts, axis=0),
+            "evictions": evictions, "scale": scale}
 
 
 def hh_top_rows(merged: dict, config: HeavyHitterConfig, k: int,

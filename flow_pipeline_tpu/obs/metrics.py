@@ -231,6 +231,18 @@ class Histogram:
             return (sum(counts) if counts else 0,
                     self._sums.get(key, 0.0))
 
+    def remove(self, **labels) -> None:
+        """Drop one label-set series — the Gauge.remove() contract for
+        histograms: a histogram keyed by a dynamic entity (a mesh
+        member's submit latency, its audit series) would otherwise
+        render its last buckets forever after the entity dies, and a
+        frozen bucket matrix reads as a live-but-stalled signal on
+        every heatmap."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._counts.pop(key, None)
+            self._sums.pop(key, None)
+
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self._kind}"]

@@ -143,10 +143,14 @@ class WorkerServePublisher:
             elif isinstance(m, WindowAggregator):
                 watermark = max(watermark, float(m.watermark))
         self._last_gen = self.ledger.generation
+        aud = getattr(worker.fused, "audit", None)
         snap = self.store.publish(
             watermark=watermark, flows_seen=worker.flows_seen,
             source="worker", families=families,
-            ranges=self.ledger.freeze())
+            ranges=self.ledger.freeze(),
+            # sketchwatch: the newest per-family close reports ride the
+            # snapshot (read under worker.lock here; served lock-free)
+            audit=dict(aud.last_reports) if aud is not None else None)
         self._last_publish = time.monotonic()
         log.debug("flowserve published v%d (%.1f ms, %d families)",
                   snap.version, (self._last_publish - t0) * 1e3,
@@ -249,7 +253,11 @@ class MeshServePublisher:
                 value_cols=tuple(spec.config.value_cols))
         return self.store.publish(
             watermark=float(coord.commit_watermark()), flows_seen=None,
-            source="mesh", families=families, ranges=self.ledger.freeze())
+            source="mesh", families=families, ranges=self.ledger.freeze(),
+            # sketchwatch: the coordinator's NETWORK-WIDE audit reports
+            # (merged cohort vs merged sketch, refreshed at merge time)
+            audit=coord.audit_reports()
+            if hasattr(coord, "audit_reports") else None)
 
 
 def attach_worker(worker, refresh: float = 2.0,

@@ -145,7 +145,15 @@ class ServeServer:
     # ---- request dispatch --------------------------------------------------
 
     def _respond(self, target: str, inm: str | None) -> bytes:
-        """One request -> one fully assembled response buffer."""
+        """One request -> one fully assembled response buffer, counted
+        by status code (the buffer always opens "HTTP/1.1 NNN", so the
+        code is bytes [9:12] — one slice, no re-parse; the 5xx-rate
+        alert in deploy/prometheus/alerts.yml reads this family)."""
+        resp = self._respond_inner(target, inm)
+        self.store.m_responses.inc(code=resp[9:12].decode("ascii"))
+        return resp
+
+    def _respond_inner(self, target: str, inm: str | None) -> bytes:
         t0 = time.perf_counter()
         snap = self.store.current  # ONE pointer load per request
         if snap is not None:
@@ -175,6 +183,7 @@ class ServeServer:
                 "/query/topk": self._topk,
                 "/query/estimate": self._estimate,
                 "/query/range": self._range,
+                "/query/audit": self._audit,
             }.get(endpoint)
             if handler is None:
                 return _http_response(404, json.dumps(
@@ -315,6 +324,27 @@ class ServeServer:
             "window_start": fam.window_start,
             "key": lanes,
             "estimates": {n: int(est[j]) for j, n in enumerate(names)},
+        }
+
+    @staticmethod
+    def _audit(snap: Snapshot, q) -> dict:
+        """sketchwatch: the newest per-family accuracy audit reports the
+        snapshot carries (worker: per-process; mesh: network-wide merged
+        cohort vs merged sketch). Empty models = audit off or nothing
+        closed yet — an answer, not an error."""
+        name = q.get("model")
+        if name:
+            report = snap.audit.get(name)
+            if report is None:
+                raise KeyError(f"no audit report for model {name!r}")
+            models = {name: report}
+        else:
+            models = dict(snap.audit)
+        return {
+            "version": snap.version,
+            "source": snap.source,
+            "watermark": snap.watermark,
+            "models": models,
         }
 
     @staticmethod

@@ -62,6 +62,10 @@ SERVE_METRICS = {
     "age": ("serve_snapshot_age_seconds",
             "age of the served snapshot at the last publish/query "
             "(refreshed per request under load)"),
+    "responses": ("serve_responses_total",
+                  "flowserve HTTP responses by status code (label: "
+                  "code) — the 5xx-rate alert's denominator-free "
+                  "signal"),
 }
 
 
@@ -138,6 +142,10 @@ class Snapshot:
     families: Mapping[str, FamilyView] = field(default_factory=dict)
     # table -> ((slot, columnar rows), ...) newest-RANGE_SLOTS, ascending
     ranges: Mapping[str, tuple] = field(default_factory=dict)
+    # sketchwatch: {family: newest JSON-safe audit report} at publish —
+    # what /query/audit serves (empty when -obs.audit=off or nothing
+    # has closed yet)
+    audit: Mapping[str, dict] = field(default_factory=dict)
 
     def age(self, now: Optional[float] = None) -> float:
         return max(0.0, (now or time.time()) - self.created)
@@ -232,6 +240,7 @@ class SnapshotStore:
         self.m_version = REGISTRY.gauge(*SERVE_METRICS["version"])
         self.m_timestamp = REGISTRY.gauge(*SERVE_METRICS["timestamp"])
         self.m_age = REGISTRY.gauge(*SERVE_METRICS["age"])
+        self.m_responses = REGISTRY.counter(*SERVE_METRICS["responses"])
 
     @property
     def current(self) -> Optional[Snapshot]:
@@ -239,7 +248,8 @@ class SnapshotStore:
 
     def publish(self, *, watermark: float, flows_seen: Optional[int],
                 source: str, families: Mapping[str, FamilyView],
-                ranges: Mapping[str, tuple]) -> Snapshot:
+                ranges: Mapping[str, tuple],
+                audit: Optional[Mapping[str, dict]] = None) -> Snapshot:
         with self._pub_lock:
             prev = self._current
             snap = Snapshot(
@@ -250,6 +260,7 @@ class SnapshotStore:
                 source=source,
                 families=families,
                 ranges=ranges,
+                audit=dict(audit) if audit else {},
             )
             self._current = snap  # the RCU publish: one reference swap
         self.m_published.inc()

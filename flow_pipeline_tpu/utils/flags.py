@@ -40,7 +40,7 @@ KNOWN_FLAGS = frozenset({
     "ingest.mode", "ingest.shards", "ingest.depth", "ingest.flush_queue",
     "ingest.native_group", "ingest.fused",
     "checkpoint.path", "flush.count", "metrics.addr", "sink", "in",
-    "listen.feed", "query.addr", "obs.trace",
+    "listen.feed", "query.addr", "obs.trace", "obs.audit",
     # flowserve (serve/)
     "serve.addr", "serve.refresh",
     # flowmesh (mesh/)

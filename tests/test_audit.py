@@ -1,0 +1,565 @@
+"""sketchwatch (obs/audit.py): sampling determinism, the uint64-exact
+cohort envelope vs the exact_groupby oracle past 2^53, audit-on vs
+audit-off sink-row bit-exactness (single worker AND the 4-worker mesh
+churn leg), mesh-merged audit counters bit-equal to the single-worker
+oracle's cohort, the /query/audit serve surface, and the
+Histogram.remove() / coordinator series-lifecycle regressions.
+`make audit-parity` runs this file."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.cli import (_build_models, _common_flags,
+                                   _gen_flags, _processor_flags)
+from flow_pipeline_tpu.engine import StreamWorker, WorkerConfig
+from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+from flow_pipeline_tpu.mesh import InProcessMesh, produce_sharded
+from flow_pipeline_tpu.mesh import merge as merge_ops
+from flow_pipeline_tpu.models.heavy_hitter import HeavyHitterConfig
+from flow_pipeline_tpu.obs.audit import (AUDIT_SAMPLE_BITS, SketchAudit,
+                                         audit_report, sample_mask)
+from flow_pipeline_tpu.schema.batch import FlowBatch
+from flow_pipeline_tpu.transport import Consumer, InProcessBus
+from flow_pipeline_tpu.utils.flags import KNOWN_FLAGS, FlagSet
+
+N_KEYS = 200  # << capacity: admission is collision-free, tables exact
+N_FLOWS = 24_000
+PARTITIONS = 8
+BATCH = 4096
+
+TOP_COLS = ("src_addr", "dst_addr", "src_port", "dst_port", "proto",
+            "bytes", "packets", "count", "timeslot")
+
+
+def _vals(*extra):
+    # identical knobs to tests/test_mesh.py so the jitted apply graphs
+    # are shared across the pytest process (the suite must stay fast)
+    fs = _processor_flags(_gen_flags(_common_flags(FlagSet("test"))))
+    return fs.parse([
+        "-produce.profile", "zipf", "-zipf.keys", str(N_KEYS),
+        "-model.ports=false", "-model.ddos=false", "-model.ips=false",
+        "-processor.batch", str(BATCH), "-sketch.capacity", "512",
+        *extra,
+    ])
+
+
+def _stream_batches(n_flows=N_FLOWS, seed=0):
+    gen = FlowGenerator(ZipfProfile(n_keys=N_KEYS, alpha=1.2), seed=seed,
+                        rate=100_000.0)
+    out, done = [], 0
+    while done < n_flows:
+        n = min(8192, n_flows - done)
+        out.append(gen.batch(n))
+        done += n
+    return out
+
+
+def _make_bus(n_flows=N_FLOWS, partitions=PARTITIONS):
+    bus = InProcessBus()
+    bus.create_topic("flows", partitions)
+    for batch in _stream_batches(n_flows):
+        produce_sharded(bus, "flows", batch, partitions)
+    return bus
+
+
+class ListSink:
+    def __init__(self):
+        self.tables = {}
+
+    def write(self, table, rows):
+        self.tables.setdefault(table, []).append(rows)
+
+
+def _run_worker(vals, sink, audit="off", backend=None):
+    worker = StreamWorker(
+        Consumer(_make_bus(), "flows", fixedlen=True),
+        _build_models(vals), [sink],
+        WorkerConfig(poll_max=BATCH, snapshot_every=0,
+                     sketch_backend=backend or vals["sketch.backend"],
+                     obs_audit=audit))
+    worker.run(stop_when_idle=True)
+    return worker
+
+
+def _assert_tables_bit_exact(t1: dict, t2: dict):
+    assert set(t1) == set(t2)
+    for table in t1:
+        assert len(t1[table]) == len(t2[table]), table
+        for r1, r2 in zip(t1[table], t2[table]):
+            assert set(r1) == set(r2), table
+            for col in r1:
+                a, b = np.asarray(r1[col]), np.asarray(r2[col])
+                assert a.dtype == b.dtype and a.shape == b.shape, \
+                    (table, col)
+                assert (a == b).all(), (table, col)
+
+
+# ---------------------------------------------------------------------------
+# deterministic sampling
+# ---------------------------------------------------------------------------
+
+
+class TestSampling:
+    def test_mask_deterministic_and_roughly_1_in_256(self):
+        rng = np.random.default_rng(0)
+        lanes = rng.integers(0, 2**32, size=(100_000, 5),
+                             dtype=np.int64).astype(np.uint32)
+        m1, m2 = sample_mask(lanes), sample_mask(lanes)
+        assert (m1 == m2).all()
+        # binomial(100k, 1/256): mean ~390, assert a generous band
+        assert 150 <= int(m1.sum()) <= 800
+
+    def test_mask_is_per_key_not_per_position(self):
+        """The mesh contract: a key samples identically regardless of
+        which shard/chunk/row position carries it."""
+        rng = np.random.default_rng(1)
+        lanes = rng.integers(0, 2**32, size=(4096, 2),
+                             dtype=np.int64).astype(np.uint32)
+        perm = rng.permutation(len(lanes))
+        assert (sample_mask(lanes)[perm] == sample_mask(lanes[perm])).all()
+
+    def test_full_mode_audits_everything(self):
+        lanes = np.zeros((7, 3), np.uint32)
+        assert sample_mask(lanes, "full").all()
+
+
+# ---------------------------------------------------------------------------
+# uint64-exact envelope: cohort sums vs the exact oracle past 2^53
+# ---------------------------------------------------------------------------
+
+
+class TestUint64Envelope:
+    def test_cohort_sums_match_exact_groupby_past_2_53(self):
+        """Per-key cohort totals above 2^53 (where float64 accumulation
+        already rounds) must bit-equal the uint64 exact_groupby oracle:
+        the audit's fold is u64 addition of f32-exact addends."""
+        from flow_pipeline_tpu.models.oracle import exact_groupby
+
+        n = 16_384
+        rng = np.random.default_rng(2)
+        src = (rng.integers(0, 4, size=n) + 10).astype(np.uint32)
+        dst = np.full(n, 77, np.uint32)
+        # 2^42 per row is exactly representable in f32; a key's total
+        # crosses 2^53 after ~2k rows (each key gets ~4k here)
+        bytes_col = np.full(n, np.uint64(1) << np.uint64(42), np.uint64)
+        batch = FlowBatch({
+            "time_received": np.full(n, 1_000, np.uint32),
+            "src_as": src, "dst_as": dst,
+            "bytes": bytes_col,
+            "packets": np.ones(n, np.uint64),
+        })
+        oracle = exact_groupby(batch, ["src_as", "dst_as"],
+                               ["bytes", "packets"], timeslot=False)
+        assert int(oracle["bytes"].max()) > 2**53  # the test has teeth
+        cfg = HeavyHitterConfig(key_cols=("src_as", "dst_as"),
+                                value_cols=("bytes", "packets"),
+                                batch_size=n, scale_col=None)
+        audit = SketchAudit({"env": (cfg, 10)}, mode="full")
+        # feed per-row (the fused path's shape), in two chunks
+        lanes = np.stack([src, dst], axis=1).astype(np.uint32)
+        vals = np.stack([bytes_col, np.ones(n, np.uint64)],
+                        axis=1).astype(np.float32)
+        audit.observe_rows("env", lanes[:n // 2], vals[:n // 2])
+        audit.observe_rows("env", lanes[n // 2:], vals[n // 2:])
+        part = audit.take_partial("env")
+        got = {tuple(int(x) for x in part["keys"][i]):
+               part["vals"][i] for i in range(len(part["keys"]))}
+        assert len(got) == len(oracle["src_as"])
+        for i in range(len(oracle["src_as"])):
+            key = (int(oracle["src_as"][i]), int(oracle["dst_as"][i]))
+            want = np.array([oracle["bytes"][i], oracle["packets"][i],
+                             oracle["count"][i]], np.uint64)
+            assert (got[key] == want).all(), key
+
+    def test_grouped_and_row_observation_agree_on_envelope(self):
+        """Chunk grouping granularity must not change the cohort: group
+        sums (staged path) and per-row addends (fused path) fold to the
+        same uint64 totals on the exact envelope."""
+        n = 4096
+        rng = np.random.default_rng(3)
+        lanes = (rng.integers(0, 50, size=(n, 2))).astype(np.uint32)
+        vals = rng.integers(1, 1500, size=(n, 2)).astype(np.float32)
+        cfg = HeavyHitterConfig(key_cols=("src_as", "dst_as"),
+                                value_cols=("bytes", "packets"),
+                                batch_size=n, scale_col=None)
+        a_rows = SketchAudit({"f": (cfg, 10)}, mode="full")
+        a_rows.observe_rows("f", lanes, vals)
+        a_grp = SketchAudit({"f": (cfg, 10)}, mode="full")
+        order = np.lexsort(lanes.T[::-1])
+        sk = lanes[order]
+        bound = np.ones(n, bool)
+        bound[1:] = (sk[1:] != sk[:-1]).any(axis=1)
+        starts = np.flatnonzero(bound)
+        uniq = np.ascontiguousarray(sk[starts])
+        vsum = np.add.reduceat(vals[order].astype(np.float64), starts,
+                               axis=0).astype(np.float32)
+        cnt = np.diff(np.append(starts, n)).astype(np.float32)
+        sums = np.concatenate([vsum, cnt[:, None]], axis=1)
+        a_grp.observe_grouped("f", uniq, sums, len(uniq))
+        p1, p2 = a_rows.take_partial("f"), a_grp.take_partial("f")
+        assert (p1["keys"] == p2["keys"]).all()
+        assert (p1["vals"] == p2["vals"]).all()
+
+
+# ---------------------------------------------------------------------------
+# report semantics
+# ---------------------------------------------------------------------------
+
+
+class TestReport:
+    @staticmethod
+    def _state(cms, keys, vals):
+        return {"cms": cms, "table_keys": keys, "table_vals": vals}
+
+    def test_exact_regime_reports_zero_and_full_recall(self):
+        """A sketch wide enough that the cohort's estimates are exact
+        must report 0 error, recall 1, no false drops."""
+        from flow_pipeline_tpu.hostsketch.engine import np_cms_update
+
+        cfg = HeavyHitterConfig(key_cols=("src_as", "dst_as"),
+                                value_cols=("bytes", "packets"),
+                                width=1 << 16, capacity=16,
+                                batch_size=64, scale_col=None)
+        keys = np.arange(20, dtype=np.uint32).reshape(10, 2)
+        counts = np.arange(10, 0, -1).astype(np.uint64)
+        cms = np.zeros((3, cfg.depth, cfg.width), np.uint64)
+        vals = np.stack([counts * 100, counts, counts],
+                        axis=1).astype(np.float32)
+        np_cms_update(cms, keys, vals, conservative=True)
+        tkeys = np.full((16, 2), 0xFFFFFFFF, np.uint32)
+        tvals = np.zeros((16, 3), np.float32)
+        tkeys[:10] = keys
+        tvals[:10] = vals
+        cohort = np.stack([counts * 100, counts, counts],
+                          axis=1).astype(np.uint64)
+        rep = audit_report(keys, cohort, self._state(cms, tkeys, tvals),
+                           cfg, k=5, scale=1)
+        assert rep["cms_err"] == {"p50": 0.0, "p99": 0.0, "max": 0.0}
+        assert rep["table_err"] == {"p50": 0.0, "p99": 0.0, "max": 0.0}
+        assert rep["recall_at_k"] == 1.0
+        assert rep["precision_at_k"] == 1.0
+        assert rep["false_drops"] == 0
+        assert rep["sampled_keys"] == 10
+        assert rep["table_occupancy"] == pytest.approx(10 / 16)
+
+    def test_missing_heavy_key_counts_as_false_drop(self):
+        cfg = HeavyHitterConfig(key_cols=("src_as", "dst_as"),
+                                value_cols=("bytes", "packets"),
+                                width=1 << 10, capacity=4,
+                                batch_size=64, scale_col=None)
+        keys = np.arange(8, dtype=np.uint32).reshape(4, 2)
+        cohort = np.stack([[400, 300, 200, 100]] * 3,
+                          axis=1).astype(np.uint64)
+        cms = np.zeros((3, cfg.depth, cfg.width), np.uint64)
+        tkeys = np.full((4, 2), 0xFFFFFFFF, np.uint32)
+        tvals = np.zeros((4, 3), np.float32)
+        tkeys[0] = keys[1]  # the TOP key (row 0) is missing entirely
+        tvals[0] = [300, 300, 300]
+        rep = audit_report(keys, cohort, self._state(cms, tkeys, tvals),
+                           cfg, k=2, scale=1)
+        assert rep["false_drops"] >= 1
+        assert rep["recall_at_k"] < 1.0
+
+    def test_error_grows_with_fill(self):
+        """The acceptance direction: the same stream through a narrow
+        sketch reports strictly more error than through a wide one, and
+        the wide (exact-regime) sketch reports zero."""
+        from flow_pipeline_tpu.hostsketch.engine import np_cms_update
+
+        rng = np.random.default_rng(5)
+        keys = rng.integers(0, 2**32, size=(2000, 2),
+                            dtype=np.int64).astype(np.uint32)
+        keys = np.unique(keys, axis=0)
+        n = len(keys)
+        counts = rng.integers(1, 100, size=n).astype(np.uint64)
+        vals = np.stack([counts, counts, counts],
+                        axis=1).astype(np.float32)
+        cohort = vals.astype(np.uint64)
+        errs = {}
+        for width in (1 << 16, 1 << 7):
+            cfg = HeavyHitterConfig(key_cols=("src_as", "dst_as"),
+                                    value_cols=("bytes", "packets"),
+                                    width=width, capacity=16,
+                                    batch_size=64, scale_col=None)
+            cms = np.zeros((3, cfg.depth, width), np.uint64)
+            np_cms_update(cms, keys, vals, conservative=True)
+            tkeys = np.full((16, 2), 0xFFFFFFFF, np.uint32)
+            tvals = np.zeros((16, 3), np.float32)
+            rep = audit_report(keys, cohort,
+                               self._state(cms, tkeys, tvals),
+                               cfg, k=16, scale=1)
+            errs[width] = (rep["cms_err"]["p99"],
+                           rep["fill_ratio"][-1])
+        assert errs[1 << 16][0] == 0.0  # exact regime reports 0
+        assert errs[1 << 7][1] > errs[1 << 16][1]  # fill grew...
+        assert errs[1 << 7][0] > 0.0               # ...and so did error
+
+
+# ---------------------------------------------------------------------------
+# audit-parity: instrumentation must be purely observational
+# ---------------------------------------------------------------------------
+
+
+class TestAuditParity:
+    def test_worker_sink_rows_bit_exact_audit_on_off(self):
+        """The acceptance gate, worker leg: -obs.audit=off vs full on
+        the fused host dataplane — every sink row bit-exact."""
+        vals = _vals("-sketch.backend", "host")
+        s_off, s_on = ListSink(), ListSink()
+        w_off = _run_worker(vals, s_off, audit="off")
+        w_on = _run_worker(vals, s_on, audit="full")
+        assert getattr(w_off.fused, "audit", None) is None
+        assert w_on.fused.audit is not None
+        assert w_on.fused.audit.last_reports  # it DID audit something
+        _assert_tables_bit_exact(s_off.tables, s_on.tables)
+
+    def test_mesh_churn_sink_rows_bit_exact_audit_on_off(self):
+        """The acceptance gate, mesh leg: a 4-worker mesh with a
+        mid-stream member kill stays bit-exact to the audit-off single
+        worker with the audit fully on — instrumentation cannot perturb
+        the merge/carry/replay machinery."""
+        vals = _vals()
+        sink1, sink2 = ListSink(), ListSink()
+        _run_worker(vals, sink1, audit="off")
+        mesh = InProcessMesh(
+            _make_bus(), "flows", 4,
+            model_factory=lambda: _build_models(vals),
+            config=WorkerConfig(poll_max=BATCH, snapshot_every=0,
+                                obs_audit="full"),
+            sinks=[sink2], submit_every=2)
+        mesh.start()
+        victim = mesh.members[1]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            w = victim.worker
+            if w is not None and w.flows_seen >= BATCH:
+                break
+            time.sleep(0.002)
+        else:
+            pytest.fail("victim never processed a batch")
+        mesh.kill_member(1)
+        mesh.wait_idle()
+        mesh.finalize()
+        top1 = sink1.tables["top_talkers"][0]
+        top2 = sink2.tables["top_talkers"][0]
+        v1, v2 = np.asarray(top1["valid"]), np.asarray(top2["valid"])
+        assert int(v1.sum()) == int(v2.sum())
+        for col in TOP_COLS:
+            a = np.asarray(top1[col])[v1]
+            b = np.asarray(top2[col])[v2]
+            assert (a == b).all(), col
+
+
+# ---------------------------------------------------------------------------
+# mesh-merged audit counters == single-worker oracle cohort
+# ---------------------------------------------------------------------------
+
+
+class TestMeshAuditMerge:
+    def test_merged_cohort_bit_equals_oracle(self):
+        """Per-member audit partials ride the submission envelope and
+        fold at the coordinator as u64 sums; the merged cohort must
+        bit-equal what a single worker seeing the whole stream sampled
+        (same deterministic key sample, same totals)."""
+        vals = _vals("-sketch.backend", "host")
+        # oracle: single worker, audit in capture mode so partials are
+        # retained instead of evaluated-and-dropped
+        oracle_parts: dict[int, dict] = {}
+        worker = StreamWorker(
+            Consumer(_make_bus(), "flows", fixedlen=True),
+            _build_models(vals), [ListSink()],
+            WorkerConfig(poll_max=BATCH, snapshot_every=0,
+                         sketch_backend="host", obs_audit="full"))
+        worker.fused.audit.capture = \
+            lambda name, slot, part: oracle_parts.setdefault(
+                slot, {}).setdefault(name, part)
+        worker.run(stop_when_idle=True)
+        assert oracle_parts, "oracle closed no audited windows"
+        mesh = InProcessMesh(
+            _make_bus(), "flows", 2,
+            model_factory=lambda: _build_models(vals),
+            config=WorkerConfig(poll_max=BATCH, snapshot_every=0,
+                                sketch_backend="host",
+                                obs_audit="full"),
+            sinks=[ListSink()])
+        mesh.run()
+        coord = mesh.coordinator
+        checked = 0
+        for slot, models in oracle_parts.items():
+            for name, part in models.items():
+                merged = coord.audit_cohort(name, slot)
+                assert merged is not None, (name, slot)
+                assert merged["keys"].dtype == np.uint32
+                assert merged["vals"].dtype == np.uint64
+                assert (merged["keys"] == part["keys"]).all(), (name, slot)
+                assert (merged["vals"] == part["vals"]).all(), (name, slot)
+                checked += 1
+        assert checked >= 1
+        # and the coordinator published the network-wide report
+        reports = coord.audit_reports()
+        assert "top_talkers" in reports
+        assert reports["top_talkers"]["sampled_keys"] > 0
+
+
+# ---------------------------------------------------------------------------
+# coordinator protocol: merged-audit publish + series lifecycle
+# ---------------------------------------------------------------------------
+
+
+class TestCoordinatorAudit:
+    @staticmethod
+    def _hh_contrib(slot, audit_vals, member_seed, ranges, wm,
+                    final=False):
+        from flow_pipeline_tpu.mesh import codec
+
+        cfg = HeavyHitterConfig(key_cols=("src_as", "dst_as"),
+                                value_cols=("bytes", "packets"),
+                                width=256, capacity=8, batch_size=64,
+                                scale_col=None)
+        keys = np.arange(4, dtype=np.uint32).reshape(2, 2)
+        tkeys = np.full((8, 2), 0xFFFFFFFF, np.uint32)
+        tvals = np.zeros((8, 3), np.float32)
+        tkeys[:2] = keys
+        tvals[:2] = np.asarray(audit_vals, np.float32)
+        payload = {
+            "kind": "hh",
+            "cms": np.zeros((3, cfg.depth, cfg.width), np.uint64),
+            "table_keys": tkeys, "table_vals": tvals,
+            "audit": {"keys": keys,
+                      "vals": np.asarray(audit_vals, np.uint64),
+                      "scale": 1, "evictions": 1},
+        }
+        return cfg, codec.encode({
+            "member": f"m{member_seed}", "ranges": ranges,
+            "watermark": wm, "closed": {slot: {"hh": payload}},
+            "open": {}, "flows": 10, "final": final, "release": False,
+            "span": {"sub": member_seed, "member": f"m{member_seed}",
+                     "sent": time.time(), "chunk": 1, "windows": [slot]},
+        })
+
+    def test_merged_audit_is_u64_sum_and_member_series_removed(self):
+        from flow_pipeline_tpu.mesh import ModelSpec, MeshCoordinator
+
+        cfg, blob_a = self._hh_contrib(
+            300, [[100, 10, 5], [50, 5, 2]], 1, {0: [0, 5]}, 900,
+            final=True)
+        spec = ModelSpec("hh", "hh", cfg, k=8, window_seconds=300)
+        c = MeshCoordinator([spec], 2, heartbeat_timeout=1e9)
+        c.join("a"), c.join("b")
+        sa, sb = c.sync("a"), c.sync("b")
+        pa = list(sa["assign"])[0]
+        pb = list(sb["assign"])[0]
+        _, blob_a = self._hh_contrib(
+            300, [[100, 10, 5], [50, 5, 2]], 1, {pa: [0, 5]}, 900,
+            final=True)
+        _, blob_b = self._hh_contrib(
+            300, [[30, 3, 1], [20, 2, 1]], 2, {pb: [0, 5]}, 900,
+            final=True)
+        assert c.submit("a", blob_a)["ok"]
+        assert c.submit("b", blob_b)["ok"]
+        merged = c.audit_cohort("hh", 300)
+        assert merged is not None
+        assert (merged["vals"] == np.array(
+            [[130, 13, 6], [70, 7, 3]], np.uint64)).all()
+        assert merged["evictions"] == 2
+        rep = c.audit_reports()["hh"]
+        assert rep["sampled_keys"] == 2
+        # submit->merge latency is member-labeled now; fencing removes
+        # the member's histogram series (Histogram.remove regression)
+        assert 'member="a"' in c._m["sub2merge_s"].render()
+        c.fence("a")
+        assert 'member="a"' not in c._m["sub2merge_s"].render()
+        assert 'member="b"' in c._m["sub2merge_s"].render()
+
+
+class TestHistogramRemove:
+    def test_remove_drops_one_label_set(self):
+        from flow_pipeline_tpu.obs.metrics import Histogram
+
+        h = Histogram("t_hist_remove", "t", buckets=(1.0, 2.0))
+        h.observe(0.5, member="a")
+        h.observe(1.5, member="b")
+        assert 'member="a"' in h.render()
+        h.remove(member="a")
+        text = h.render()
+        assert 'member="a"' not in text
+        assert 'member="b"' in text
+        assert h.value(member="a") == (0, 0.0)
+        assert h.value(member="b") == (1, 1.5)
+
+    def test_remove_missing_label_set_is_noop(self):
+        from flow_pipeline_tpu.obs.metrics import Histogram
+
+        h = Histogram("t_hist_remove2", "t", buckets=(1.0,))
+        h.remove(member="ghost")  # must not raise
+        h.observe(0.5)
+        assert h.value() == (1, 0.5)
+
+
+# ---------------------------------------------------------------------------
+# flowserve: /query/audit
+# ---------------------------------------------------------------------------
+
+
+class TestServeAudit:
+    def test_query_audit_serves_last_reports(self):
+        from flow_pipeline_tpu.serve import ServeServer, attach_worker
+
+        vals = _vals("-sketch.backend", "host")
+        worker = StreamWorker(
+            Consumer(_make_bus(n_flows=8192), "flows", fixedlen=True),
+            _build_models(vals), [ListSink()],
+            WorkerConfig(poll_max=BATCH, snapshot_every=0,
+                         sketch_backend="host", obs_audit="full"))
+        pub = attach_worker(worker, refresh=0.0)
+        worker.run(stop_when_idle=True)
+        snap = pub.store.current
+        assert snap is not None and snap.audit, \
+            "publish carried no audit reports"
+        # start() before stop(): BaseServer.shutdown() waits on the
+        # serve_forever loop having run at least once
+        server = ServeServer(pub.store, port=0).start()
+        try:
+            resp = server._respond("/query/audit", None)
+            head, _, body = resp.partition(b"\r\n\r\n")
+            assert b"200" in head.split(b"\r\n")[0]
+            doc = json.loads(body)
+            assert doc["models"]
+            name, rep = next(iter(doc["models"].items()))
+            assert "cms_err" in rep and "fill_ratio" in rep
+            # unknown model answers 400, not a dropped connection
+            resp = server._respond("/query/audit?model=nope", None)
+            assert resp.startswith(b"HTTP/1.1 400")
+            # responses are counted by code for the 5xx alert
+            assert pub.store.m_responses.value(code="200") >= 1
+            assert pub.store.m_responses.value(code="400") >= 1
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# flags / plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_obs_audit_flag_registered_and_threaded():
+    assert "obs.audit" in KNOWN_FLAGS
+    vals = _vals("-obs.audit", "full")
+    from flow_pipeline_tpu.cli import _worker_config
+
+    assert _worker_config(vals).obs_audit == "full"
+    with pytest.raises(ValueError):
+        StreamWorker(None, {}, [], WorkerConfig(obs_audit="bogus"))
+
+
+def test_audit_metrics_registered_eagerly_on_worker():
+    from flow_pipeline_tpu.obs import REGISTRY
+
+    StreamWorker(None, {}, [], WorkerConfig())
+    for name in ("sketch_estimate_error_ratio", "sketch_cms_fill_ratio",
+                 "sketch_table_occupancy", "sketch_hh_recall",
+                 "sketch_audit_false_drop_total"):
+        assert name in REGISTRY._metrics, name

@@ -321,6 +321,40 @@ class TestGrafana:
         assert "serve_snapshot_age_seconds" in exprs
         assert "serve_snapshots_published_total" in exprs
 
+    def test_pipeline_dashboard_sketchwatch_panels(self):
+        """Round-15 sketchwatch panels: the sampled-audit error ratio
+        off the aggregable le buckets, CMS fill / table occupancy and
+        churn (the why behind error growth), and the sampled
+        recall/precision next to the cohort-health panel."""
+        with open(os.path.join(DEPLOY, "grafana", "dashboards",
+                               "pipeline.json")) as f:
+            dash = json.load(f)
+        panels = {p["title"]: p for p in dash["panels"]}
+        err = panels["Sketch estimate error ratio (sampled audit)"]
+        exprs = " ".join(t["expr"] for t in err["targets"])
+        assert "sketch_estimate_error_ratio_bucket" in exprs
+        assert "histogram_quantile(0.99" in exprs and "by (le)" in exprs
+        assert 'path="cms"' in exprs and 'path="table"' in exprs
+        fill = panels["Sketch CMS fill ratio (saturation)"]
+        exprs = " ".join(t["expr"] for t in fill["targets"])
+        assert "sketch_cms_fill_ratio" in exprs
+        assert "sketch_cms_row_load_max" in exprs
+        occ = panels["Sketch table occupancy and admission churn"]
+        exprs = " ".join(t["expr"] for t in occ["targets"])
+        assert "sketch_table_occupancy" in exprs
+        assert "sketch_table_evictions_total" in exprs
+        assert "sketch_table_est_admitted_fraction" in exprs
+        rec = panels["Sketch heavy-hitter recall/precision "
+                     "(sampled ground truth)"]
+        exprs = " ".join(t["expr"] for t in rec["targets"])
+        assert "sketch_hh_recall" in exprs
+        assert "sketch_hh_precision" in exprs
+        assert "sketch_audit_false_drop_total" in exprs
+        cohort = panels["Sketch audit cohort (size, cadence, overflow)"]
+        exprs = " ".join(t["expr"] for t in cohort["targets"])
+        assert "sketch_audit_sampled_keys" in exprs
+        assert "sketch_audit_cohort_overflow_total" in exprs
+
     def test_traffic_dashboards_have_four_topn_tables(self):
         # reference viz.json serves four top-N tables: src/dst IPs AND
         # src/dst ports — both dashboard variants must carry all four
@@ -350,7 +384,9 @@ class TestDashboardHonesty:
     missing nf-delay summary)."""
 
     PROM_FUNCS = {"rate", "irate", "sum", "avg", "max", "min", "increase",
-                  "by", "histogram_quantile", "time", "le"}
+                  "by", "histogram_quantile", "time", "le",
+                  # binary-op/matching keywords (alert exprs)
+                  "and", "or", "unless", "on", "ignoring"}
     SQL_KEYWORDS = {"select", "from", "where", "group", "by", "order",
                     "limit", "as", "between", "and", "or", "desc", "asc",
                     "in", "not", "time", "case", "when", "then", "else",
@@ -433,6 +469,49 @@ class TestDashboardHonesty:
                 )
                 checked += 1
         assert checked >= 15  # the surface is real, not vacuously empty
+
+    def test_alert_exprs_use_registered_metrics(self):
+        """deploy/prometheus/alerts.yml under the same honesty contract
+        as the dashboards: every metric identifier in every alert expr
+        must resolve against the rendered exposition surface — an alert
+        on a typo'd series never fires, which is worse than no alert."""
+        import re
+
+        doc = load("prometheus/alerts.yml")
+        names = self.exported_metric_names()
+        rules = [r for g in doc["groups"] for r in g["rules"]]
+        assert len(rules) >= 6  # the r15 satellite's floor
+        checked = 0
+        for rule in rules:
+            expr = rule["expr"]
+            bare = re.sub(r"\{[^}]*\}", "", expr)
+            bare = re.sub(r"\[[^\]]*\]", "", bare)
+            bare = re.sub(r'"[^"]*"', "", bare)
+            idents = set(re.findall(r"[a-zA-Z_][a-zA-Z0-9_]*", bare))
+            metrics = idents - self.PROM_FUNCS
+            assert metrics, f"{rule['alert']}: no metric in {expr!r}"
+            for m in metrics:
+                assert m in names, (
+                    f"alert {rule['alert']}: {m!r} is not a registered "
+                    "metric")
+                checked += 1
+            assert rule.get("labels", {}).get("severity"), rule["alert"]
+            assert "summary" in rule.get("annotations", {}), rule["alert"]
+        assert checked >= 8
+        # the audit error-ratio p99 rule the r15 satellite names
+        assert any("sketch_estimate_error_ratio_bucket" in r["expr"]
+                   for r in rules)
+
+    def test_alerts_wired_into_prometheus_and_compose(self):
+        """The rules file must actually be evaluated: prometheus.yml
+        names it under rule_files, and every compose topology mounts it
+        next to the scrape config."""
+        prom = load("prometheus/prometheus.yml")
+        assert "alerts.yml" in prom.get("rule_files", [])
+        for path in COMPOSE_FILES:
+            doc = load(path)
+            vols = "\n".join(doc["services"]["prometheus"]["volumes"])
+            assert "alerts.yml:/etc/prometheus/alerts.yml" in vols, path
 
     def test_sql_queries_resolve_against_ddl(self):
         import re

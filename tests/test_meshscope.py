@@ -449,14 +449,16 @@ class TestLineageProtocol:
     def test_slo_histograms_observe_on_merge(self):
         c = self.make(partitions=1)
         b0, _ = c._m["barrier_s"].value()
-        s0, _ = c._m["sub2merge_s"].value()
+        # submit->merge is member-labeled (r15: so a fenced member's
+        # series can be removed instead of freezing)
+        s0, _ = c._m["sub2merge_s"].value(member="a")
         c.join("a")
         c.sync("a")
         c.submit("a", _contrib({0: [0, 10]}, wm=900,
                                closed={300: _wagg_win(7, 50)},
                                span=_span(1)))
         b1, _ = c._m["barrier_s"].value()
-        s1, _ = c._m["sub2merge_s"].value()
+        s1, _ = c._m["sub2merge_s"].value(member="a")
         assert b1 == b0 + 1
         assert s1 >= s0 + 1
 
