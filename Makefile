@@ -5,7 +5,7 @@
 
 .PHONY: all native test bench proto clean services-test lint native-san \
 	hostsketch-parity fused-parity fused-parity-traced mesh-parity \
-	mesh-parity-traced serve-load audit-parity
+	mesh-parity-traced serve-load audit-parity invertible-parity
 
 all: native
 
@@ -42,6 +42,18 @@ native-san:
 hostsketch-parity:
 	$(MAKE) -C native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_hostsketch.py -v
+
+# Bit-exact parity of the invertible sketch family (-hh.sketch=
+# invertible) across its three twins — the pure-numpy reference
+# (hostsketch/engine.py np_inv_*), the jnp ops kernel (ops/invsketch,
+# x64) and the native C kernels (hs_inv_update / hs_inv_decode, reached
+# standalone AND through ff_fused_update) — run against a FRESHLY BUILT
+# library: u64 extremes, thread-count determinism, hypothesis property,
+# decode-at-close exactness, and the exact-regime equality to table
+# mode (docs/ARCHITECTURE.md "invertible sketch" states the contract).
+invertible-parity:
+	$(MAKE) -C native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_invsketch.py -v
 
 # Bit-exact parity of the fused native dataplane (-ingest.fused) against
 # the staged group->sketch path, run against a FRESHLY BUILT library —

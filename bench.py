@@ -416,7 +416,8 @@ def _run_e2e(n_flows: int, samples: int = 5,
              ingest_mode: str = "pipelined",
              sketch_backend: str = "device",
              ingest_fused: str = "off",
-             obs_audit: str = "off") -> dict:
+             obs_audit: str = "off",
+             hh_sketch: str = "table") -> dict:
     """Shared e2e measurement: stats + per-stage budget (VERDICT r3 #1).
 
     The budget diffs the stage summaries across the timed samples and
@@ -443,7 +444,8 @@ def _run_e2e(n_flows: int, samples: int = 5,
     from flow_pipeline_tpu.utils.flags import FlagSet
 
     fs = _processor_flags(_gen_flags(_common_flags(FlagSet("bench"))))
-    vals = fs.parse(["-produce.profile", "zipf"])
+    vals = fs.parse(["-produce.profile", "zipf",
+                     "-hh.sketch", hh_sketch])
 
     def run_stream(n):
         bus = InProcessBus()
@@ -515,6 +517,7 @@ def _run_e2e(n_flows: int, samples: int = 5,
     stats["ingest_native_group"] = True  # both A/B legs (see run_stream)
     stats["sketch_backend"] = sketch_backend
     stats["ingest_fused"] = ingest_fused
+    stats["hh_sketch"] = hh_sketch
     stats["host_group_share_pct"] = stages.get(
         "host_group", {}).get("share_pct", 0.0)
     stats["flushing_share_pct"] = stages.get(
@@ -543,6 +546,8 @@ def _run_e2e(n_flows: int, samples: int = 5,
         used.add("sketch")
     if ingest_fused == "on":
         used.add("fused")
+    if hh_sketch == "invertible" and sketch_backend == "host":
+        used.add("invsketch")
     missing = sorted(used & set(native_lib.missing_features()))
     if missing:
         print(f"WARNING: native library cannot serve {missing} — "
@@ -597,6 +602,50 @@ def bench_hostsketch() -> None:
     }))
 
 
+def _lane_build_ab(pairs: int = 6, reps: int = 30) -> dict:
+    """Paired A/B of the r16 lane-build change (ROADMAP 4a): the old
+    per-lane concat (_key_lanes_np) vs the preallocated direct-fill
+    buffer (_key_lanes_into) over a real decoded chunk's 5-tuple
+    columns — the extraction that IS the fused prepare half. Alternating
+    order inside each pair, median of per-pair ratios."""
+    import numpy as np
+
+    from flow_pipeline_tpu.engine.hostfused import (_key_lanes_into,
+                                                    _key_lanes_np)
+    from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+
+    cols = FlowGenerator(ZipfProfile(n_keys=100_000, alpha=1.1),
+                         seed=0).batch(32768).columns
+    key_cols = ("src_addr", "dst_addr", "src_port", "dst_port", "proto")
+    ref = _key_lanes_np(cols, key_cols)
+    new = _key_lanes_into(cols, key_cols)
+    assert np.array_equal(np.ascontiguousarray(ref), new)
+
+    def time_fn(fn):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn(cols, key_cols)
+        return (time.perf_counter() - t0) / reps * 1e6
+
+    concat_us, fill_us, ratios = [], [], []
+    for i in range(pairs):
+        if i % 2 == 0:
+            c, f = time_fn(_key_lanes_np), time_fn(_key_lanes_into)
+        else:
+            f, c = time_fn(_key_lanes_into), time_fn(_key_lanes_np)
+        concat_us.append(c)
+        fill_us.append(f)
+        if f:
+            ratios.append(c / f)
+    return {
+        "lane_build_concat_us": round(statistics.median(concat_us), 1),
+        "lane_build_prealloc_us": round(statistics.median(fill_us), 1),
+        "lane_build_speedup": round(statistics.median(ratios), 3)
+        if ratios else 0.0,
+        "lane_build_pairs": [round(r, 3) for r in ratios],
+    }
+
+
 def bench_fused() -> None:
     """Same-box fused-dataplane A/B (the BENCH_r10 artifact): the full
     e2e pipeline on the host sketch backend with the staged
@@ -646,6 +695,9 @@ def bench_fused() -> None:
         # spends on radix/refine/regroup/fold/cms/prefilter/topk (pct of
         # the stage total; `other` = Python-side lane extraction etc.)
         "host_fused_phase_breakdown": fused["host_fused_phases"],
+        # r16 lane-build A/B (ROADMAP 4a): the prepare-half key-lane
+        # extraction, old concat vs preallocated direct fill
+        **_lane_build_ab(),
         "stages_staged": staged["stages"],
         "stages_fused": fused["stages"],
         "spread_pct_staged": staged["spread_pct"],
@@ -1259,11 +1311,85 @@ def bench_serve() -> None:
     }))
 
 
+HH_SKETCH_PAIRS = 4
+
+
+def _sweep_hh_sketch_ab() -> dict:
+    """Paired alternating-order -hh.sketch=table|invertible e2e legs on
+    the fused host dataplane (the r11 methodology: drift cancels within
+    a pair, alternation cancels the warm-second bias), recording the
+    host_fused in-kernel phase breakdown PER LEG — so the admission-
+    path deletion is MEASURED, not asserted: the invertible leg's
+    topk/cms/prefilter phases must read ~0 (its whole sketch fold is
+    the `inv` phase), while the table leg carries the ~56% admission
+    share BENCH_r11 attributed."""
+    from flow_pipeline_tpu import native as native_lib
+
+    if not (native_lib.fused_available() and native_lib.inv_available()):
+        return {"error": "libflowdecode lacks the fused/invertible "
+                         "kernels", "hint": "make native"}
+    table_rates, inv_rates, ratios = [], [], []
+    table_phases, inv_phases = {}, {}
+
+    def leg(mode):
+        return _run_e2e(E2E_FLOWS, samples=1, sketch_backend="host",
+                        ingest_fused="on", hh_sketch=mode)
+
+    for i in range(HH_SKETCH_PAIRS):
+        if i % 2 == 0:
+            tab, inv = leg("table"), leg("invertible")
+        else:
+            inv, tab = leg("invertible"), leg("table")
+        table_rates.append(tab["value"])
+        inv_rates.append(inv["value"])
+        if tab["value"]:
+            ratios.append(inv["value"] / tab["value"])
+        table_phases = tab["host_fused_phases"] or table_phases
+        inv_phases = inv["host_fused_phases"] or inv_phases
+
+    def admission_share(phases):
+        return round(sum(phases.get(ph, 0.0)
+                         for ph in ("topk", "cms", "prefilter")), 1)
+
+    speedup = statistics.median(ratios) if ratios else 0.0
+    return {
+        "metric": "hh sweep -hh.sketch=table|invertible paired A/B "
+                  "(admission-path deletion, fused host dataplane)",
+        "unit": "flows/sec",
+        "value": round(statistics.median(inv_rates), 1),
+        "table_flows_per_sec": round(statistics.median(table_rates), 1),
+        "invertible_flows_per_sec": round(
+            statistics.median(inv_rates), 1),
+        "invertible_speedup": round(speedup, 3),
+        "invertible_speedup_pairs": [round(r, 3) for r in ratios],
+        "pairs": HH_SKETCH_PAIRS,
+        # the acceptance numbers: the table leg's admission phases
+        # (topk + cms + prefilter, pct of host_fused) vs the invertible
+        # leg's — which must sit at ~0 with the new `inv` phase
+        # carrying that family's whole fold
+        "host_fused_phases_table": table_phases,
+        "host_fused_phases_invertible": inv_phases,
+        "admission_share_table_pct": admission_share(table_phases),
+        "admission_share_invertible_pct": admission_share(inv_phases),
+        "inv_phase_share_pct": inv_phases.get("inv", 0.0),
+        "native_capabilities": native_lib.capabilities(),
+        "platform": _PLATFORM,
+        "host_note": (
+            "paired alternating-order legs (r11 methodology) — single "
+            "legs on throttled 2-core boxes spread 10-30%, so the "
+            "median per-pair ratio is the honest statistic; the phase "
+            "shares are in-kernel attribution and box-independent"),
+        **_host_conditions(),
+    }
+
+
 def bench_sweep() -> None:
     """Tuning sweep for the flagship step: batch size x CMS width x impl
     x table prefilter x admission rule. One JSON line per point plus a
     final best-config line — run this the moment real hardware is
-    attached to pick hh defaults empirically.
+    attached to pick hh defaults empirically. The final line is the
+    r16 -hh.sketch=table|invertible paired e2e A/B (BENCH_r16's
+    headline: the admission-path deletion, measured per leg).
 
     The (prefilter, admission) axes quantify the admission path
     (VERDICT #2): prefilter on/off isolates the table-aware candidate
@@ -1347,6 +1473,10 @@ def bench_sweep() -> None:
         if adm_plain else 0.0,
         **_host_conditions(),
     }))
+    # r16: the sketch-family paired e2e A/B (the BENCH_r16 headline)
+    global _NATIVE
+    _NATIVE = _ensure_native()
+    print(json.dumps(_sweep_hh_sketch_ab()))
 
 
 def bench_trace(logdir: str = "/tmp/flowtpu_trace") -> None:

@@ -158,8 +158,18 @@ def _build_models(vals):
             cms_impl=vals["sketch.cms"],
             table_prefilter=vals["sketch.prefilter"],
             table_admission=vals["sketch.admission"],
+            hh_sketch=vals.get("hh.sketch", "table"),
         )
         if mesh:
+            if cfg.hh_sketch == "invertible":
+                # ShardedHeavyHitter shards the jitted table step over a
+                # device mesh; the invertible family's exact u64 planes
+                # have no device layout to shard — refuse instead of
+                # silently running the wrong family
+                raise ValueError(
+                    "-hh.sketch=invertible does not support "
+                    "-processor.mesh device sharding (host-resident "
+                    "u64 planes); use flowmesh workers instead")
             from .parallel import ShardedHeavyHitter
 
             return WindowedHeavyHitter(cfg, k=vals["sketch.topk"],
@@ -230,6 +240,14 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
               "Sketch step executor: device (jitted CMS/top-K apply) | "
               "host (native threaded uint64 engine; needs the "
               "host-grouped pipeline)")
+    fs.string("hh.sketch", "table",
+              "Heavy-hitter sketch family: table (CMS + top-K admission "
+              "table — prefilter, admission CMS queries, table merge) | "
+              "invertible (linear key-recovery sketch: no admission "
+              "machinery on the hot path, heavy keys decoded from the "
+              "sketch at window close, mesh merge a plain u64 sum; "
+              "ignores -sketch.prefilter/-sketch.admission and forces "
+              "the plain CMS update; wants -sketch.backend=host)")
     fs.string("sketch.admission", "est",
               "Top-K table admission: est (space-saving, CMS-seeded) | "
               "plain (batch-sum merge; benchmarking A/B only)")

@@ -137,6 +137,36 @@ def _key_lanes_np(cols: dict, key_cols) -> np.ndarray:
     return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
 
 
+def _fill_lanes(out: np.ndarray, off: int, lanes) -> int:
+    """Write 1-D/2-D uint32 lane arrays into ``out`` columns starting at
+    ``off``; returns the next free column. The ONE lane-layout fill loop
+    (_key_lanes_into + _wagg_rows share it)."""
+    for a in lanes:
+        if a.ndim == 1:
+            out[:, off] = a
+            off += 1
+        else:
+            w = a.shape[1]
+            out[:, off:off + w] = a
+            off += w
+    return off
+
+
+def _key_lanes_into(cols: dict, key_cols) -> np.ndarray:
+    """[N, W] uint32 key lanes written straight into ONE preallocated
+    C-contiguous buffer — no per-lane ``[:, None]`` reshapes and no
+    ``np.concatenate`` pass (ROADMAP 4a: the concat's temporaries were
+    most of the residual host_group share on the fused leg, where lane
+    extraction IS the prepare half). Same words as _key_lanes_np by
+    construction; ``bench.py fused`` carries the paired A/B."""
+    lanes = [_u32_lane(cols[name]) for name in key_cols]
+    n = lanes[0].shape[0]
+    total = sum(1 if a.ndim == 1 else a.shape[1] for a in lanes)
+    out = np.empty((n, total), np.uint32)
+    _fill_lanes(out, 0, lanes)
+    return out
+
+
 def _value_planes_np(cols: dict, value_cols,
                      scale_col: str | None = None) -> np.ndarray:
     """[N, P] float32 value planes with the device path's u32 saturation,
@@ -352,13 +382,17 @@ class HostGroupPipeline(FusedPipeline):
         cfg = m.config
         t = np.minimum(cols["time_received"], _U32_MAX).astype(np.uint32)
         slot = t - t % np.uint32(cfg.window_seconds)
-        lanes = [slot[:, None]]
-        for name in cfg.key_cols:
-            a = _u32_lane(cols[name])
-            lanes.append(a if a.ndim == 2 else a[:, None])
-        if cfg.scale_col:  # rate lane LAST, matching group_cols(cfg)
-            lanes.append(_u32_lane(cols[cfg.scale_col])[:, None])
-        lanes = np.concatenate(lanes, axis=1)
+        # lanes built straight into one preallocated buffer (the same
+        # no-concat discipline as _key_lanes_into): slot first, key
+        # lanes, rate lane LAST, matching group_cols(cfg)
+        key_lanes = [_u32_lane(cols[name]) for name in cfg.key_cols]
+        total = 1 + sum(1 if a.ndim == 1 else a.shape[1]
+                        for a in key_lanes) + (1 if cfg.scale_col else 0)
+        lanes = np.empty((n, total), np.uint32)
+        lanes[:, 0] = slot
+        off = _fill_lanes(lanes, 1, key_lanes)
+        if cfg.scale_col:
+            lanes[:, off] = _u32_lane(cols[cfg.scale_col])
         planes = [np.minimum(cols[name], _U32_MAX) for name in cfg.value_cols]
         return self._group_exact_planes(lanes, np.stack(planes, axis=1))
 

@@ -145,6 +145,10 @@ class StreamWorker:
             raise ValueError(
                 f"obs_audit must be off|sample|full, "
                 f"got {config.obs_audit!r}")
+        # invertible hh families (-hh.sketch=invertible) have no jitted
+        # table step: they are served by the host sketch pipeline
+        # (staged or fused) or, failing that, the per-model numpy path
+        hh_sketch = self._hh_sketch_mode(models)
         self.fused = None
         if config.fused and models:
             from .fused import FusedPipeline
@@ -177,6 +181,19 @@ class StreamWorker:
                     self.fused = FusedPipeline(models)
             else:
                 log.info("model set not fusable; using per-model updates")
+        if hh_sketch == "invertible" and self.fused is not None:
+            from ..hostsketch import HostSketchPipeline
+
+            if not isinstance(self.fused, HostSketchPipeline):
+                # the jitted table step cannot fold invertible state;
+                # only the host sketch engine (and the per-model numpy
+                # fallback) can — degrade loudly rather than corrupt
+                log.warning(
+                    "hh.sketch=invertible needs the host sketch "
+                    "pipeline (-sketch.backend=host + CPU backend or "
+                    "-processor.hostassist on); falling back to the "
+                    "per-model numpy path for this worker")
+                self.fused = None
         if config.ingest_fused == "on":
             # "on" is a hard requirement everywhere, not just inside the
             # pipeline constructor: any selection-level fallback above
@@ -313,7 +330,8 @@ class StreamWorker:
         from ..obs.buildinfo import publish_build_info
 
         publish_build_info(config.build_role,
-                           sketch_backend=config.sketch_backend)
+                           sketch_backend=config.sketch_backend,
+                           hh_sketch=hh_sketch)
         # flowlint: unguarded -- written by whichever single thread runs _write_rows (worker inline, or the one flusher thread)
         self._commit_watermark = 0.0
         # flowlint: unguarded -- worker thread only (set per _process step, read when queueing flush jobs)
@@ -327,6 +345,20 @@ class StreamWorker:
                 check = getattr(sink, "check_raw_schema", None)
                 if check is not None:
                     check()
+
+    @staticmethod
+    def _hh_sketch_mode(models: dict) -> str:
+        """The heavy-hitter sketch family this worker actually runs —
+        the flow_build_info ``hh_sketch`` label ("none" when the model
+        set has no sketch-backed hh family)."""
+        modes = {
+            getattr(m.model.config, "hh_sketch", "table")
+            for m in models.values()
+            if isinstance(m, WindowedHeavyHitter)
+            and getattr(m.model, "snapshot_kind", None) == "windowed_hh"}
+        if not modes:
+            return "none"
+        return "invertible" if "invertible" in modes else "table"
 
     # ---- main loop --------------------------------------------------------
 
@@ -737,11 +769,41 @@ class StreamWorker:
                     continue
                 if ms["kind"] == "windowed_hh":
                     hh = ms["hh"]  # NamedTuple decoded as field dict
-                    model.model.state = HHState(
-                        cms=jnp.asarray(hh["cms"]),
-                        table_keys=jnp.asarray(hh["table_keys"]),
-                        table_vals=jnp.asarray(hh["table_vals"]),
-                    )
+                    inv_cfg = getattr(model.model.config, "hh_sketch",
+                                      "table") == "invertible"
+                    if ("keysum" in hh) != inv_cfg:
+                        # a table-family checkpoint restored into an
+                        # invertible-config model (or vice versa): the
+                        # state layouts do not convert — skip loudly,
+                        # that window's sketch starts over (the same
+                        # discipline as the kind-mismatch skip above)
+                        log.warning(
+                            "checkpoint hh state for model %r is %s "
+                            "but the model runs hh_sketch=%s; skipping "
+                            "its state", name,
+                            "invertible" if "keysum" in hh else "table",
+                            model.model.config.hh_sketch)
+                        continue
+                    if inv_cfg:
+                        import numpy as np
+
+                        from ..models.heavy_hitter import InvState
+
+                        # numpy, NOT jnp: without x64 a jnp.asarray
+                        # would silently downcast the exact u64 planes
+                        model.model.state = InvState(
+                            cms=np.asarray(hh["cms"], dtype=np.uint64),
+                            keysum=np.asarray(hh["keysum"],
+                                              dtype=np.uint64),
+                            keycheck=np.asarray(hh["keycheck"],
+                                                dtype=np.uint64),
+                        )
+                    else:
+                        model.model.state = HHState(
+                            cms=jnp.asarray(hh["cms"]),
+                            table_keys=jnp.asarray(hh["table_keys"]),
+                            table_vals=jnp.asarray(hh["table_vals"]),
+                        )
                 else:
                     model.model.totals = jnp.asarray(ms["totals"])
                 model.current_slot = ms["current_slot"]

@@ -41,12 +41,23 @@ from ..schema.keys import hash_words_np
 from .state import (
     _U64_CAP,
     HostHHState,
+    HostInvState,
     from_device_state,
     host_hh_init,
+    host_inv_init,
+    is_inv_state,
     to_device_state,
 )
 
 _SENTINEL = np.uint32(0xFFFFFFFF)
+
+# Invertible-sketch checksum hash constants — protocol constants shared
+# bit-for-bit by native/hostsketch.cc inv_key_hash and
+# ops/invsketch.py inv_key_hash (all arithmetic mod 2^64).
+INV_HASH_SEED = np.uint64(0x9E3779B97F4A7C15)
+INV_HASH_M1 = np.uint64(0xFF51AFD7ED558CCD)
+INV_HASH_M2 = np.uint64(0xC4CEB9FE1A85EC53)
+_U64_ALL = np.uint64(0xFFFFFFFFFFFFFFFF)
 
 
 def sketch_backend_available() -> bool:
@@ -61,13 +72,18 @@ def sketch_backend_available() -> bool:
 
 
 def _addend_u64(vals: np.ndarray) -> np.ndarray:
-    """f32 addends -> u64, matching native addend_u64 (negatives and NaN
-    contribute nothing; out-of-envelope values clamp)."""
+    """f32 addends -> u64, matching native addend_u64 BIT-FOR-BIT
+    (negatives and NaN contribute nothing; values at/past 2^64 — inf
+    included — clamp to UINT64_MAX exactly like the C kernel's
+    ``v >= 2^64f -> UINT64_MAX`` branch; the rest cast exactly)."""
     v = np.asarray(vals, dtype=np.float32)
     with np.errstate(invalid="ignore"):
         v = np.where(np.isnan(v) | (v <= 0), np.float32(0.0), v)
+        big = v >= np.float32(2.0**64)
         v = np.minimum(v, _U64_CAP)
-    return v.astype(np.uint64)
+    out = v.astype(np.uint64)
+    out[big] = np.uint64(0xFFFFFFFFFFFFFFFF)
+    return out
 
 
 def _np_buckets(keys: np.ndarray, depth: int, width: int) -> np.ndarray:
@@ -169,6 +185,206 @@ def np_topk_merge(table_keys: np.ndarray, table_vals: np.ndarray,
     return new_keys, new_vals
 
 
+# ---- invertible sketch: numpy reference twins ------------------------------
+#
+# The invertible family (-hh.sketch=invertible; PAPERS.md 1910.10441's
+# recover-keys-from-the-sketch model, linearized) deletes the admission
+# machinery from the hot path: update is ONE pure per-bucket fold over
+# the same murmur3 buckets the CMS planes use —
+#
+#   cms[p, d, b]    += addend_u64(vals[p])          (plain; all planes)
+#   keysum[d, b, l] += key[l] * cnt                 (wrap)
+#   keycheck[d, b]  += inv_key_hash(key) * cnt      (wrap)
+#
+# Every cell is a plain u64 wrap sum, so the whole state is LINEAR in
+# the stream: chunk granularity, shard assignment and thread
+# interleaving cannot change it, and the mesh merge is an element-wise
+# u64 sum. Heavy keys are recovered only at window close by IBLT-style
+# peeling over pure buckets (np_inv_decode) — a bucket holding exactly
+# one distinct key divides out exactly and verifies against both the
+# checksum plane and its own bucket hash (false decode ~2^-64).
+# Conservative update is deliberately NOT offered: decode divides by
+# the count cell, which must be the bucket's exact sum.
+
+
+def np_inv_key_hash(keys: np.ndarray) -> np.ndarray:
+    """[n] uint64 checksum hash over [n, W] uint32 key lanes — the
+    numpy twin of native inv_key_hash (wrap arithmetic mod 2^64)."""
+    keys = np.asarray(keys, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        h = np.full(keys.shape[0], INV_HASH_SEED, np.uint64)
+        for lane in range(keys.shape[1]):
+            h = h ^ keys[:, lane].astype(np.uint64)
+            h = h * INV_HASH_M1
+            h = h ^ (h >> np.uint64(33))
+        h = h * INV_HASH_M2
+        h = h ^ (h >> np.uint64(29))
+    return h
+
+
+def np_inv_update(st: HostInvState, keys: np.ndarray,
+                  vals: np.ndarray) -> None:
+    """Invertible-sketch update in place over valid rows only (callers
+    slice). ``keys`` [n, kw] uint32; ``vals`` [n, P+1] float32 addends
+    with the count plane LAST (its u64 clamp is the key weight)."""
+    planes, depth, width = st.cms.shape
+    if keys.shape[0] == 0:
+        return
+    keys = np.asarray(keys, dtype=np.uint32)
+    buckets = _np_buckets(keys, depth, width)
+    add = _addend_u64(vals)
+    cnt = add[:, -1]
+    h64 = np_inv_key_hash(keys)
+    with np.errstate(over="ignore"):
+        for pi in range(planes):
+            for d in range(depth):
+                np.add.at(st.cms[pi, d], buckets[d], add[:, pi])
+        lanes_u64 = keys.astype(np.uint64) * cnt[:, None]
+        check = h64 * cnt
+        for d in range(depth):
+            np.add.at(st.keysum[d], buckets[d], lanes_u64)
+            np.add.at(st.keycheck[d], buckets[d], check)
+
+
+def np_inv_decode(cms: np.ndarray, keysum: np.ndarray,
+                  keycheck: np.ndarray):
+    """Heavy-key recovery by peeling pure buckets — the numpy twin of
+    native hs_inv_decode. Inputs read-only (the peel works on copies).
+    Returns (keys [K, kw] u32, vals [K, P+1] u64 exact sums) in
+    CANONICAL lexicographic key order, so every backend's decode is
+    array-equal (the recoverable set is peel-order independent)."""
+    planes, depth, width = cms.shape
+    kw = keysum.shape[2]
+    cms = cms.copy()
+    keysum = keysum.copy()
+    keycheck = keycheck.copy()
+    out_keys: list[np.ndarray] = []
+    out_vals: list[np.ndarray] = []
+    n_out = 0
+    # honest states decode at most depth*width keys (each decode zeroes
+    # its own bucket); the cap bounds the peel on crafted/corrupted
+    # states whose wrap subtractions keep re-activating buckets — the
+    # same guard the native kernel applies to its output buffers
+    max_out = depth * width
+    seen: set[bytes] = set()
+    cand = cms[-1] != 0  # [depth, width] candidate buckets this round
+    while cand.any() and n_out < max_out:
+        d_idx, b_idx = np.nonzero(cand)
+        cnt = cms[-1, d_idx, b_idx]
+        ok = cnt != 0
+        cnt_safe = np.where(ok, cnt, np.uint64(1))
+        ks = keysum[d_idx, b_idx, :]
+        q = ks // cnt_safe[:, None]
+        ok &= (q * cnt_safe[:, None] == ks).all(axis=1)  # divides evenly
+        ok &= (q <= np.uint64(0xFFFFFFFF)).all(axis=1)
+        qk = q.astype(np.uint32)
+        with np.errstate(over="ignore"):
+            ok &= np_inv_key_hash(qk) * cnt_safe == keycheck[d_idx, b_idx]
+        for d in range(depth):  # bucket-consistency, per seed row
+            m = ok & (d_idx == d)
+            if m.any():
+                h = hash_words_np(np.ascontiguousarray(qk[m]), seed=d)
+                ok[np.nonzero(m)[0][h % np.uint32(width) != b_idx[m]]] \
+                    = False
+        rows = np.flatnonzero(ok)
+        if not len(rows):
+            break
+        # dedup within the round (a key pure in several rows decodes in
+        # each; the exact values are identical) and against prior rounds
+        kview = np.ascontiguousarray(qk[rows]).view(
+            [("", np.uint32)] * kw).reshape(-1)
+        _, first = np.unique(kview, return_index=True)
+        picked = []
+        for i in sorted(first):
+            if kview[i].tobytes() not in seen:
+                seen.add(kview[i].tobytes())
+                picked.append(rows[i])
+        if not picked:
+            break
+        picked = np.asarray(picked[:max_out - n_out])
+        dec_keys = np.ascontiguousarray(qk[picked])
+        dec_vals = np.stack(
+            [cms[p, d_idx[picked], b_idx[picked]] for p in range(planes)],
+            axis=1)
+        out_keys.append(dec_keys)
+        out_vals.append(dec_vals)
+        n_out += len(picked)
+        # peel each decoded key's exact contribution from every depth
+        # row (wrap subtraction), then rescan only the touched buckets
+        dcnt = dec_vals[:, -1]
+        h64 = np_inv_key_hash(dec_keys)
+        touched = np.zeros((depth, width), bool)
+        with np.errstate(over="ignore"):
+            lanes_u64 = dec_keys.astype(np.uint64) * dcnt[:, None]
+            check = h64 * dcnt
+            for d in range(depth):
+                # flowlint: disable=uint64-discipline -- bucket INDICES in [0, width), not counters (same trade as _np_buckets)
+                bb = (hash_words_np(dec_keys, seed=d)
+                      % np.uint32(width)).astype(np.int64)
+                for p in range(planes):
+                    np.subtract.at(cms[p, d], bb, dec_vals[:, p])
+                np.subtract.at(keysum[d], bb, lanes_u64)
+                np.subtract.at(keycheck[d], bb, check)
+                touched[d, bb] = True
+        cand = touched & (cms[-1] != 0)
+    if not out_keys:
+        return (np.zeros((0, kw), np.uint32),
+                np.zeros((0, planes), np.uint64))
+    keys = np.concatenate(out_keys)
+    vals = np.concatenate(out_vals)
+    order = np.lexsort(keys.T[::-1])
+    return (np.ascontiguousarray(keys[order]),
+            np.ascontiguousarray(vals[order]))
+
+
+def inv_decode_state(state):
+    """Canonical (lex-ordered) decode of any invertible-state form —
+    HostInvState, the model-facing InvState, or a checkpoint/mesh field
+    dict. Uses the native kernel when available (its decode SET is
+    peel-order independent, so the lex sort makes backends
+    array-equal); the numpy twin otherwise."""
+    if isinstance(state, dict):
+        cms, ks, kc = state["cms"], state["keysum"], state["keycheck"]
+    else:
+        cms, ks, kc = state.cms, state.keysum, state.keycheck
+    cms = np.ascontiguousarray(np.asarray(cms), dtype=np.uint64)
+    ks = np.ascontiguousarray(np.asarray(ks), dtype=np.uint64)
+    kc = np.ascontiguousarray(np.asarray(kc), dtype=np.uint64)
+    from .. import native
+
+    if native.inv_available():
+        keys, vals = native.hs_inv_decode(cms, ks, kc)
+        order = np.lexsort(keys.T[::-1])
+        return (np.ascontiguousarray(keys[order]),
+                np.ascontiguousarray(vals[order]))
+    return np_inv_decode(cms, ks, kc)
+
+
+def inv_extract(state, capacity: int):
+    """Ranked candidate table from an invertible sketch at window close
+    — the decode-at-close twin of the table family's resident table.
+    Returns (table_keys [capacity, kw] u32 sentinel-padded, table_vals
+    [capacity, P+1] f32), ranked by the exact u64 primary sums
+    descending with the stable lexicographic tie-break — the same
+    (primary desc, lex asc) rule every table merge ranks by, so
+    downstream extraction/serve/mesh consumers see the familiar
+    layout. The all-sentinel key is dropped (unrepresentable in the
+    table layout, exactly like topk_merge_est drops it)."""
+    keys, vals = inv_decode_state(state)
+    real = (keys != _SENTINEL).any(axis=1)
+    keys, vals = keys[real], vals[real]
+    kw = keys.shape[1]
+    planes = vals.shape[1]
+    # stable ascending sort of (U64_MAX - primary) == primary desc with
+    # lex ties preserved (keys arrive lex-sorted from the decode)
+    order = np.argsort(_U64_ALL - vals[:, 0], kind="stable")[:capacity]
+    table_keys = np.full((capacity, kw), _SENTINEL, np.uint32)
+    table_vals = np.zeros((capacity, planes), np.float32)
+    table_keys[:len(order)] = keys[order]
+    table_vals[:len(order)] = vals[order].astype(np.float32)
+    return table_keys, table_vals
+
+
 # ---- the engine -----------------------------------------------------------
 
 
@@ -202,17 +418,28 @@ class HostSketchEngine:
         # pass an explicit count.
         self.threads = threads or max(1, min(4, (os.cpu_count() or 1) // 2))
         # flowlint: unguarded -- worker thread only (pipeline drives reset/import/update/export under worker.lock)
-        self.states: list[HostHHState | None] = [None] * len(self.configs)
+        self.states: list[HostHHState | HostInvState | None] = \
+            [None] * len(self.configs)
         for cfg in self.configs:
             if cfg.table_admission not in ("est", "plain"):
                 raise ValueError(
                     f"table_admission must be est|plain, got "
                     f"{cfg.table_admission!r}")
+            if getattr(cfg, "hh_sketch", "table") not in (
+                    "table", "invertible"):
+                raise ValueError(
+                    f"hh_sketch must be table|invertible, got "
+                    f"{cfg.hh_sketch!r}")
+
+    def _invertible(self, i: int) -> bool:
+        return getattr(self.configs[i], "hh_sketch", "table") \
+            == "invertible"
 
     # ---- state plumbing ---------------------------------------------------
 
     def reset(self, i: int) -> None:
-        self.states[i] = host_hh_init(self.configs[i])
+        self.states[i] = host_inv_init(self.configs[i]) \
+            if self._invertible(i) else host_hh_init(self.configs[i])
 
     def import_state(self, i: int, device_state) -> None:
         self.states[i] = from_device_state(device_state)
@@ -244,6 +471,20 @@ class HostSketchEngine:
         uniq = np.ascontiguousarray(uniq[:n_groups], dtype=np.uint32)
         sums = np.ascontiguousarray(sums[:n_groups], dtype=np.float32)
         threads = 1 if n_groups < 2048 else self.threads
+        if self._invertible(i):
+            # the invertible family's whole step: one pure per-bucket
+            # fold — no prefilter, no admission query, no table merge
+            if self.native:
+                from .. import native
+
+                if native.inv_available():
+                    native.hs_inv_update(st.cms, st.keysum, st.keycheck,
+                                         uniq, sums, None, threads,
+                                         stats=stats)
+                    return
+                # stale .so (pre-r16): the numpy twin is bit-identical
+            np_inv_update(st, uniq, sums)
+            return
         if self.native:
             from .. import native
 

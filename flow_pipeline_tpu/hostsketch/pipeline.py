@@ -36,11 +36,13 @@ from ..engine.hostfused import (
     PreparedChunk,
     _cached_apply,
     _degradation_reason,
+    _key_lanes_into,
     _key_lanes_np,
     _value_planes_np,
     mark_native_serving,
     report_native_degradation,
 )
+from .state import HostInvState
 from ..ingest.shard import ShardPool
 from ..obs import REGISTRY, get_logger
 from .engine import HostSketchEngine, sketch_backend_available
@@ -163,20 +165,45 @@ class HostSketchPipeline(HostGroupPipeline):
 
         if fused not in ("auto", "on", "off"):
             raise ValueError(f"fused must be auto|on|off, got {fused!r}")
+        any_inv = any(
+            getattr(w.config, "hh_sketch", "table") == "invertible"
+            for _, w in self._hh)
         can = native.fused_available() and self._engine.native
+        if any_inv and can and not native.inv_available():
+            # an .so with the fused plane but no hs_inv_update predates
+            # the invertible trailer on ff_fused_update — routing an
+            # invertible tree through it would run the table path on
+            # the wrong state layout (the degradation is reported once,
+            # below, with the staged engine's)
+            can = False
         if fused == "on" and not can:
             raise RuntimeError(
                 "ingest.fused=on but the fused native dataplane cannot "
                 "serve: " + ("the sketch engine is not native"
-                             if native.fused_available() else
-                             _degradation_reason("ff_fused_update", "r10")))
+                             if not self._engine.native else
+                             _degradation_reason("ff_fused_update", "r10")
+                             if not native.fused_available() else
+                             _degradation_reason("hs_inv_update", "r16")))
         self._fused = fused != "off" and can
+        if any_inv and self._engine.native:
+            # the staged engine ALSO routes invertible families through
+            # hs_inv_update: a stale .so quietly serving the numpy twin
+            # under a native flag must be loud (gauge + warning), and
+            # the healthy 0 published explicitly like every feature
+            if native.inv_available():
+                mark_native_serving("invsketch")
+            elif sketch_native != "numpy":
+                report_native_degradation(
+                    "invsketch",
+                    _degradation_reason("hs_inv_update", "r16"))
         if fused == "auto" and not can and sketch_native != "numpy":
             # production default wanted the fused plane: degrading to the
             # staged path must be loud (same contract as native_group)
             report_native_degradation(
                 "fused", _degradation_reason("ff_fused_update", "r10")
                 if not native.fused_available()
+                else _degradation_reason("hs_inv_update", "r16")
+                if any_inv and not native.inv_available()
                 else "sketch engine is not native")
         elif self._fused:
             mark_native_serving("fused")
@@ -242,7 +269,10 @@ class HostSketchPipeline(HostGroupPipeline):
                     [cfgs[f].table_admission == "plain" for f in ms],
                     np.uint8),
                 ddos_parent=ddos_parent, ddos_sel=ddos_sel,
-                ddos_plane=ddos_plane)))
+                ddos_plane=ddos_plane,
+                invertible=np.asarray(
+                    [getattr(cfgs[f], "hh_sketch", "table")
+                     == "invertible" for f in ms], np.uint8))))
 
     # ---- prepare half (fused: lane extraction only) ------------------------
 
@@ -267,8 +297,10 @@ class HostSketchPipeline(HostGroupPipeline):
         fused_in = []
         for ms, _plan in self._fused_trees:
             cfg = self._hh[ms[0]][1].config
-            lanes = np.ascontiguousarray(
-                _key_lanes_np(cols, cfg.key_cols), dtype=np.uint32)
+            # lanes built straight into one preallocated buffer — the
+            # extraction IS this path's prepare cost (ROADMAP 4a), so
+            # the concat's temporaries were pure overhead
+            lanes = _key_lanes_into(cols, cfg.key_cols)
             vals = np.ascontiguousarray(
                 _value_planes_np(cols, cfg.value_cols, cfg.scale_col),
                 dtype=np.float32)
@@ -440,7 +472,9 @@ class HostSketchPipeline(HostGroupPipeline):
         if self._audit_chunks % 8 == 1:
             for i, (name, _) in enumerate(self._hh):
                 st = self._engine.states[i]
-                if st is not None:
+                if st is not None and not isinstance(st, HostInvState):
+                    # invertible families have no candidate table — the
+                    # admission churn this probe measures does not exist
                     self.audit.note_table(name, st.table_keys)
 
     # ---- state synchronization --------------------------------------------

@@ -45,6 +45,22 @@ class HostHHState:
     table_vals: np.ndarray  # [capacity, P+1] float32, C-contiguous
 
 
+@dataclass
+class HostInvState:
+    """One family's host-resident INVERTIBLE sketch state
+    (-hh.sketch=invertible): the count/value planes plus the
+    key-recovery planes, all plain u64 wrap sums — linear in the
+    stream, so shards merge by element-wise u64 addition and heavy keys
+    decode from the sketch itself at window close
+    (hostsketch.engine.np_inv_decode / native hs_inv_decode). There is
+    NO candidate table: the admission machinery does not exist for this
+    family."""
+
+    cms: np.ndarray       # [P+1, depth, width] uint64, C-contiguous
+    keysum: np.ndarray    # [depth, width, key_width] uint64
+    keycheck: np.ndarray  # [depth, width] uint64
+
+
 def host_hh_init(config: HeavyHitterConfig) -> HostHHState:
     planes = len(config.value_cols) + 1  # + count plane
     w = key_width(config)
@@ -53,6 +69,26 @@ def host_hh_init(config: HeavyHitterConfig) -> HostHHState:
         table_keys=np.full((config.capacity, w), 0xFFFFFFFF, np.uint32),
         table_vals=np.zeros((config.capacity, planes), np.float32),
     )
+
+
+def host_inv_init(config: HeavyHitterConfig) -> HostInvState:
+    planes = len(config.value_cols) + 1  # + count plane
+    w = key_width(config)
+    return HostInvState(
+        cms=np.zeros((planes, config.depth, config.width), np.uint64),
+        keysum=np.zeros((config.depth, config.width, w), np.uint64),
+        keycheck=np.zeros((config.depth, config.width), np.uint64),
+    )
+
+
+def is_inv_state(state) -> bool:
+    """Whether any sketch-state form (HostInvState, the model-facing
+    InvState, or a checkpoint/mesh field dict) is an invertible-family
+    state — the one dispatch rule every cross-boundary consumer
+    (checkpoint restore, mesh codec/merge, sketchwatch) shares."""
+    if isinstance(state, dict):
+        return "keysum" in state
+    return hasattr(state, "keysum")
 
 
 def _cms_to_u64(cms) -> np.ndarray:
@@ -78,19 +114,39 @@ def frozen_cms(state) -> np.ndarray:
     payloads, flowserve's frozen per-key-estimate planes). Always
     copies: callers publish the result to readers that outlive the
     engine's in-place mutation."""
-    if isinstance(state, HostHHState):
+    if isinstance(state, (HostHHState, HostInvState)):
         return state.cms.copy()
-    if isinstance(state, np.ndarray):
-        return _cms_to_u64(state)
-    cms = state["cms"] if isinstance(state, dict) else state.cms
-    return _cms_to_u64(cms)
+    if not isinstance(state, np.ndarray):
+        state = state["cms"] if isinstance(state, dict) else state.cms
+    a = np.asarray(state)
+    if a.dtype == np.uint64:
+        # invertible states (and already-frozen payloads) carry exact
+        # u64 planes — routing them through the f32 conversion would
+        # destroy every cell past 2^24
+        return np.ascontiguousarray(a).copy()
+    return _cms_to_u64(a)
 
 
-def from_device_state(state) -> HostHHState:
-    """Import a device ``HHState`` (jax or numpy leaves; also accepts the
-    checkpoint loader's field-dict form) into engine-owned host buffers.
-    Always copies — the engine mutates its state in place and must never
-    alias arrays a LazyWindowTop or checkpoint may still read."""
+def _u64_leaf(a) -> np.ndarray:
+    """A fresh C-contiguous uint64 copy of an (already-u64) array leaf —
+    the invertible planes never round-trip through float."""
+    out = np.ascontiguousarray(np.asarray(a), dtype=np.uint64)
+    return out.copy() if out is a or not out.flags["OWNDATA"] else out
+
+
+def from_device_state(state):
+    """Import a model-facing state (``HHState``/``InvState``, jax or
+    numpy leaves; also accepts the checkpoint loader's field-dict form)
+    into engine-owned host buffers. Always copies — the engine mutates
+    its state in place and must never alias arrays a LazyWindowTop or
+    checkpoint may still read."""
+    if is_inv_state(state):
+        if isinstance(state, dict):
+            cms, ks, kc = state["cms"], state["keysum"], state["keycheck"]
+        else:
+            cms, ks, kc = state.cms, state.keysum, state.keycheck
+        return HostInvState(cms=_u64_leaf(cms), keysum=_u64_leaf(ks),
+                            keycheck=_u64_leaf(kc))
     if isinstance(state, dict):  # engine.checkpoint decodes NamedTuples so
         cms, tk, tv = (state["cms"], state["table_keys"],
                        state["table_vals"])
@@ -105,10 +161,21 @@ def from_device_state(state) -> HostHHState:
     )
 
 
-def to_device_state(host: HostHHState) -> HHState:
-    """Export engine state as a device-layout ``HHState`` with fresh numpy
+def to_device_state(host):
+    """Export engine state as a model-facing state with fresh numpy
     leaves (consumed by model.top()/top_lazy(), checkpoints, and a
-    backend switch back to the jitted path)."""
+    backend switch back to the jitted path). Invertible families export
+    an ``InvState`` — host-resident u64 by design (there is no f32
+    device layout for the key-recovery planes; the exact monoid IS the
+    canonical form)."""
+    if isinstance(host, HostInvState):
+        from ..models.heavy_hitter import InvState
+
+        return InvState(
+            cms=host.cms.copy(),
+            keysum=host.keysum.copy(),
+            keycheck=host.keycheck.copy(),
+        )
     return HHState(
         cms=host.cms.astype(np.float32),
         table_keys=host.table_keys.copy(),

@@ -24,7 +24,7 @@ import json
 import numpy as np
 
 from ..engine.checkpoint import _decode, _encode
-from ..hostsketch.state import HostHHState, frozen_cms
+from ..hostsketch.state import (HostHHState, frozen_cms, is_inv_state)
 
 MAGIC = b"FMSH1\n"
 
@@ -59,10 +59,28 @@ def decode(data: bytes):
 # the mesh). ``kind`` tags dispatch the coordinator-side merge.
 
 
+def _u64_plane(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a), dtype=np.uint64).copy()
+
+
 def hh_payload(state) -> dict:
     """Device/host HHState (or checkpoint field-dict) -> canonical
     uint64-CMS payload. Accepts jax or numpy leaves; always copies
-    (frozen_cms is the shared hostsketch export seam)."""
+    (frozen_cms is the shared hostsketch export seam).
+
+    Invertible-family states (InvState / HostInvState / field dicts
+    with key-recovery planes) ship as ``kind="hh_inv"``: the three u64
+    plane sets verbatim — self-contained and LINEAR, so the
+    coordinator's merge is a plain element-wise u64 sum (merge_hh
+    dispatches on the kind) and there is no extracted table to ship
+    until the merged window is decoded at close."""
+    if is_inv_state(state):
+        if isinstance(state, dict):
+            ks, kc = state["keysum"], state["keycheck"]
+        else:
+            ks, kc = state.keysum, state.keycheck
+        return {"kind": "hh_inv", "cms": frozen_cms(state),
+                "keysum": _u64_plane(ks), "keycheck": _u64_plane(kc)}
     if isinstance(state, HostHHState):
         return {"kind": "hh", "cms": frozen_cms(state),
                 "table_keys": state.table_keys.copy(),

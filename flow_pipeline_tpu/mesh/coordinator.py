@@ -279,7 +279,15 @@ class MeshCoordinator:
         self.audit_merged: dict[tuple[str, int], dict] = {}  # guarded-by: _merge_lock
         # model -> newest JSON-safe network-wide audit report
         self._audit_reports: dict[str, dict] = {}  # guarded-by: _merge_lock
-        publish_build_info("coordinator")
+        # the hh_sketch label reflects the family the mesh MERGES —
+        # dashboards must be able to tell which sketch produced the
+        # network-wide series (bench artifacts join against it)
+        hh_modes = {getattr(s.config, "hh_sketch", "table")
+                    for s in self.specs if s.kind == "hh"}
+        publish_build_info(
+            "coordinator",
+            hh_sketch=("invertible" if "invertible" in hh_modes
+                       else "table" if hh_modes else "none"))
 
     # ---- membership -------------------------------------------------------
 

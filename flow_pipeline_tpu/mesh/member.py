@@ -290,7 +290,8 @@ class MeshMember:
                     m.current_slot is not None:
                 payload = codec.capture_model(m.model)
                 aud = getattr(w.fused, "audit", None)
-                if aud is not None and payload.get("kind") == "hh":
+                if aud is not None and payload.get("kind") in (
+                        "hh", "hh_inv"):
                     # the carry must snapshot the open cohort too:
                     # a promoted carry's audit partial has to cover
                     # exactly the rows its sketch state covers
@@ -328,7 +329,7 @@ class MeshMember:
             for name, model_payload in models.items():
                 part = audit_closed.get(slot, {}).get(name)
                 if part is not None and \
-                        model_payload.get("kind") == "hh":
+                        model_payload.get("kind") in ("hh", "hh_inv"):
                     model_payload["audit"] = part
         with w.lock:
             w.sync_sketch_states()

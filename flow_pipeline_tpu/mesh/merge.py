@@ -66,7 +66,23 @@ def merge_hh(payloads: list[dict], config: HeavyHitterConfig) -> dict:
     row from every table, grouped by key (lexicographic), per-key plane
     sums, ranked by plane-0 descending with the stable lex tie-break,
     truncated to capacity.
+
+    Invertible payloads (kind="hh_inv", the -hh.sketch=invertible
+    family) dispatch to :func:`merge_hh_inv`: every plane merges by a
+    plain element-wise u64 sum — no table folds, no device-rank
+    semantics — and the merged table view is DECODED from the merged
+    sketch. Either way the merged dict carries {cms, table_keys,
+    table_vals}, so extraction, serving and the audit consume one
+    shape.
     """
+    if any(p.get("kind") == "hh_inv" for p in payloads):
+        if not all(p.get("kind") == "hh_inv" for p in payloads):
+            # one family must run ONE sketch flavor mesh-wide: a mixed
+            # fold has no exactness story (u64 planes vs f32 tables)
+            raise ValueError(
+                "cannot merge mixed hh/hh_inv payloads for one family "
+                "— every member must run the same -hh.sketch")
+        return merge_hh_inv(payloads, config)
     planes = len(config.value_cols) + 1
     kw = key_width(config)
     cms = np.zeros((planes, config.depth, config.width), np.uint64)
@@ -97,6 +113,43 @@ def merge_hh(payloads: list[dict], config: HeavyHitterConfig) -> dict:
     # linearity argument rests on — the merged cohort IS the cohort a
     # single worker seeing the whole stream would have built
     audits = [p["audit"] for p in payloads if p.get("audit") is not None]
+    if audits:
+        out["audit"] = merge_audit(audits)
+    return out
+
+
+def merge_hh_inv(payloads: list[dict], config: HeavyHitterConfig) -> dict:
+    """Fold invertible-family payloads: element-wise u64 wrap sum of
+    the count/value planes AND the key-recovery planes — the whole
+    merge (the sketch is linear in the stream, so the sum of per-shard
+    states IS the state of the union stream, bit-exactly). The merged
+    table view is then decoded ONCE from the merged sketch
+    (hostsketch.engine.inv_extract), so `hh_top_rows`, the serve
+    publisher and the merged-cohort audit consume the same
+    {cms, table_keys, table_vals} shape table merges produce."""
+    from ..hostsketch.engine import inv_extract
+
+    planes = len(config.value_cols) + 1
+    kw = key_width(config)
+    cms = np.zeros((planes, config.depth, config.width), np.uint64)
+    keysum = np.zeros((config.depth, config.width, kw), np.uint64)
+    keycheck = np.zeros((config.depth, config.width), np.uint64)
+    with np.errstate(over="ignore"):
+        for p in payloads:
+            # asarray, not astype: hh_inv payloads are u64 by
+            # construction (codec._u64_plane) — astype would allocate a
+            # throwaway copy of every plane set per member per merge
+            cms += np.asarray(p["cms"], dtype=np.uint64)
+            keysum += np.asarray(p["keysum"], dtype=np.uint64)
+            keycheck += np.asarray(p["keycheck"], dtype=np.uint64)
+    table_keys, table_vals = inv_extract(
+        {"cms": cms, "keysum": keysum, "keycheck": keycheck},
+        config.capacity)
+    out = {"kind": "hh", "cms": cms, "table_keys": table_keys,
+           "table_vals": table_vals, "keysum": keysum,
+           "keycheck": keycheck}
+    audits = [p["audit"] for p in payloads
+              if p.get("audit") is not None]
     if audits:
         out["audit"] = merge_audit(audits)
     return out

@@ -81,6 +81,21 @@ class HeavyHitterConfig:
     # sketches are approximate by contract. None disables. With the
     # mocker (rate 1) outputs are unchanged.
     scale_col: str | None = "sampling_rate"
+    # Sketch family (-hh.sketch): "table" keeps the CMS + top-K
+    # admission table (prefilter -> admission CMS query -> table merge —
+    # ~56% of the fused native pass, BENCH_r11); "invertible" replaces
+    # the whole admission path with key-recovery planes folded next to
+    # the CMS buckets (keysum/keycheck u64 wrap sums — ops/invsketch,
+    # hostsketch/engine np_inv_*, native hs_inv_*): update is one pure
+    # per-bucket fold, heavy keys are DECODED from the sketch at window
+    # close, and the mesh merge degenerates to a plain element-wise u64
+    # sum. Invertible forces the PLAIN count-plane update (decode
+    # divides by the count cell, which must be the bucket's exact sum),
+    # so `conservative`, `table_prefilter` and `table_admission` are
+    # ignored for this family. Production home: the host dataplane
+    # (-sketch.backend=host, fused or staged); other pipelines fall
+    # back to the per-model numpy path with a warning.
+    hh_sketch: str = "table"
 
 
 class HHState(NamedTuple):
@@ -89,6 +104,19 @@ class HHState(NamedTuple):
     cms: jnp.ndarray  # [P+1, depth, width] (value planes + count plane)
     table_keys: jnp.ndarray  # [C, W]
     table_vals: jnp.ndarray  # [C, P+1]
+
+
+class InvState(NamedTuple):
+    """Invertible-family sketch state (hh_sketch="invertible"): exact
+    uint64 planes, HOST-resident numpy by design — the key-recovery
+    planes have no f32 device layout (a lane times a count does not fit
+    the float-exact envelope), so the u64 monoid IS the canonical form.
+    The jnp twin (ops/invsketch) serves x64-enabled devices; the
+    production home is the native host dataplane."""
+
+    cms: np.ndarray       # [P+1, depth, width] uint64
+    keysum: np.ndarray    # [depth, width, key_width] uint64
+    keycheck: np.ndarray  # [depth, width] uint64
 
 
 def key_width(config: HeavyHitterConfig) -> int:
@@ -103,7 +131,23 @@ def input_cols(config: HeavyHitterConfig) -> list[str]:
     return out
 
 
-def hh_init(config: HeavyHitterConfig) -> HHState:
+def inv_init(config: HeavyHitterConfig) -> InvState:
+    planes = len(config.value_cols) + 1  # + count
+    w = key_width(config)
+    return InvState(
+        cms=np.zeros((planes, config.depth, config.width), np.uint64),
+        keysum=np.zeros((config.depth, config.width, w), np.uint64),
+        keycheck=np.zeros((config.depth, config.width), np.uint64),
+    )
+
+
+def hh_init(config: HeavyHitterConfig):
+    if config.hh_sketch not in ("table", "invertible"):
+        raise ValueError(
+            f"hh_sketch must be table|invertible, got "
+            f"{config.hh_sketch!r}")
+    if config.hh_sketch == "invertible":
+        return inv_init(config)
     planes = len(config.value_cols) + 1  # + count
     tk, tv = topk_ops.topk_init(config.capacity, key_width(config), planes)
     return HHState(
@@ -260,6 +304,35 @@ def _top_from_state(state: HHState, config: HeavyHitterConfig,
     return out
 
 
+def _inv_top_from_state(state: InvState, config: HeavyHitterConfig,
+                        k: int) -> dict[str, np.ndarray]:
+    """Top-k rows from one invertible state — the decode-at-close twin
+    of _top_from_state: heavy keys recovered from the sketch itself
+    (hostsketch.engine.inv_extract), ranked exactly like the table
+    family ((primary desc, lex asc)); est columns stay the CMS
+    min-over-depth point estimates off the same count/value planes.
+    Output columns are shape- and dtype-identical to the table path's."""
+    from ..hostsketch.engine import inv_extract, np_cms_query
+
+    keys, vals = inv_extract(state, config.capacity)
+    keys, vals = keys[:k], vals[:k]
+    valid = (keys != np.uint32(0xFFFFFFFF)).any(axis=1)
+    ests = np_cms_query(np.asarray(state.cms), keys)
+    out: dict[str, np.ndarray] = {}
+    col = 0
+    for name in config.key_cols:
+        w = lane_width(name)
+        out[name] = keys[:, col:col + w] if w == 4 else keys[:, col]
+        col += w
+    for j, name in enumerate(config.value_cols):
+        out[name] = vals[:, j]
+        out[f"{name}_est"] = ests[:, j]
+    out["count"] = vals[:, -1]
+    out["count_est"] = ests[:, -1]
+    out["valid"] = valid
+    return out
+
+
 class HeavyHitterModel:
     """Host wrapper: feed batches, extract top-K at window close."""
 
@@ -270,6 +343,9 @@ class HeavyHitterModel:
         self.state = hh_init(config)
 
     def update(self, batch: FlowBatch) -> None:
+        if self.config.hh_sketch == "invertible":
+            self._inv_update(batch)
+            return
         bs = self.config.batch_size
         for start in range(0, len(batch), bs):  # chunk arbitrary batch sizes
             padded, mask = batch.slice(start, start + bs).pad_to(bs)
@@ -278,6 +354,32 @@ class HeavyHitterModel:
             self.state = hh_update(
                 self.state, cols, jnp.asarray(mask), config=self.config
             )
+
+    def _inv_update(self, batch: FlowBatch) -> None:
+        """Per-model fallback for the invertible family (the production
+        home is the host pipeline, whose engine folds the prepared
+        group tables instead): group each chunk exactly like the staged
+        prepare half, then run the numpy twin in place. Mutates the
+        state arrays (callers that capture state — top_lazy — copy)."""
+        from ..engine.hostfused import _key_lanes_np, _value_planes_np
+        from ..hostsketch.engine import np_inv_update
+        from ..ops.hostgroup import group_by_key
+
+        cfg = self.config
+        bs = cfg.batch_size
+        for start in range(0, len(batch), bs):
+            chunk = batch.slice(start, start + bs)
+            if len(chunk) == 0:
+                continue
+            cols = chunk.columns
+            lanes = _key_lanes_np(cols, cfg.key_cols)
+            vals = _value_planes_np(cols, cfg.value_cols, cfg.scale_col)
+            uniq, sums, counts = group_by_key(lanes, [vals], exact=False)
+            addends = np.concatenate(
+                [sums[0].astype(np.float32),
+                 counts.astype(np.float32)[:, None]], axis=1)
+            np_inv_update(self.state, np.ascontiguousarray(
+                uniq, dtype=np.uint32), addends)
 
     def top(self, k: int | None = None) -> dict[str, np.ndarray]:
         """Top-k rows: keys split back into columns + estimated sums.
@@ -289,9 +391,16 @@ class HeavyHitterModel:
         while resident. ``est`` columns are the CMS point estimates at
         extraction time — an independent upper bound (tighter under
         conservative update); for a key resident since window start the
-        table value is the exact observed sum and ``est`` bounds it."""
-        return _top_from_state(self.state, self.config,
-                               k or self.config.capacity)
+        table value is the exact observed sum and ``est`` bounds it.
+
+        The invertible family has no table: the ranking is DECODED from
+        the sketch here (hostsketch.engine.inv_extract — once per read,
+        which window-close extraction and snapshot publishes amortize),
+        and decoded values are the keys' exact sums, not upper bounds."""
+        k = k or self.config.capacity
+        if self.config.hh_sketch == "invertible":
+            return _inv_top_from_state(self.state, self.config, k)
+        return _top_from_state(self.state, self.config, k)
 
     def top_lazy(self, k: int | None = None):
         """Zero-arg closure producing top(k) from the state captured NOW.
@@ -299,9 +408,15 @@ class HeavyHitterModel:
         For the ingest runtime's background flusher: state arrays are
         immutable and reset()/update() replace rather than mutate them,
         so the extraction (a device sync) can run off-thread after the
-        window rolls."""
+        window rolls. The invertible fallback path (_inv_update) mutates
+        in place, so that family captures fresh copies — once per
+        window close, the same cost class as the decode itself."""
         state, config = self.state, self.config
         k = k or config.capacity
+        if config.hh_sketch == "invertible":
+            state = InvState(state.cms.copy(), state.keysum.copy(),
+                             state.keycheck.copy())
+            return lambda: _inv_top_from_state(state, config, k)
         return lambda: _top_from_state(state, config, k)
 
     def reset(self) -> None:

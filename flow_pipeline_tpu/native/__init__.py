@@ -150,6 +150,31 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_longlong,
             ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
         ]
+    if hasattr(lib, "hs_inv_update"):  # pre-r16 .so lacks the invertible
+        lib.hs_inv_update.restype = ctypes.c_longlong
+        lib.hs_inv_update.argtypes = [
+            ctypes.c_void_p,  # [P, D, W] uint64 count/value planes (in place)
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [D, W, kw] uint64 keysum planes (in place)
+            ctypes.c_void_p,  # [D, W] uint64 checksum plane (in place)
+            ctypes.c_void_p,  # [n, kw] uint32 keys
+            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, P] float32 addends (count plane last)
+            ctypes.c_void_p,  # [n] uint8 valid (NULL = all)
+            ctypes.c_int,     # threads
+            ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
+        ]
+        lib.hs_inv_decode.restype = ctypes.c_longlong
+        lib.hs_inv_decode.argtypes = [
+            ctypes.c_void_p,  # [P, D, W] uint64 count/value planes
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [D, W, kw] uint64 keysum planes
+            ctypes.c_void_p,  # [D, W] uint64 checksum plane
+            ctypes.c_longlong,
+            ctypes.c_void_p,  # [D*W, kw] uint32 decoded keys out
+            ctypes.c_void_p,  # [D*W, P] uint64 decoded sums out
+            ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
+        ]
     if hasattr(lib, "ff_group_sum"):  # pre-r10 .so lacks the fused plane
         lib.ff_group_sum.restype = ctypes.c_longlong
         lib.ff_group_sum.argtypes = [
@@ -189,6 +214,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_void_p,  # [n] float32 ddos sums out
             ctypes.c_int,     # threads
             ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
+            # r16 invertible trailer (safe past a pre-r16 .so: extra
+            # cdecl args are ignored, and invertible trees are gated on
+            # the hs_inv_update export which only r16+ builds carry)
+            ctypes.c_void_p,  # [nf] uint8 invertible flags (NULL = none)
+            ctypes.POINTER(ctypes.c_void_p),  # [nf] keysum buffers
+            ctypes.POINTER(ctypes.c_void_p),  # [nf] keycheck buffers
         ]
     return lib
 
@@ -214,6 +245,8 @@ FF_STAT_SLOTS = {
     "prefilter": 4,  # hs_hh_prefilter (ns)
     "topk": 5,       # hs_cms_query (admission est) + hs_topk_merge (ns)
     "fold": 6,       # root group-table accumulation (ns)
+    "inv": 10,       # hs_inv_update / hs_inv_decode (the invertible
+                     # family's whole sketch fold — no admission phases)
 }
 FF_STAT_PHASES = tuple(FF_STAT_SLOTS)  # ns-valued phase slots, in order
 FF_STAT_ROWS = 7
@@ -246,6 +279,7 @@ _FEATURE_SYMBOLS = {
     "group": "flow_hash_group",
     "sketch": "hs_cms_update",
     "fused": "ff_fused_update",
+    "invsketch": "hs_inv_update",
 }
 
 
@@ -458,6 +492,77 @@ def hs_topk_merge(table_keys: np.ndarray, table_vals: np.ndarray,
     return int(rc)
 
 
+def inv_available() -> bool:
+    """Whether the loaded library exports the invertible sketch kernels
+    (an .so built before r16 serves the table family fine but cannot
+    run -hh.sketch=invertible natively)."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "hs_inv_update")
+
+
+def hs_inv_update(cms: np.ndarray, keysum: np.ndarray,
+                  keycheck: np.ndarray, keys: np.ndarray,
+                  vals: np.ndarray, valid, threads: int = 1,
+                  stats: Optional[np.ndarray] = None) -> None:
+    """Native invertible-sketch update in place — one pure per-bucket
+    fold (u64 count/value planes + key-recovery planes), no admission
+    machinery. cms [P, D, W] u64; keysum [D, W, kw] u64; keycheck
+    [D, W] u64; keys [n, kw] u32; vals [n, P] f32 (count plane LAST).
+    Deterministic for any thread count (plain wrap adds are order-free;
+    see native/hostsketch.cc). Raises on degenerate shapes."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "hs_inv_update"):
+        raise RuntimeError("libflowdecode.so missing the invertible "
+                           "sketch kernels; run `make native`")
+    assert cms.dtype == np.uint64 and cms.flags["C_CONTIGUOUS"]
+    assert keysum.dtype == np.uint64 and keysum.flags["C_CONTIGUOUS"]
+    assert keycheck.dtype == np.uint64 and keycheck.flags["C_CONTIGUOUS"]
+    p, d, w = cms.shape
+    keys = np.ascontiguousarray(keys, dtype=np.uint32)
+    vals = np.ascontiguousarray(vals, dtype=np.float32)
+    n, kw = keys.shape
+    assert keysum.shape == (d, w, kw) and keycheck.shape == (d, w)
+    vptr = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, dtype=np.uint8)
+        vptr = _c_arr(valid)
+    rc = lib.hs_inv_update(_c_arr(cms), p, d, w, _c_arr(keysum),
+                           _c_arr(keycheck), _c_arr(keys), n, kw,
+                           _c_arr(vals), vptr, int(threads),
+                           _stats_ptr(stats))
+    if rc != 0:
+        raise ValueError(f"hs_inv_update failed (rc={rc}): degenerate "
+                         f"shape planes={p} depth={d} width={w} kw={kw}")
+
+
+def hs_inv_decode(cms: np.ndarray, keysum: np.ndarray,
+                  keycheck: np.ndarray,
+                  stats: Optional[np.ndarray] = None):
+    """Native heavy-key recovery from an invertible sketch (IBLT-style
+    peel over pure buckets; inputs read-only). Returns (keys [K, kw]
+    u32, vals [K, P] u64) in the kernel's peel order — callers
+    canonicalize (hostsketch.engine lex-sorts before ranking)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "hs_inv_decode"):
+        raise RuntimeError("libflowdecode.so missing the invertible "
+                           "sketch kernels; run `make native`")
+    assert cms.dtype == np.uint64 and cms.flags["C_CONTIGUOUS"]
+    assert keysum.dtype == np.uint64 and keysum.flags["C_CONTIGUOUS"]
+    assert keycheck.dtype == np.uint64 and keycheck.flags["C_CONTIGUOUS"]
+    p, d, w = cms.shape
+    kw = keysum.shape[2]
+    assert keysum.shape == (d, w, kw) and keycheck.shape == (d, w)
+    keys_out = np.empty((d * w, kw), np.uint32)
+    vals_out = np.empty((d * w, p), np.uint64)
+    n = lib.hs_inv_decode(_c_arr(cms), p, d, w, _c_arr(keysum),
+                          _c_arr(keycheck), kw, _c_arr(keys_out),
+                          _c_arr(vals_out), _stats_ptr(stats))
+    if n < 0:
+        raise ValueError(f"hs_inv_decode failed (rc={n})")
+    n = int(n)
+    return keys_out[:n], vals_out[:n]
+
+
 def fused_available() -> bool:
     """Whether the loaded library exports the fused dataplane (an .so
     built before r10 decodes, groups and sketches fine but cannot run
@@ -521,6 +626,10 @@ class FusedPlan:
     ddos_parent: int = -1         # family index, -1 = no ddos side table
     ddos_sel: Optional[np.ndarray] = None  # [ddos_sel_w] int64
     ddos_plane: int = -1
+    # [nf] uint8 — families running -hh.sketch=invertible (their states
+    # are HostInvState; the admission path is never entered for them).
+    # None = all-table, the pre-r16 plan shape.
+    invertible: Optional[np.ndarray] = None
 
 
 def fused_update(lanes: np.ndarray, vals: np.ndarray, plan: FusedPlan,
@@ -566,14 +675,35 @@ def fused_update(lanes: np.ndarray, vals: np.ndarray, plan: FusedPlan,
     cms_ptrs = (ctypes.c_void_p * nf)()
     tkey_ptrs = (ctypes.c_void_p * nf)()
     tval_ptrs = (ctypes.c_void_p * nf)()
+    inv_ks_ptrs = (ctypes.c_void_p * nf)()
+    inv_kc_ptrs = (ctypes.c_void_p * nf)()
+    inv_flags = None
+    if plan.invertible is not None:
+        inv_flags = np.ascontiguousarray(plan.invertible, dtype=np.uint8)
+        if inv_flags.any() and not inv_available():
+            # the loaded .so predates hs_inv_update — its ff_fused_update
+            # also predates the invertible trailer and would silently
+            # run the table path on inv state buffers
+            raise RuntimeError("libflowdecode.so missing the invertible "
+                              "sketch kernels; run `make native`")
     if do_sketch:
         for i, st in enumerate(states):
             assert st.cms.dtype == np.uint64 and st.cms.flags["C_CONTIGUOUS"]
+            cms_ptrs[i] = st.cms.ctypes.data_as(ctypes.c_void_p).value
+            if inv_flags is not None and inv_flags[i]:
+                assert st.keysum.dtype == np.uint64 and \
+                    st.keysum.flags["C_CONTIGUOUS"]
+                assert st.keycheck.dtype == np.uint64 and \
+                    st.keycheck.flags["C_CONTIGUOUS"]
+                inv_ks_ptrs[i] = st.keysum.ctypes.data_as(
+                    ctypes.c_void_p).value
+                inv_kc_ptrs[i] = st.keycheck.ctypes.data_as(
+                    ctypes.c_void_p).value
+                continue
             assert st.table_keys.dtype == np.uint32 and \
                 st.table_keys.flags["C_CONTIGUOUS"]
             assert st.table_vals.dtype == np.float32 and \
                 st.table_vals.flags["C_CONTIGUOUS"]
-            cms_ptrs[i] = st.cms.ctypes.data_as(ctypes.c_void_p).value
             tkey_ptrs[i] = st.table_keys.ctypes.data_as(
                 ctypes.c_void_p).value
             tval_ptrs[i] = st.table_vals.ctypes.data_as(
@@ -599,7 +729,9 @@ def fused_update(lanes: np.ndarray, vals: np.ndarray, plan: FusedPlan,
         plan.ddos_plane if ddos_parent >= 0 else -1,
         _c_arr(ddos_keys) if ddos_keys is not None else None,
         _c_arr(ddos_sums) if ddos_sums is not None else None,
-        int(threads), _stats_ptr(stats))
+        int(threads), _stats_ptr(stats),
+        _c_arr(inv_flags) if inv_flags is not None else None,
+        inv_ks_ptrs, inv_kc_ptrs)
     if g < 0:
         raise ValueError(f"ff_fused_update failed (rc={g}): degenerate "
                          f"shape n={n} w={w} p={p} nf={nf}")

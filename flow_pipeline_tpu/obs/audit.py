@@ -220,12 +220,26 @@ def sample_mask(lanes: np.ndarray, mode: str = "sample") -> np.ndarray:
 # ---- pure evaluation helpers (shared by worker audit + coordinator) -------
 
 
-def _state_arrays(state):
+def _state_arrays(state, config=None):
     """(cms u64 [P+1,D,W], table_keys u32, table_vals f32) from any
-    sketch-state form: device HHState, HostHHState, or a merged mesh
-    payload dict."""
-    from ..hostsketch.state import frozen_cms
+    sketch-state form: device HHState, HostHHState, a merged mesh
+    payload dict — or an invertible-family state (InvState /
+    HostInvState / field dict), whose "table" is DECODED from the
+    sketch at ``config.capacity`` (the exact ranking the family emits;
+    audit metrics are therefore backend-agnostic by construction).
+    Merged invertible payloads arrive pre-decoded (merge_hh_inv ships
+    table columns next to the planes) and take the table path."""
+    from ..hostsketch.state import frozen_cms, is_inv_state
 
+    has_table = (("table_keys" in state) if isinstance(state, dict)
+                 else hasattr(state, "table_keys"))
+    if not has_table and is_inv_state(state):
+        from ..hostsketch.engine import inv_extract
+
+        assert config is not None, \
+            "invertible-state audit needs the family config (capacity)"
+        tk, tv = inv_extract(state, config.capacity)
+        return frozen_cms(state), tk, tv
     cms = frozen_cms(state)
     if isinstance(state, dict):
         tk, tv = state["table_keys"], state["table_vals"]
@@ -264,7 +278,7 @@ def audit_report(keys: np.ndarray, vals: np.ndarray, state, config,
     """
     from ..hostsketch.engine import np_cms_query_u64
 
-    cms, tkeys, tvals = _state_arrays(state)
+    cms, tkeys, tvals = _state_arrays(state, config)
     n = keys.shape[0]
     report: dict = {"slot": None if slot is None else int(slot),
                     "sampled_keys": int(n), "k": int(k)}

@@ -21,6 +21,10 @@ Labels:
   up as a silent slowdown
 - ``trace``  — the flowtrace recorder mode at publish time
 - ``sketch`` — the sketch backend (device | host)
+- ``hh_sketch`` — the heavy-hitter sketch family actually serving
+  (table | invertible | none when the model set has no sketch-backed
+  hh family) — bench artifacts and dashboards must be able to tell
+  which family produced every series (-hh.sketch)
 """
 
 from __future__ import annotations
@@ -35,7 +39,7 @@ BUILD_INFO = (
 
 
 def publish_build_info(role: str, sketch_backend: str = "device",
-                       **labels):
+                       hh_sketch: str = "table", **labels):
     """Set the identity gauge for this process/role; returns the gauge
     (tests read it back). Safe to call repeatedly — re-publishing the
     same label set is an idempotent set(1)."""
@@ -46,5 +50,5 @@ def publish_build_info(role: str, sketch_backend: str = "device",
     native = ",".join(sorted(f for f, ok in caps.items() if ok)) or "none"
     g = REGISTRY.gauge(*BUILD_INFO)
     g.set(1, role=role, native=native, trace=TRACER.mode,
-          sketch=sketch_backend, **labels)
+          sketch=sketch_backend, hh_sketch=hh_sketch, **labels)
     return g

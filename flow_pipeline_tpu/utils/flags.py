@@ -35,7 +35,7 @@ KNOWN_FLAGS = frozenset({
     "model.flows5m", "model.talkers", "model.ips", "model.ports",
     "model.ddos",
     "sketch.width", "sketch.cms", "sketch.prefilter", "sketch.admission",
-    "sketch.capacity", "sketch.topk", "sketch.backend",
+    "sketch.capacity", "sketch.topk", "sketch.backend", "hh.sketch",
     "window.lateness", "archive.raw", "feed.prefetch",
     "ingest.mode", "ingest.shards", "ingest.depth", "ingest.flush_queue",
     "ingest.native_group", "ingest.fused",

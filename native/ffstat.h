@@ -31,6 +31,9 @@ enum FfStat {
   FF_STAT_ROWS = 7,          // input rows seen (root families)
   FF_STAT_GROUPS = 8,        // groups produced (all families)
   FF_STAT_RADIX_PASSES = 9,  // radix passes executed
+  FF_STAT_INV_NS = 10,       // hs_inv_update / hs_inv_decode (the
+                             // invertible family's whole sketch fold —
+                             // it has no cms/prefilter/topk phases)
 };
 
 constexpr int kFfStatsLen = 16;

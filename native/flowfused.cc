@@ -74,6 +74,11 @@ long long hs_topk_merge(uint32_t* table_keys, float* table_vals,
                         const uint32_t* cand_keys, const float* cand_sums,
                         const float* cand_est, const uint8_t* cand_valid,
                         long long n, int64_t* stats);
+long long hs_inv_update(uint64_t* cms, long long planes, long long depth,
+                        long long width, uint64_t* keysum,
+                        uint64_t* keycheck, const uint32_t* keys,
+                        long long n, long long kw, const float* vals,
+                        const uint8_t* valid, int threads, int64_t* stats);
 }  // extern "C"
 
 namespace {
@@ -156,9 +161,10 @@ void accumulate(const uint32_t* lanes, long long m, long long wk,
 // delegated to the hs_* kernels the staged engine calls.
 long long sketch_family(const FamTable& fam, long long p, long long depth,
                         long long width, long long cap, int conservative,
-                        int prefilter, int admission_plain, uint64_t* cms,
-                        uint32_t* tkeys, float* tvals, int threads,
-                        int64_t* stats) {
+                        int prefilter, int admission_plain, int invertible,
+                        uint64_t* cms, uint32_t* tkeys, float* tvals,
+                        uint64_t* inv_keysum, uint64_t* inv_keycheck,
+                        int threads, int64_t* stats) {
   long long g = fam.g;
   if (g <= 0) return 0;  // all-invalid chunk: CMS and table both no-ops
   long long planes = p + 1;  // + count plane
@@ -175,6 +181,14 @@ long long sketch_family(const FamTable& fam, long long p, long long depth,
   // same serial gate as HostSketchEngine.update: under 2048 groups the
   // spawn/join overhead exceeds the win
   int t = g < 2048 ? 1 : threads;
+  if (invertible) {
+    // the whole admission path (prefilter -> admission CMS query ->
+    // top-K merge) does not exist for the invertible family: one pure
+    // per-bucket fold, heavy keys recovered at window close
+    return hs_inv_update(cms, planes, depth, width, inv_keysum,
+                         inv_keycheck, fam.keys.data(), g, fam.wk,
+                         sums.data(), nullptr, t, stats) == 0 ? 0 : -1;
+  }
   long long rc = hs_cms_update(cms, planes, depth, width, fam.keys.data(),
                                g, fam.wk, sums.data(), nullptr,
                                conservative, t, stats);
@@ -296,6 +310,14 @@ long long ff_group_sum(const uint32_t* lanes, long long n, long long w,
 // sketch phases inside the hs_* kernels the buffer rides through.
 // Returns the DDoS side-table group count (0 when ddos_parent < 0), or
 // -1 on degenerate shapes / kernel failure.
+// Invertible families (-hh.sketch=invertible) ride the same tree:
+// `finv` (nullable = all-table) marks them, `inv_ks_ptrs`/`inv_kc_ptrs`
+// carry their keysum/keycheck planes, and their table/prefilter
+// parameters are ignored — the admission path is simply never entered.
+// The three parameters trail the r10 signature so a stale pre-r16 .so
+// called with table-only trees still computes correctly (extra cdecl
+// args are ignored); invertible trees are gated Python-side on the
+// hs_inv_update export, which only r16+ builds carry.
 long long ff_fused_update(const uint32_t* lanes, long long n, long long w,
                           const float* vals, long long p, long long nf,
                           const int64_t* parent, const int64_t* sel,
@@ -308,7 +330,9 @@ long long ff_fused_update(const uint32_t* lanes, long long n, long long w,
                           long long ddos_parent, const int64_t* ddos_sel,
                           long long ddos_sel_w, long long ddos_plane,
                           uint32_t* ddos_keys_out, float* ddos_sums_out,
-                          int threads, int64_t* stats) {
+                          int threads, int64_t* stats,
+                          const uint8_t* finv, void** inv_ks_ptrs,
+                          void** inv_kc_ptrs) {
   if (n < 0 || w < 1 || p < 0 || nf < 1 || parent[0] != -1) return -1;
   if (ddos_parent >= nf ||
       (ddos_parent >= 0 &&
@@ -380,12 +404,16 @@ long long ff_fused_update(const uint32_t* lanes, long long n, long long w,
       }
     }
     if (do_sketch) {
+      int inv = finv != nullptr && finv[f];
       long long rc = sketch_family(
           fams[static_cast<size_t>(f)], p, fdepth[f], fwidth[f], fcap[f],
-          fconserv[f], fprefilter[f], fplain[f],
+          fconserv[f], fprefilter[f], fplain[f], inv,
           static_cast<uint64_t*>(cms_ptrs[f]),
-          static_cast<uint32_t*>(tkey_ptrs[f]),
-          static_cast<float*>(tval_ptrs[f]), threads, stats);
+          inv ? nullptr : static_cast<uint32_t*>(tkey_ptrs[f]),
+          inv ? nullptr : static_cast<float*>(tval_ptrs[f]),
+          inv ? static_cast<uint64_t*>(inv_ks_ptrs[f]) : nullptr,
+          inv ? static_cast<uint64_t*>(inv_kc_ptrs[f]) : nullptr,
+          threads, stats);
       if (rc < 0) return -1;
     }
   }

@@ -132,6 +132,25 @@ void fill_buckets(const uint32_t* keys, long long n, long long kw,
   });
 }
 
+// ---- invertible-sketch key checksum (protocol constant) -------------------
+//
+// 64-bit lane-fold hash verifying a decoded key against its bucket's
+// checksum plane. Mirrored EXACTLY by hostsketch/engine.py
+// np_inv_key_hash and ops/invsketch.py inv_key_hash — all arithmetic is
+// mod 2^64 (wrap), so per-occurrence checksum contributions stay a
+// linear u64 monoid (merge = element sum) like every other inv plane.
+inline uint64_t inv_key_hash(const uint32_t* w, long long kw) {
+  uint64_t h = 0x9E3779B97F4A7C15ull;
+  for (long long i = 0; i < kw; ++i) {
+    h ^= static_cast<uint64_t>(w[i]);
+    h *= 0xFF51AFD7ED558CCDull;
+    h ^= h >> 33;
+  }
+  h *= 0xC4CEB9FE1A85EC53ull;
+  h ^= h >> 29;
+  return h;
+}
+
 // h1 of ops.hostgroup.hash_u64 / ops.segment.hash_lanes: the 32-bit mix
 // the table prefilter's membership test rides (same constants as
 // flowdecode.cc's mix_lanes pair 0).
@@ -432,6 +451,205 @@ long long hs_topk_merge(uint32_t* table_keys, float* table_vals,
   }
   if (stats != nullptr) stats[FF_STAT_TOPK_NS] += ff_now_ns(stats) - t0;
   return real;
+}
+
+// Invertible-sketch update (-hh.sketch=invertible): one pure per-bucket
+// fold with NO admission machinery — no candidate table, no admission
+// CMS query, no prefilter. Per group row r and depth row d (bucket b =
+// the SAME murmur3 word-lane hash the CMS planes use):
+//
+//   cms[p, d, b]        += addend_u64(vals[r, p])        (all planes)
+//   keysum[d, b, l]     += key[r, l] * cnt   (wrap, per key lane l)
+//   keycheck[d, b]      += inv_key_hash(key[r]) * cnt    (wrap)
+//
+// where cnt is the count-plane addend. Every cell is a plain u64 wrap
+// sum — linear in the stream — so (a) merging shards is an element-wise
+// u64 sum, (b) update order is irrelevant (associative + commutative:
+// deterministic at ANY thread count with no ordering discipline), and
+// (c) heavy keys are recovered from the sketch itself at window close
+// (hs_inv_decode below; the 1910.10441 network-wide invertibility
+// model). The count planes are always PLAIN-updated: conservative
+// update would break the per-bucket exactness the decode divides by.
+//
+//   cms:      [planes, depth, width] uint64, in place
+//   keysum:   [depth, width, kw] uint64, in place
+//   keycheck: [depth, width] uint64, in place
+//   keys:     [n, kw] uint32 unique key lanes
+//   vals:     [n, planes] float32 addends (count plane LAST)
+//   valid:    [n] uint8 mask (NULL = all valid)
+//
+// Returns 0, or -1 on degenerate shapes. n == 0 is a clean no-op.
+long long hs_inv_update(uint64_t* cms, long long planes, long long depth,
+                        long long width, uint64_t* keysum,
+                        uint64_t* keycheck, const uint32_t* keys,
+                        long long n, long long kw, const float* vals,
+                        const uint8_t* valid, int threads,
+                        int64_t* stats) {
+  if (planes < 1 || depth < 1 || width < 1 || n < 0 || kw < 1) return -1;
+  if (n == 0) return 0;
+  int64_t t0 = ff_now_ns(stats);
+  std::vector<uint32_t> buckets(static_cast<size_t>(depth * n));
+  fill_buckets(keys, n, kw, depth, width, threads, buckets.data());
+  // per-row count weight + 64-bit checksum hash, once per row (shared
+  // by every depth task below)
+  std::vector<uint64_t> cnt(static_cast<size_t>(n));
+  std::vector<uint64_t> h64(static_cast<size_t>(n));
+  parallel_tasks(n_blocks(n), threads, [&](long long blk) {
+    long long lo = blk * kRowBlock;
+    long long hi = std::min(n, lo + kRowBlock);
+    for (long long r = lo; r < hi; ++r) {
+      cnt[static_cast<size_t>(r)] =
+          addend_u64(vals[r * planes + (planes - 1)]);
+      h64[static_cast<size_t>(r)] = inv_key_hash(keys + r * kw, kw);
+    }
+  });
+  // count/value planes: each (plane, depth) row owns disjoint cells
+  parallel_tasks(planes * depth, threads, [&](long long task) {
+    long long p = task / depth, d = task % depth;
+    uint64_t* row = cms + (p * depth + d) * width;
+    const uint32_t* b = buckets.data() + d * n;
+    for (long long r = 0; r < n; ++r) {
+      if (valid && !valid[r]) continue;
+      row[b[r]] += addend_u64(vals[r * planes + p]);
+    }
+  });
+  // key-recovery planes: task (d, l) owns keysum column l of depth row
+  // d; task (d, kw) owns that row's checksum — disjoint cells, wrap
+  // adds, order-free
+  parallel_tasks(depth * (kw + 1), threads, [&](long long task) {
+    long long d = task / (kw + 1), l = task % (kw + 1);
+    const uint32_t* b = buckets.data() + d * n;
+    if (l < kw) {
+      uint64_t* row = keysum + d * width * kw;
+      for (long long r = 0; r < n; ++r) {
+        if (valid && !valid[r]) continue;
+        row[static_cast<long long>(b[r]) * kw + l] +=
+            static_cast<uint64_t>(keys[r * kw + l]) *
+            cnt[static_cast<size_t>(r)];
+      }
+    } else {
+      uint64_t* row = keycheck + d * width;
+      for (long long r = 0; r < n; ++r) {
+        if (valid && !valid[r]) continue;
+        row[b[r]] += h64[static_cast<size_t>(r)] *
+                     cnt[static_cast<size_t>(r)];
+      }
+    }
+  });
+  if (stats != nullptr) stats[FF_STAT_INV_NS] += ff_now_ns(stats) - t0;
+  return 0;
+}
+
+// Heavy-key recovery from an invertible sketch — IBLT-style peeling
+// over PURE buckets. A bucket holding exactly one distinct key decodes
+// exactly: every keysum lane divides evenly by the count cell, the
+// quotient re-hashes to this bucket, and the checksum plane equals
+// inv_key_hash(key) * count (mod 2^64 — a false decode survives all
+// three checks with probability ~2^-64). Each decoded key's exact
+// contribution is subtracted from its bucket in EVERY depth row, which
+// may make further buckets pure; the peel iterates to a fixpoint. The
+// recoverable key SET is order-independent (peeling is confluent), so
+// the caller's canonical lex sort + ranking makes native and numpy
+// decodes bit-identical.
+//
+// Inputs are read-only (the peel works on copies). Outputs are
+// caller-allocated at depth*width rows (each decode zeroes its own
+// bucket, so decodes can never exceed the bucket count):
+//   keys_out: [depth*width, kw] uint32
+//   vals_out: [depth*width, planes] uint64 (exact per-key sums,
+//             count plane last)
+// Returns the number of decoded keys, or -1 on degenerate shapes.
+long long hs_inv_decode(const uint64_t* cms, long long planes,
+                        long long depth, long long width,
+                        const uint64_t* keysum, const uint64_t* keycheck,
+                        long long kw, uint32_t* keys_out,
+                        uint64_t* vals_out, int64_t* stats) {
+  if (planes < 1 || depth < 1 || width < 1 || kw < 1) return -1;
+  int64_t t0 = ff_now_ns(stats);
+  std::vector<uint64_t> c(cms, cms + planes * depth * width);
+  std::vector<uint64_t> ks(keysum, keysum + depth * width * kw);
+  std::vector<uint64_t> kc(keycheck, keycheck + depth * width);
+  auto cnt_at = [&](long long d, long long b) -> uint64_t& {
+    return c[((planes - 1) * depth + d) * width + b];
+  };
+  std::vector<long long> work;
+  std::vector<uint8_t> queued(static_cast<size_t>(depth * width), 0);
+  work.reserve(static_cast<size_t>(depth * width));
+  for (long long d = 0; d < depth; ++d) {
+    for (long long b = 0; b < width; ++b) {
+      if (cnt_at(d, b) != 0) {
+        work.push_back(d * width + b);
+        queued[static_cast<size_t>(d * width + b)] = 1;
+      }
+    }
+  }
+  std::vector<uint32_t> key(static_cast<size_t>(kw));
+  long long n_out = 0;
+  while (!work.empty()) {
+    long long db = work.back();
+    work.pop_back();
+    queued[static_cast<size_t>(db)] = 0;
+    long long d = db / width, b = db % width;
+    uint64_t cnt = cnt_at(d, b);
+    if (cnt == 0) continue;
+    const uint64_t* krow = ks.data() + (d * width + b) * kw;
+    bool pure = true;
+    for (long long l = 0; l < kw; ++l) {
+      uint64_t v = krow[l];
+      if (v % cnt != 0 || v / cnt > 0xFFFFFFFFull) {
+        pure = false;
+        break;
+      }
+      key[static_cast<size_t>(l)] = static_cast<uint32_t>(v / cnt);
+    }
+    if (!pure) continue;
+    uint64_t h = inv_key_hash(key.data(), kw);
+    if (h * cnt != kc[d * width + b]) continue;
+    if (hash_words(key.data(), kw, static_cast<uint32_t>(d)) %
+            static_cast<uint32_t>(width) !=
+        static_cast<uint32_t>(b)) {
+      continue;
+    }
+    if (n_out >= depth * width) {
+      // honest states cannot get here (each decode zeroes its own
+      // bucket), but this kernel also runs on member-SUPPLIED mesh
+      // payloads at the coordinator: a crafted state whose wrap
+      // subtractions keep re-activating buckets must exhaust the
+      // caller's depth*width-row buffers, not overflow them
+      break;
+    }
+    // exact per-key sums = this pure bucket's plane cells
+    uint64_t* out_v = vals_out + n_out * planes;
+    for (long long p = 0; p < planes; ++p) {
+      out_v[p] = c[(p * depth + d) * width + b];
+    }
+    std::memcpy(keys_out + n_out * kw, key.data(),
+                static_cast<size_t>(kw) * sizeof(uint32_t));
+    ++n_out;
+    // peel the key from every depth row (wrap subtraction — exact for
+    // true decodes), re-queueing touched buckets
+    for (long long d2 = 0; d2 < depth; ++d2) {
+      long long b2 = hash_words(key.data(), kw,
+                                static_cast<uint32_t>(d2)) %
+                     static_cast<uint32_t>(width);
+      for (long long p = 0; p < planes; ++p) {
+        c[(p * depth + d2) * width + b2] -= out_v[p];
+      }
+      uint64_t* k2 = ks.data() + (d2 * width + b2) * kw;
+      for (long long l = 0; l < kw; ++l) {
+        k2[l] -= static_cast<uint64_t>(key[static_cast<size_t>(l)]) *
+                 out_v[planes - 1];
+      }
+      kc[d2 * width + b2] -= h * out_v[planes - 1];
+      long long db2 = d2 * width + b2;
+      if (cnt_at(d2, b2) != 0 && !queued[static_cast<size_t>(db2)]) {
+        work.push_back(db2);
+        queued[static_cast<size_t>(db2)] = 1;
+      }
+    }
+  }
+  if (stats != nullptr) stats[FF_STAT_INV_NS] += ff_now_ns(stats) - t0;
+  return n_out;
 }
 
 }  // extern "C"

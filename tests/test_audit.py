@@ -298,6 +298,104 @@ class TestReport:
         assert errs[1 << 7][0] > 0.0               # ...and so did error
 
 
+class TestInvertibleAudit:
+    """sketchwatch gate for -hh.sketch=invertible (r16): the audit is
+    backend-agnostic — the invertible family's decoded ranking audits
+    through the same report machinery, reports the exact regime as
+    error 0 (every observation in the le="0" bucket), and its
+    recall@k on the error-vs-fill sweep never falls below table mode
+    (decoded values are exact; admission loss does not exist)."""
+
+    @staticmethod
+    def _sweep_stream(seed=17, n_keys=3000, rows=12000):
+        rng = np.random.default_rng(seed)
+        ids = (rng.zipf(1.3, size=rows) % n_keys).astype(np.uint32)
+        keys = np.stack([ids * np.uint32(2654435761),
+                         ids ^ np.uint32(0x9E3779B9)], axis=1)
+        vals = rng.integers(1, 1500, size=rows).astype(np.float32)
+        return keys, vals
+
+    @classmethod
+    def _grouped(cls, keys, vals):
+        order = np.lexsort(keys.T[::-1])
+        sk = keys[order]
+        bound = np.ones(len(sk), bool)
+        bound[1:] = (sk[1:] != sk[:-1]).any(axis=1)
+        starts = np.flatnonzero(bound)
+        uniq = np.ascontiguousarray(sk[starts])
+        vsum = np.add.reduceat(vals[order].astype(np.float64),
+                               starts).astype(np.float32)
+        cnt = np.diff(np.append(starts, len(sk))).astype(np.float32)
+        return uniq, np.stack([vsum, vsum, cnt], axis=1)
+
+    def _audit_point(self, hh_sketch, width, keys, vals):
+        from flow_pipeline_tpu.hostsketch.engine import HostSketchEngine
+        from flow_pipeline_tpu.obs.audit import SketchAudit
+
+        cfg = HeavyHitterConfig(
+            key_cols=("src_as", "dst_as"), width=width, capacity=256,
+            batch_size=4096, scale_col=None, hh_sketch=hh_sketch)
+        engine = HostSketchEngine([cfg], use_native="auto")
+        engine.reset(0)
+        audit = SketchAudit({"fam": (cfg, 64)}, mode="full")
+        uniq, sums = self._grouped(keys, vals)
+        engine.update(0, uniq, sums, len(uniq))
+        audit.observe_grouped("fam", uniq, sums, len(uniq))
+        part = audit.take_partial("fam")
+        return audit_report(part["keys"], part["vals"],
+                            engine.states[0], cfg, 64, scale=1)
+
+    def test_exact_regime_reports_zero_error(self):
+        """Wide sketch, keys << buckets: the invertible decode is exact
+        and BOTH error paths report 0 — the le="0" acceptance signal."""
+        keys, vals = self._sweep_stream(n_keys=400, rows=6000)
+        rep = self._audit_point("invertible", 1 << 16, keys, vals)
+        assert rep["cms_err"] == {"p50": 0.0, "p99": 0.0, "max": 0.0}
+        assert rep["table_err"] == {"p50": 0.0, "p99": 0.0, "max": 0.0}
+        assert rep["recall_at_k"] == 1.0
+        assert rep["false_drops"] == 0
+        # decoded values are exact, never CMS-seeded upper bounds
+        assert rep["est_admitted_fraction"] == 0.0
+
+    def test_exact_regime_observations_land_in_le0_bucket(self):
+        """The rendered histogram carries the signal dashboards gate
+        on: every exact-regime observation cumulates into le="0"."""
+        from flow_pipeline_tpu.obs import REGISTRY
+        from flow_pipeline_tpu.obs.audit import publish_report
+
+        keys, vals = self._sweep_stream(seed=23, n_keys=300, rows=5000)
+        rep = self._audit_point("invertible", 1 << 16, keys, vals)
+        fam = "inv_le0_gate"
+        publish_report(fam, rep)
+        hist = REGISTRY._metrics["sketch_estimate_error_ratio"]
+        rendered = hist.render()
+        for path in ("cms", "table"):
+            le0 = total = None
+            for line in rendered.splitlines():
+                if f'family="{fam}"' not in line or f'path="{path}"' \
+                        not in line:
+                    continue
+                if 'le="0"' in line:
+                    le0 = float(line.rsplit(" ", 1)[1])
+                elif line.startswith(
+                        "sketch_estimate_error_ratio_count"):
+                    total = float(line.rsplit(" ", 1)[1])
+            assert le0 is not None and total is not None and total > 0
+            assert le0 == total, (path, le0, total)
+
+    def test_recall_at_least_table_mode_on_fill_sweep(self):
+        """The same stream through both families at shrinking widths:
+        invertible recall@k must never fall below table mode's (and
+        both report exact at the widest point)."""
+        keys, vals = self._sweep_stream()
+        for width in (1 << 16, 1 << 12, 1 << 9):
+            rep_inv = self._audit_point("invertible", width, keys, vals)
+            rep_tab = self._audit_point("table", width, keys, vals)
+            assert rep_inv["recall_at_k"] is not None
+            assert rep_inv["recall_at_k"] >= rep_tab["recall_at_k"], \
+                (width, rep_inv["recall_at_k"], rep_tab["recall_at_k"])
+
+
 # ---------------------------------------------------------------------------
 # audit-parity: instrumentation must be purely observational
 # ---------------------------------------------------------------------------

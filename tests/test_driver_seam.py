@@ -48,6 +48,7 @@ def tiny_bench(monkeypatch):
     monkeypatch.setattr(bench, "E2E_FLOWS", 16384)
     monkeypatch.setattr(bench, "SWEEP_BATCHES_CPU", (512,))
     monkeypatch.setattr(bench, "SWEEP_STEPS", 2)
+    monkeypatch.setattr(bench, "HH_SKETCH_PAIRS", 1)
     monkeypatch.setattr(bench, "TRACE_BATCH", 512)
     monkeypatch.setattr(bench, "SHARDED_PER_CHIP", 256)
     monkeypatch.setattr(bench, "SHARDED_STEPS", 2)
@@ -89,9 +90,16 @@ def test_bench_hostsketch_staging(tiny_bench, capsys):
 
 def test_bench_sweep_staging(tiny_bench, capsys):
     bench.bench_sweep()
-    out = _last_json(capsys)
-    assert out["metric"] == "hh sweep best"
-    assert out["value"] > 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    outs = [json.loads(l) for l in lines]
+    best = next(o for o in outs if o["metric"] == "hh sweep best")
+    assert best["value"] > 0
+    # the r16 sketch-family paired A/B rides the same artifact
+    ab = outs[-1]
+    if "error" not in ab:
+        assert "admission_share_invertible_pct" in ab
+        assert ab["invertible_flows_per_sec"] > 0
+        assert "inv" in ab["host_fused_phases_invertible"]
 
 
 def test_bench_trace_staging(tiny_bench, capsys, tmp_path):

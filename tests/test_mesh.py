@@ -717,6 +717,62 @@ def test_mesh_topk_query_equals_single_worker_oracle():
         [r["bytes"] for r in single_records]
 
 
+@pytest.mark.parametrize("n_workers", [1, 2, 4])
+def test_mesh_parity_invertible_vs_single_worker(n_workers):
+    """Invertible-family mesh citizenship (r16 acceptance): an N-worker
+    mesh running -hh.sketch=invertible merges by a PLAIN element-wise
+    u64 sum (merge_hh_inv — no table folds, no device-rank semantics)
+    and its decoded merged output is bit-exact to a single worker
+    consuming the identical sharded bus; flows_5m stays oracle-exact."""
+    vals = _vals("-sketch.backend", "host", "-hh.sketch", "invertible")
+    sink1, sink2 = ListSink(), ListSink()
+    _run_single_worker(vals, sink1)
+    _run_mesh(vals, n_workers, sink2)
+    oracle = _oracle_flows5m()
+    for fold in (_fold_flows5m(sink1.tables), _fold_flows5m(sink2.tables)):
+        assert set(fold) == set(oracle)
+        for k in oracle:
+            assert (fold[k] == oracle[k]).all()
+    _assert_topk_equal(sink1.tables["top_talkers"][0],
+                       sink2.tables["top_talkers"][0])
+
+
+def test_mesh_churn_invertible_kill_one_worker_stays_exact():
+    """Kill-one-worker churn in invertible mode: carry promotion ships
+    the dead member's u64 planes, the successor replays the rest, and
+    the merged decode stays bit-exact to the single-worker answer."""
+    vals = _vals("-sketch.backend", "host", "-hh.sketch", "invertible")
+    sink1, sink2 = ListSink(), ListSink()
+    _run_single_worker(vals, sink1)
+    mesh = InProcessMesh(
+        _make_bus(), "flows", 3,
+        model_factory=lambda: _build_models(vals),
+        config=WorkerConfig(poll_max=BATCH, snapshot_every=0,
+                            sketch_backend="host"),
+        sinks=[sink2], submit_every=2)
+    mesh.start()
+    victim = mesh.members[1]
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        w = victim.worker
+        if w is not None and w.flows_seen >= BATCH:
+            break
+        time.sleep(0.002)
+    else:
+        pytest.fail("victim never processed a batch")
+    mesh.kill_member(1)
+    mesh.wait_idle()
+    mesh.finalize()
+    oracle = _oracle_flows5m()
+    fold = _fold_flows5m(sink2.tables)
+    assert set(fold) == set(oracle)
+    for k in oracle:
+        assert (fold[k] == oracle[k]).all()
+    _assert_topk_equal(sink1.tables["top_talkers"][0],
+                       sink2.tables["top_talkers"][0])
+    assert mesh.coordinator._m["rebalance"].value(reason="death") >= 1.0
+
+
 def test_mesh_flags_registered_and_validated():
     for flag in ("mesh.workers", "mesh.role", "mesh.coordinator",
                  "mesh.id", "mesh.listen", "mesh.heartbeat"):

@@ -753,7 +753,7 @@ class TestBuildInfo:
         native = ",".join(sorted(f for f, ok in caps.items() if ok)) \
             or "none"
         assert g.value(role="coordinator", native=native, trace="ring",
-                       sketch="device") == 1.0
+                       sketch="device", hh_sketch="table") == 1.0
         assert "flow_build_info" in REGISTRY.render()
 
     def test_worker_publishes_on_construction(self):

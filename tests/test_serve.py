@@ -566,6 +566,75 @@ class TestMeshServe:
 # ---- flags -----------------------------------------------------------------
 
 
+class TestInvertibleServe:
+    """flowserve citizenship for -hh.sketch=invertible (r16
+    acceptance): snapshots publish the decoded ranking through the
+    unchanged FamilyView machinery, /query/topk stays bit-exact to the
+    locked path, /query/estimate serves off the family's exact u64
+    planes (no freeze conversion needed), and /query/audit works."""
+
+    @pytest.fixture(scope="class")
+    def inv_served(self):
+        models = {
+            "flows_5m": WindowAggregator(WindowAggConfig(batch_size=512)),
+            "top_talkers": WindowedHeavyHitter(
+                HeavyHitterConfig(batch_size=512, width=1 << 12,
+                                  capacity=64, hh_sketch="invertible"),
+                k=10),
+        }
+        worker = StreamWorker(
+            Consumer(_fill_bus(), fixedlen=True), models, [MemorySink()],
+            WorkerConfig(snapshot_every=0, poll_max=512,
+                         sketch_backend="host", host_assist="on",
+                         obs_audit="full"))
+        pub = attach_worker(worker, refresh=0.0)
+        while worker.run_once():
+            pass
+        with worker.lock:
+            pub.publish(worker)
+        serve = ServeServer(pub.store, port=0).start()
+        yield worker, pub, serve
+        serve.stop()
+
+    @pytest.mark.parametrize("k", [1, 5, 10])
+    def test_topk_bit_exact_vs_locked_path(self, inv_served, k):
+        worker, _, serve = inv_served
+        snap_ans = _get(serve.port, f"/query/topk?k={k}")
+        with worker.lock:
+            worker.sync_sketch_states()
+            m = worker.models["top_talkers"]
+            locked = rows_to_records({
+                key: v[:k] for key, v in m.model.top(10).items()})
+        assert snap_ans["rows"] == locked
+        assert snap_ans["window_start"] == m.current_slot
+
+    def test_estimate_serves_exact_u64_planes(self, inv_served):
+        from flow_pipeline_tpu.hostsketch.engine import np_cms_query_u64
+
+        _, pub, serve = inv_served
+        fam = pub.store.current.families["top_talkers"]
+        frozen = fam.cms.get()
+        assert frozen.dtype == np.uint64
+        lanes = np.concatenate([np.atleast_1d(fam.rows["src_addr"][0]),
+                                np.atleast_1d(fam.rows["dst_addr"][0])])
+        key = ",".join(str(int(x)) for x in lanes)
+        est = _get(serve.port, f"/query/estimate?key={key}")
+        want = np_cms_query_u64(frozen, np.asarray([lanes], np.uint32))[0]
+        assert est["estimates"]["bytes"] == int(want[0])
+        # decoded values are exact sums, bounded by the CMS estimate
+        assert est["estimates"]["bytes"] >= int(fam.rows["bytes"][0])
+
+    def test_query_audit_serves_invertible_reports(self, inv_served):
+        _, pub, serve = inv_served
+        snap = pub.store.current
+        assert snap.audit, "publish carried no audit reports"
+        doc = _get(serve.port, "/query/audit")
+        rep = doc["models"]["top_talkers"]
+        assert "cms_err" in rep and "fill_ratio" in rep
+        # invertible decodes are exact: nothing is est-admitted
+        assert rep["est_admitted_fraction"] == 0.0
+
+
 def test_serve_flags_registered_and_parsed():
     from flow_pipeline_tpu.cli import (_common_flags, _gen_flags,
                                        _processor_flags)

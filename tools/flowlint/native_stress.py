@@ -227,6 +227,9 @@ def _thread_work(native, tid: int, iters: int, batch, data: bytes,
             # 7) fused dataplane: group + cascade + sketch in one call
             if native.fused_available():
                 _fused_work(native, rng, it)
+            # 8) invertible sketch family: per-bucket fold + peel decode
+            if native.inv_available():
+                _inv_work(native, rng, it)
     except Exception as e:  # noqa: BLE001 — collected for the exit code
         errors.append(f"thread {tid}: {type(e).__name__}: {e}")
 
@@ -442,6 +445,121 @@ def _fused_work(native, rng, it: int) -> None:
         raise AssertionError("out-of-range lane selection accepted")
     except ValueError:
         pass
+
+
+def _inv_work(native, rng, it: int) -> None:
+    """One invertible-sketch stress round on thread-private state.
+
+    hs_inv_update: byte-identical at every internal thread count (plain
+    wrap adds are order-free) with the linear-mass invariant on every
+    plane; hs_inv_decode: inputs read-only, decoded mass never exceeds
+    the stream's, and in the unique-key sparse regime the decode is the
+    exact inverse of the update. Degenerate shapes are REJECTED before
+    any write; width-1 buckets (every key collides) ride every third
+    round. The invertible tree also runs through ff_fused_update."""
+    import numpy as np
+    import types
+
+    planes, depth = 3, 2
+    kw = (1, 4, 11)[it % 3]
+    width = (1, 8, 512)[it % 3]
+    n = int(rng.integers(0, 600))
+    keys = np.unique(
+        rng.integers(0, 1 << 12, size=(n, kw), dtype=np.uint32), axis=0)
+    m = keys.shape[0]
+    vals = rng.integers(0, 1500, size=(m, planes)).astype(np.float32)
+    vals[:, -1] = rng.integers(1, 32, size=m).astype(np.float32)
+    valid = rng.random(m) > 0.2
+    stats = native.new_stats()
+    states = []
+    for threads in (1, 2, 8):
+        cms = np.zeros((planes, depth, width), np.uint64)
+        ks = np.zeros((depth, width, kw), np.uint64)
+        kc = np.zeros((depth, width), np.uint64)
+        native.hs_inv_update(cms, ks, kc, keys, vals, valid, threads,
+                             stats=stats)
+        states.append((cms, ks, kc))
+    for st in states[1:]:
+        for a, b in zip(states[0], st):
+            assert np.array_equal(a, b), "inv update nondeterminism"
+    cms, ks, kc = states[0]
+    # linear mass: every (plane, depth) row holds the full addend mass
+    want = vals[valid].astype(np.uint64).sum(axis=0)
+    assert np.array_equal(cms.sum(axis=2), np.broadcast_to(
+        want[:, None], (planes, depth))), "inv linear mass mismatch"
+    if m:
+        assert stats[native.FF_STAT_SLOTS["inv"]] > 0
+        assert (stats >= 0).all(), "negative inv stats slot"
+    # decode: read-only inputs, exact inverse in the unique-key regime
+    snap = (cms.copy(), ks.copy(), kc.copy())
+    dk, dv = native.hs_inv_decode(cms, ks, kc, stats=stats)
+    for a, b in zip(snap, (cms, ks, kc)):
+        assert np.array_equal(a, b), "decode mutated its inputs"
+    assert (dv[:, -1].sum() <= cms[-1, 0].sum()), "decoded mass exceeds stream"
+    if width >= 512 and m:
+        vkeys = keys[valid]
+        vvals = vals[valid]
+        order = np.lexsort(vkeys.T[::-1])
+        sk = vkeys[order]
+        bound = np.ones(len(sk), bool)
+        bound[1:] = (sk[1:] != sk[:-1]).any(axis=1)
+        starts = np.flatnonzero(bound)
+        sums = np.add.reduceat(
+            vvals[order].astype(np.uint64), starts, axis=0)
+        exact = {sk[s].tobytes(): sums[i]
+                 for i, s in enumerate(starts)}
+        # every decoded key is a real key with its EXACT sums (a false
+        # decode would corrupt peels elsewhere — this is the guard)
+        for i in range(len(dk)):
+            want = exact.get(dk[i].tobytes())
+            assert want is not None, "decode invented a key"
+            assert np.array_equal(dv[i], want), "decoded values not exact"
+        # completeness is deliberately NOT asserted here: at depth 2
+        # two keys sharing both buckets form an unpeelable 2-cycle with
+        # non-trivial probability at any load (production configs run
+        # depth 4, where tests/test_invsketch.py pins full recovery);
+        # the memory-safety invariants are exactness + determinism
+    # degenerate shapes rejected, never written
+    try:
+        native.hs_inv_update(np.zeros((1, 1, 0), np.uint64),
+                             np.zeros((1, 0, 1), np.uint64),
+                             np.zeros((1, 0), np.uint64),
+                             np.zeros((1, 1), np.uint32),
+                             np.ones((1, 1), np.float32), None)
+        raise AssertionError("zero-width invertible sketch accepted")
+    except ValueError:
+        pass
+    # the invertible tree through the fused pass: root invertible +
+    # cascade child invertible, thread-count determinism again
+    if native.fused_available() and kw >= 3:
+        p = planes - 1
+        plan = native.FusedPlan(
+            parent=np.asarray([-1, 0], np.int64),
+            sel=np.asarray([0], np.int64),
+            sel_off=np.asarray([0, 0, 1], np.int64),
+            depth=np.asarray([depth, depth], np.int64),
+            width=np.asarray([32, 32], np.int64),
+            cap=np.asarray([8, 8], np.int64),
+            conservative=np.asarray([0, 0], np.uint8),
+            prefilter=np.asarray([1, 1], np.uint8),
+            admission_plain=np.asarray([0, 0], np.uint8),
+            invertible=np.asarray([1, 1], np.uint8))
+        lanes3 = np.ascontiguousarray(keys[:, :3])
+        vals2 = np.ascontiguousarray(vals[:, :p])
+        runs = []
+        for threads in (1, 8):
+            sts = [types.SimpleNamespace(
+                cms=np.zeros((planes, depth, 32), np.uint64),
+                keysum=np.zeros((depth, 32, w), np.uint64),
+                keycheck=np.zeros((depth, 32), np.uint64))
+                for w in (3, 1)]
+            native.fused_update(lanes3, vals2, plan, sts,
+                                do_sketch=True, threads=threads)
+            runs.append(sts)
+        for a, b in zip(*runs):
+            assert np.array_equal(a.cms, b.cms), "fused inv nondeterminism"
+            assert np.array_equal(a.keysum, b.keysum)
+            assert np.array_equal(a.keycheck, b.keycheck)
 
 
 def main(argv=None) -> int:
