@@ -5,7 +5,8 @@
 
 .PHONY: all native test bench proto clean services-test lint native-san \
 	hostsketch-parity fused-parity fused-parity-traced mesh-parity \
-	mesh-parity-traced serve-load audit-parity invertible-parity
+	mesh-parity-traced serve-load audit-parity invertible-parity \
+	chaos-parity
 
 all: native
 
@@ -91,6 +92,19 @@ fused-parity-traced:
 	$(MAKE) -C native
 	FLOWTPU_TRACE=always JAX_PLATFORMS=cpu \
 		python -m pytest tests/test_fusedplane.py tests/test_flowtrace.py -v
+
+# flowchaos (mesh/journal.py, sink/resilient.py, utils/faults.py): the
+# exactness-under-churn contract extended from "a worker dies" to
+# "anything dies" — kill-coordinator-mid-stream recovers from the
+# write-ahead journal bit-exact vs the single-worker oracle, injected
+# sink faults dead-letter + replay back to row-set equality, seeded
+# mesh-transport faults lose/double-count nothing, readers see zero
+# 5xx while the serve publisher flaps, and the supervisor absorbs
+# repeated crash-restore cycles (docs/FAULT_TOLERANCE.md states the
+# failure model).
+chaos-parity:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
+		tests/test_supervisor.py -v
 
 # sketchwatch (obs/audit.py): the accuracy-observability suite — the
 # audit must be purely observational (audit-on vs audit-off sink rows

@@ -1098,6 +1098,171 @@ def bench_mesh() -> None:
     }))
 
 
+CHAOS_FLOWS = 60_000
+CHAOS_PARTITIONS = 8
+CHAOS_WORKERS = 2
+CHAOS_PAIRS = 4
+# armed-but-(effectively-)never-firing: every seam consults its RNG on
+# every call — the WORST-case cost of the fault machinery. The true
+# faults-off path is one attribute read per seam and strictly cheaper.
+CHAOS_ARMED_PLAN = ("sink.write:p=1e-12;mesh.submit:p=1e-12;"
+                    "mesh.sync:p=1e-12@seed=1")
+CHAOS_FAULT_PLAN = "mesh.submit:p=0.05;mesh.sync:p=0.03@seed=7"
+
+
+def bench_chaos() -> None:
+    """flowchaos acceptance artifact (r17): (1) the seam-overhead
+    paired A/B — the in-process mesh (whose members cross the
+    mesh.submit/mesh.sync seams every submission, with a
+    ResilientSink-wrapped member sink crossing sink.write) run with the
+    fault layer DISARMED vs ARMED at p~0, in adjacent alternating-order
+    pairs (r11 methodology); budget <2% median. (2) the seeded-fault
+    leg: the same mesh under the CHAOS_FAULT_PLAN with the coordinator
+    write-ahead journal on — records injected-fault and retry counts,
+    journal record volume, and the wall time a fresh coordinator takes
+    to RECOVER from that journal."""
+    global _NATIVE
+    _NATIVE = _ensure_native()
+    import shutil
+    import tempfile
+
+    from flow_pipeline_tpu.cli import (_build_models, _common_flags,
+                                       _gen_flags, _make_generator,
+                                       _processor_flags)
+    from flow_pipeline_tpu.engine import WorkerConfig
+    from flow_pipeline_tpu.mesh import (InProcessMesh, MeshCoordinator,
+                                        produce_sharded,
+                                        spec_from_models)
+    from flow_pipeline_tpu.mesh.journal import replay_journal
+    from flow_pipeline_tpu.obs import REGISTRY
+    from flow_pipeline_tpu.sink import MemorySink, ResilientSink
+    from flow_pipeline_tpu.transport import InProcessBus
+    from flow_pipeline_tpu.utils.faults import FAULTS
+    from flow_pipeline_tpu.utils.flags import FlagSet
+
+    fs = _processor_flags(_gen_flags(_common_flags(FlagSet("bench"))))
+    # modeled rate 150/s spreads the stream over ~2 windows and the
+    # smaller batch multiplies submissions — the seams (submit/sync/
+    # sink.write) are crossed often enough that the A/B measures them
+    # and the seeded leg injects a meaningful fault count
+    vals = fs.parse(["-produce.profile", "zipf", "-produce.rate", "150",
+                     "-processor.batch", "4096"])
+
+    def make_bus():
+        bus = InProcessBus()
+        bus.create_topic("flows", CHAOS_PARTITIONS)
+        gen = _make_generator(vals)
+        done = 0
+        while done < CHAOS_FLOWS:
+            n = min(16384, CHAOS_FLOWS - done)
+            done += produce_sharded(bus, "flows", gen.batch(n),
+                                    CHAOS_PARTITIONS)
+        return bus
+
+    def mesh_leg(journal=None, member_sink=False):
+        bus = make_bus()  # untimed: production is upstream
+        sinks = [ResilientSink(MemorySink(), retries=2)] \
+            if member_sink else []
+        mesh = InProcessMesh(
+            bus, "flows", CHAOS_WORKERS,
+            model_factory=lambda: _build_models(vals),
+            config=WorkerConfig(poll_max=vals["processor.batch"],
+                                snapshot_every=0),
+            sinks=[], member_sinks=sinks, submit_every=4,
+            journal=journal)
+        elapsed = mesh.run()
+        return CHAOS_FLOWS / max(elapsed, 1e-9)
+
+    # ---- (1) paired alternating seam-overhead A/B -------------------------
+    mesh_leg(member_sink=True)  # untimed warmup: jit compilation must
+    # not land inside pair 0's first leg
+    ratios, off_rates, armed_rates = [], [], []
+
+    def leg(armed):
+        FAULTS.configure(CHAOS_ARMED_PLAN if armed else None)
+        try:
+            return mesh_leg(member_sink=True)
+        finally:
+            FAULTS.configure(None)
+
+    for i in range(CHAOS_PAIRS):
+        if i % 2 == 0:
+            off, armed = leg(False), leg(True)
+        else:
+            armed, off = leg(True), leg(False)
+        off_rates.append(off)
+        armed_rates.append(armed)
+        if off:
+            ratios.append(1 - armed / off)
+    overhead = 100 * statistics.median(ratios) if ratios else 0.0
+
+    # ---- (2) seeded-fault leg + journal recovery wall time ----------------
+    retries = REGISTRY.counter("mesh_member_retries_total")
+    injected = REGISTRY.counter("faults_injected_total")
+
+    def counter_total(c):
+        with c._lock:
+            return sum(c._values.values())
+
+    retries_before = counter_total(retries)
+    injected_before = counter_total(injected)
+    jdir = tempfile.mkdtemp(prefix="flowtpu-chaos-journal-")
+    try:
+        FAULTS.configure(CHAOS_FAULT_PLAN)
+        try:
+            fault_rate = mesh_leg(journal=jdir)
+            fault_snapshot = FAULTS.snapshot()
+        finally:
+            FAULTS.configure(None)
+        journal_path = os.path.join(jdir, "coordinator.journal")
+        n_records = sum(1 for _ in replay_journal(journal_path))
+        journal_bytes = os.path.getsize(journal_path)
+        specs = spec_from_models(_build_models(vals))
+        t0 = time.perf_counter()
+        recovered = MeshCoordinator(specs, CHAOS_PARTITIONS,
+                                    journal=jdir)
+        recovery_s = time.perf_counter() - t0
+        recovered.close()
+    finally:
+        shutil.rmtree(jdir, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": "flowchaos seam overhead (paired A/B) + seeded-fault "
+                  "recovery",
+        "unit": "flows/sec",
+        "flows_per_leg": CHAOS_FLOWS,
+        "workers": CHAOS_WORKERS,
+        "value": round(statistics.median(off_rates), 1)
+        if off_rates else None,
+        "seam_overhead_pct": round(overhead, 2),
+        "seam_overhead_pairs_pct": [round(100 * r, 2) for r in ratios],
+        "faults_off_flows_per_sec": round(statistics.median(off_rates), 1)
+        if off_rates else None,
+        "faults_armed_p0_flows_per_sec": round(
+            statistics.median(armed_rates), 1) if armed_rates else None,
+        "overhead_budget_pct": 2.0,
+        "within_budget": overhead < 2.0,
+        "armed_plan": CHAOS_ARMED_PLAN,
+        "fault_plan": CHAOS_FAULT_PLAN,
+        "faulted_flows_per_sec": round(fault_rate, 1),
+        "faults_injected": fault_snapshot,
+        "mesh_member_retries": counter_total(retries) - retries_before,
+        "faults_injected_total": counter_total(injected)
+        - injected_before,
+        "journal_records": n_records,
+        "journal_bytes": journal_bytes,
+        "journal_recovery_seconds": round(recovery_s, 4),
+        "native_decode": _NATIVE,
+        "platform": _PLATFORM,
+        "host_note": (
+            "paired alternating-order disarmed/armed legs (r11 "
+            "methodology); the armed leg consults every seam's RNG per "
+            "call at p~0 — the worst case; the true faults-off path is "
+            "one attribute read per seam. Median per-pair ratio is the "
+            "honest overhead and can dip negative on throttled boxes."),
+    }))
+
+
 SERVE_FLOWS = 800_000
 SERVE_PROCS = 2      # reader subprocesses (honest concurrency: no GIL
 SERVE_THREADS = 4    # sharing with the server) x connections each
@@ -1693,6 +1858,8 @@ if __name__ == "__main__":
         bench_mesh()
     elif mode == "serve":
         bench_serve()
+    elif mode == "chaos":
+        bench_chaos()
     elif mode == "sweep":
         bench_sweep()
     elif mode == "trace":

@@ -15,6 +15,9 @@ Subcommands (``python -m flow_pipeline_tpu.cli <cmd> [-flags...]``):
 
 from __future__ import annotations
 
+# flowlint: net-checked
+# (the lineage subcommand fetches from a possibly-dead coordinator)
+
 import sys
 import time
 
@@ -292,6 +295,33 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
               "key; tests and sweeps) | off")
     fs.string("sink", "stdout", "stdout | sqlite:PATH | postgres:DSN | "
                                 "clickhouse:URL (comma separated)")
+    # flowchaos (utils/faults.py, sink/resilient.py, mesh/journal.py):
+    # fault injection + retry/dead-letter + coordinator durability —
+    # see docs/FAULT_TOLERANCE.md
+    fs.string("faults", "", "flowchaos deterministic fault plan, e.g. "
+                            "'sink.write:p=0.05;mesh.submit:p=0.02"
+                            "@seed=7' (empty disables; seams cost one "
+                            "attribute read when off)",
+              env="FLOWTPU_FAULTS")
+    fs.integer("sink.retries", 4, "Sink write attempts before a batch "
+                                  "is dead-lettered (with "
+                                  "-sink.deadletter) or the step fails "
+                                  "(without); 1 disables retries")
+    fs.string("sink.deadletter", "", "Directory for the replayable "
+                                     "dead-letter spill (<dir>/"
+                                     "deadletter/); batches that "
+                                     "exhaust retries land here "
+                                     "instead of crashing the worker; "
+                                     "re-ingest with flowtpu-replay "
+                                     "(empty = fail the step, the "
+                                     "crash-and-replay contract)")
+    fs.string("mesh.journal", "", "Coordinator write-ahead journal "
+                                  "directory (mesh.role=coordinator): "
+                                  "accepted submissions, fences, epoch "
+                                  "bumps and merged-window keys become "
+                                  "durable; a restarted coordinator "
+                                  "recovers its frontier/epoch/ledger "
+                                  "(empty = in-memory only)")
     # flowmesh (mesh/): N-worker sharded sketch mesh with window-close
     # merge and live rebalance — see docs/ARCHITECTURE.md "flowmesh"
     fs.integer("mesh.workers", 0, "Run an in-process flowmesh of this "
@@ -374,8 +404,9 @@ def _pg_dsn(dsn: str) -> str:
     return dsn
 
 
-def _make_sinks(spec: str):
-    from .sink import ClickHouseSink, PostgresSink, SQLiteSink, StdoutSink
+def _make_sinks(spec: str, retries: int = 0, deadletter: str = ""):
+    from .sink import (ClickHouseSink, PostgresSink, ResilientSink,
+                       SQLiteSink, StdoutSink)
 
     sinks = []
     for part in filter(None, spec.split(",")):
@@ -390,7 +421,19 @@ def _make_sinks(spec: str):
             sinks.append(ClickHouseSink(arg or "http://localhost:8123"))
         else:
             raise ValueError(f"unknown sink {part!r}")
+    if retries > 1 or deadletter:
+        # flowchaos: bounded backoff + (optionally) the replayable
+        # dead-letter spill around every configured sink edge
+        sinks = [ResilientSink(s, retries=max(1, retries),
+                               deadletter_dir=deadletter or None)
+                 for s in sinks]
     return sinks
+
+
+def _vals_sinks(vals):
+    """The flag-configured sink stack (shared by every service main)."""
+    return _make_sinks(vals["sink"], retries=vals["sink.retries"],
+                       deadletter=vals["sink.deadletter"])
 
 
 def _host_port(addr: str, default_port: int,
@@ -492,8 +535,9 @@ def _mesh_coordinator_main(vals) -> int:
 
     specs = spec_from_models(_build_models(vals))
     coord = MeshCoordinator(specs, vals["bus.partitions"],
-                            sinks=_make_sinks(vals["sink"]),
-                            heartbeat_timeout=vals["mesh.heartbeat"])
+                            sinks=_vals_sinks(vals),
+                            heartbeat_timeout=vals["mesh.heartbeat"],
+                            journal=vals["mesh.journal"] or None)
     serve_srv, serve_pub = _start_serve_mesh(vals, coord)
     host, port = _host_port(vals["mesh.listen"] or ":8090", 8090,
                             default_host="0.0.0.0")
@@ -520,6 +564,7 @@ def _mesh_coordinator_main(vals) -> int:
         server.stop()
         if metrics:
             metrics.stop()
+        coord.close()  # final journal fsync + file close
     return 0
 
 
@@ -569,7 +614,7 @@ def _mesh_member_main(vals) -> int:
         member_id, coord, consumer_factory,
         model_factory=lambda: _build_models(vals),
         config=_worker_config(vals),
-        sinks=_make_sinks(vals["sink"]),
+        sinks=_vals_sinks(vals),
         # progress carries every 64 batches: bounds a successor's replay
         # (and the promotable carry) mid-window — windows are minutes of
         # stream, a rebalance should not replay minutes of flows
@@ -599,8 +644,10 @@ def processor_main(argv=None) -> int:
     vals = fs.parse(argv if argv is not None else sys.argv[2:])
     set_level(vals["loglevel"])
     from .obs.trace import TRACER
+    from .utils.faults import FAULTS
 
     TRACER.configure(vals["obs.trace"])
+    FAULTS.configure(vals["faults"])
     _apply_backend(vals["processor.backend"])
     if vals["mesh.role"]:
         if vals["mesh.role"] == "coordinator":
@@ -647,7 +694,7 @@ def processor_main(argv=None) -> int:
         worker = StreamWorker(
             consumer,
             _build_models(vals),
-            _make_sinks(vals["sink"]),
+            _vals_sinks(vals),
             _worker_config(vals),
         )
         serve_srv, serve_store = _start_serve_worker(vals, worker)
@@ -793,13 +840,14 @@ def _pipeline_mesh(vals) -> int:
                                     gen.batch(n), partitions)
     log.info("produced %d flows (key-hash sharded over %d partitions) "
              "in %.2fs", produced, partitions, time.perf_counter() - t0)
-    sinks = _make_sinks(vals["sink"])
+    sinks = _vals_sinks(vals)
     server = _start_metrics(vals["metrics.addr"], 8081)
     mesh = InProcessMesh(
         bus, vals["kafka.topic"], n_workers,
         model_factory=lambda: _build_models(vals),
         config=_worker_config(vals), sinks=sinks, member_sinks=sinks,
-        heartbeat_timeout=vals["mesh.heartbeat"])
+        heartbeat_timeout=vals["mesh.heartbeat"],
+        journal=vals["mesh.journal"] or None)
     serve_srv, serve_pub = _start_serve_mesh(vals, mesh.coordinator)
     query = None
     if vals["query.addr"]:
@@ -828,8 +876,10 @@ def pipeline_main(argv=None) -> int:
     vals = fs.parse(argv if argv is not None else sys.argv[2:])
     set_level(vals["loglevel"])
     from .obs.trace import TRACER
+    from .utils.faults import FAULTS
 
     TRACER.configure(vals["obs.trace"])
+    FAULTS.configure(vals["faults"])
     _apply_backend(vals["processor.backend"])
     if vals["mesh.workers"]:
         return _pipeline_mesh(vals)
@@ -853,7 +903,7 @@ def pipeline_main(argv=None) -> int:
     worker = StreamWorker(
         consumer,
         _build_models(vals),
-        _make_sinks(vals["sink"]),
+        _vals_sinks(vals),
         _worker_config(vals),
     )
     serve_srv, serve_store = _start_serve_worker(vals, worker)
@@ -950,6 +1000,43 @@ def lineage_main(argv=None) -> int:
     return 0
 
 
+def replay_main(argv=None) -> int:
+    """flowchaos dead-letter replay: re-ingest batches that exhausted
+    their sink retry budget (``<dir>/deadletter/*.dlq.json``, written by
+    ``ResilientSink``) into any sink spec. Files are deleted only after
+    every sink accepted them (at-least-once — merging tables absorb a
+    replay-of-the-replay exactly like worker replays); the first
+    failing file aborts so spill order is preserved for the next run."""
+    from .sink.resilient import deadletter_files, replay_deadletter
+
+    fs = FlagSet("replay")
+    fs.string("loglevel", "info", "Log level")
+    fs.string("replay.dir", "", "Sink dead-letter root (the directory "
+                                "passed as -sink.deadletter; its "
+                                "deadletter/ subdir holds the spill)")
+    fs.boolean("replay.delete", True, "Delete each file after every "
+                                      "sink accepted it (false = keep, "
+                                      "for dry runs)")
+    fs.string("sink", "stdout", "stdout | sqlite:PATH | postgres:DSN | "
+                                "clickhouse:URL (comma separated)")
+    vals = fs.parse(argv if argv is not None else sys.argv[2:])
+    set_level(vals["loglevel"])
+    if not vals["replay.dir"]:
+        log.error("replay needs -replay.dir (the -sink.deadletter root)")
+        return 2
+    pending = deadletter_files(vals["replay.dir"])
+    if not pending:
+        log.info("no dead-letter files under %s; nothing to replay",
+                 vals["replay.dir"])
+        return 0
+    sinks = _make_sinks(vals["sink"])
+    files, rows = replay_deadletter(vals["replay.dir"], sinks,
+                                    delete=vals["replay.delete"])
+    log.info("replayed %d file(s) / %d row(s) into %s", files, rows,
+             vals["sink"])
+    return 0
+
+
 def collector_main(argv=None) -> int:
     """UDP flow collector (in-framework GoFlow replacement): listens for
     sFlow on 6343 and NetFlow/IPFIX on 2055, produces FlowMessages."""
@@ -1022,6 +1109,7 @@ _COMMANDS = {
     "pipeline": pipeline_main,
     "collector": collector_main,
     "lineage": lineage_main,
+    "replay": replay_main,
 }
 
 
@@ -1029,7 +1117,7 @@ def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if not argv or argv[0] in ("-h", "-help", "--help"):
         print("usage: flow_pipeline_tpu.cli <mocker|processor|inserter|"
-              "pipeline|collector|lineage> [-flags]\n"
+              "pipeline|collector|lineage|replay> [-flags]\n"
               "Run '<cmd> -help' for flags.")
         return 0 if argv else 2
     cmd = _COMMANDS.get(argv[0])
@@ -1065,6 +1153,10 @@ def collector_entry() -> None:
 
 def lineage_entry() -> None:
     sys.exit(main(["lineage"] + sys.argv[1:]))
+
+
+def replay_entry() -> None:
+    sys.exit(main(["replay"] + sys.argv[1:]))
 
 
 if __name__ == "__main__":

@@ -35,14 +35,28 @@ class SupervisorConfig:
 class Supervisor:
     """run() calls ``factory()`` to build a worker and invokes
     ``worker.run(**run_kwargs)``; on exception it rebuilds (factory should
-    wire restore()) and retries with backoff."""
+    wire restore()) and retries with backoff.
+
+    A crash INSIDE ``factory()`` — a corrupt checkpoint restore, a sink
+    that cannot connect at build time — counts as a worker crash and
+    rides the same backoff/give-up ladder: before r17 it propagated
+    straight out, turning a transient restore failure into a permanent
+    supervisor death (tests/test_supervisor.py pins the fix).
+
+    ``time_fn``/``sleep_fn`` are injectable so the backoff-window logic
+    (reset after a healthy era, give-up inside a crash burst) is testable
+    without wall-clock sleeps."""
 
     def __init__(self, factory: Callable, config: SupervisorConfig = SupervisorConfig(),
+                 time_fn: Callable[[], float] = time.monotonic,
+                 sleep_fn: Callable[[float], None] = time.sleep,
                  **run_kwargs):
         self.factory = factory
         self.config = config
         self.run_kwargs = run_kwargs
         self.restarts = 0
+        self._time = time_fn
+        self._sleep = sleep_fn
         self.m_restarts = REGISTRY.counter("worker_restarts_total",
                                            "supervisor worker restarts")
 
@@ -50,15 +64,17 @@ class Supervisor:
         crash_times: list[float] = []
         backoff = self.config.backoff_initial
         while True:
-            worker = self.factory()
+            worker = None
             try:
+                worker = self.factory()
                 worker.run(**self.run_kwargs)
                 return  # clean exit
             except KeyboardInterrupt:
-                worker.finalize()
+                if worker is not None:
+                    worker.finalize()
                 raise
             except Exception as e:  # noqa: BLE001 — the supervisor's job
-                now = time.monotonic()
+                now = self._time()
                 recent = [
                     t for t in crash_times
                     if now - t < self.config.window_seconds
@@ -74,5 +90,5 @@ class Supervisor:
                     raise
                 log.exception("worker crashed (%s); restarting in %.1fs",
                               e, backoff)
-                time.sleep(backoff)
+                self._sleep(backoff)
                 backoff = min(backoff * 2, self.config.backoff_max)

@@ -133,12 +133,26 @@ MESH_METRICS = {
     "rebalance_s": ("mesh_rebalance_duration_seconds",
                     "rebalance trigger -> every partition owned again "
                     "(label: reason)"),
+    # flowchaos journal families (r17): write-ahead durability health.
+    # Registered eagerly like every other mesh family so the dashboard/
+    # alert honesty tests resolve them against a constructed coordinator
+    # whether or not a journal is configured.
+    "journal_records": ("mesh_journal_records_total",
+                        "coordinator WAL records appended (label: kind="
+                        "sub|fence|epoch|merged)"),
+    "journal_unsynced": ("mesh_journal_unsynced_records",
+                         "journal records appended but not yet fsynced "
+                         "(group commit drains this to 0 at every ack)"),
+    "journal_lag": ("mesh_journal_lag_seconds",
+                    "age of the oldest unfsynced journal record "
+                    "(0 = clean; sustained > 0 means acks are running "
+                    "ahead of durability)"),
 }
 
 # Which MESH_METRICS keys register as what (everything else: counter).
 _MESH_GAUGES = frozenset(
     {"members", "epoch", "partitions", "commit_wm", "member_wm",
-     "wm_skew"})
+     "wm_skew", "journal_unsynced", "journal_lag"})
 _MESH_HISTOGRAMS = {
     "merge_s": MERGE_SECONDS_BUCKETS,
     "barrier_s": BARRIER_SECONDS_BUCKETS,
@@ -186,12 +200,18 @@ def spec_from_models(models: dict) -> tuple[ModelSpec, ...]:
 
 class _Member:
     __slots__ = ("alive", "last_hb", "owned", "provider", "trace_url",
-                 "clock_offset", "clock_rtt", "watermark")
+                 "clock_offset", "clock_rtt", "watermark", "last_sub")
 
     def __init__(self, provider=None, trace_url=None):
         self.alive = True
         self.last_hb = 0.0
         self.owned: set[int] = set()
+        # newest accepted submission id (span.sub) from this incarnation
+        # — the lost-ack retry dedupe key. 0 = nothing accepted yet
+        # (member ids are minted from 1); a rejoin builds a fresh
+        # _Member, and a member object's _sub_seq is monotone across its
+        # own rejoins, so ids never run backwards within an incarnation.
+        self.last_sub = 0
         self.provider = provider  # callable(model)->payload | state URL
         # meshscope: the member's /debug/trace URL (HTTP mesh; None
         # in-process — everything already records into one TRACER)
@@ -213,7 +233,8 @@ class MeshCoordinator:
     def __init__(self, specs: Sequence[ModelSpec], n_partitions: int,
                  sinks: Sequence[Any] = (),
                  heartbeat_timeout: float = 5.0,
-                 time_fn: Callable[[], float] = time.monotonic):
+                 time_fn: Callable[[], float] = time.monotonic,
+                 journal: Optional[str] = None):
         self.specs = tuple(specs)
         self._by_name = {s.name: s for s in self.specs}
         self.n_partitions = int(n_partitions)
@@ -288,6 +309,25 @@ class MeshCoordinator:
             "coordinator",
             hh_sketch=("invertible" if "invertible" in hh_modes
                        else "table" if hh_modes else "none"))
+        # flowchaos write-ahead journal (-mesh.journal=<dir>): accepted
+        # submissions, fences, epoch bumps and merged-window keys become
+        # durable; a restarted coordinator recovers its frontier/epoch/
+        # ledger from them (mesh/journal.py states the contract).
+        # flowlint: unguarded -- bound once here; the journal carries its own lock
+        self._journal = None
+        if journal:
+            from .journal import CoordinatorJournal
+
+            self._journal = CoordinatorJournal(journal, metrics={
+                "records": self._m["journal_records"],
+                "unsynced": self._m["journal_unsynced"],
+                "lag": self._m["journal_lag"],
+            })
+            with self._lock:
+                ready = self._recover_locked()
+            self._journal.sync()
+            if ready:
+                self._run_merges(ready)
 
     # ---- membership -------------------------------------------------------
 
@@ -312,6 +352,8 @@ class MeshCoordinator:
             m.last_hb = self._time()
             self._rebalance_locked("join")
             epoch = self.epoch
+        if self._journal is not None:
+            self._journal.sync()
         if fold:
             self._run_merges(fold)
         if fenced:
@@ -346,6 +388,8 @@ class MeshCoordinator:
                 self._m["wm_skew"].remove(member=member_id)
                 self._m["sub2merge_s"].remove(member=member_id)
                 self._publish_watermarks_locked()
+        if self._journal is not None:
+            self._journal.sync()
         if fold:
             self._run_merges(fold)
 
@@ -359,6 +403,8 @@ class MeshCoordinator:
             m = self._members.get(member_id)
             fenced = m is not None and (m.alive or bool(m.owned))
             fold = self._fence_locked(member_id, "death")
+        if self._journal is not None:
+            self._journal.sync()
         if fold:
             self._run_merges(fold)
         if fenced:
@@ -374,6 +420,8 @@ class MeshCoordinator:
                 if m.alive and now - m.last_hb > self.heartbeat_timeout:
                     fold.extend(self._fence_locked(mid, "death") or [])
                     dead.append(mid)
+        if dead and self._journal is not None:
+            self._journal.sync()
         if fold:
             self._run_merges(fold)
         if dead:
@@ -391,6 +439,11 @@ class MeshCoordinator:
         m.alive = False
         self._released |= m.owned  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
         m.owned = set()
+        if self._journal is not None:
+            # the fence (and the carry promotion it implies) must replay
+            # at this exact point in the record order, or a recovered
+            # coordinator would promote an already-promoted carry twice
+            self._journal.append("fence", {"member": member_id})
         carry = self._carry.pop(member_id, None)
         TRACER.record("mesh_fence", now, time.time(), member=member_id,
                       reason=reason, promoted=bool(carry))
@@ -419,6 +472,9 @@ class MeshCoordinator:
 
     def _rebalance_locked(self, reason: str) -> None:
         self.epoch += 1  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        if self._journal is not None:
+            self._journal.append("epoch", {"epoch": self.epoch,
+                                           "reason": reason})
         live = sorted(mid for mid, m in self._members.items() if m.alive)
         self._targets = {mid: set() for mid in live}  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
         for p in range(self.n_partitions):
@@ -467,6 +523,101 @@ class MeshCoordinator:
         if path:
             log.warning("meshscope: %s; flight recorder dumped to %s",
                         why, path)
+
+    # ---- journal recovery (flowchaos) -------------------------------------
+
+    def _recover_locked(self):
+        """Rebuild frontier/epoch/pending/carries/merged-keys by
+        replaying the journal through the live fold paths (caller holds
+        _lock; runs once, from __init__). Returns the ready merges to
+        run lock-free: windows whose barrier had passed but whose
+        ``merged`` record never landed re-merge and re-emit here."""
+        n = 0
+        for kind, meta, blob in self._journal.replay():
+            n += 1
+            if kind == "sub":
+                self._replay_submission_locked(meta["member"],
+                                               codec.decode(blob))
+            elif kind == "fence":
+                self._replay_fence_locked(meta["member"])
+            elif kind == "epoch":
+                if int(meta["epoch"]) > self.epoch:
+                    self.epoch = int(meta["epoch"])  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+            elif kind == "merged":
+                # merged AND emitted pre-crash: its contributions must
+                # not re-emit — pop them; the key stays remembered so
+                # late contributions for it keep registering late
+                key = (meta["model"], int(meta["slot"]))
+                self._pending.pop(key, None)
+                self._lineage_pending.pop(key, None)
+                self._merged_keys.add(key)
+        if n == 0:
+            return []
+        # the old incarnation's members are all presumed dead: promote
+        # every remaining carry (journaling those fences so a SECOND
+        # crash replays identically) and bump the epoch. The members
+        # themselves are simply unknown to this incarnation — their next
+        # sync gets ``rejoin``, they abandon un-acked state and replay
+        # from the recovered frontier: the same zombie/rejoin machinery
+        # (and the same exactness argument) as a worker death.
+        for member in sorted(self._carry):
+            self._journal.append("fence", {"member": member})
+            self._replay_fence_locked(member)
+        self.epoch += 1  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        self._journal.append("epoch", {"epoch": self.epoch,
+                                       "reason": "recovery"})
+        self._m["epoch"].set(self.epoch)
+        log.warning("mesh coordinator recovered from journal: %d "
+                    "records, epoch now %d, frontier %s",
+                    n, self.epoch, self._covered)
+        return self._pop_ready_locked()
+
+    def _replay_submission_locked(self, member: str, payload: dict) -> None:
+        """One journaled accepted submission, re-applied. Mirrors
+        ``_accept_locked`` minus membership/metrics: ranges were
+        validated before the record was written, and a submission's
+        ranges cover exactly its owned set."""
+        span = payload.get("span") or {}
+        ranges = {int(p): [int(r[0]), int(r[1])]
+                  for p, r in payload.get("ranges", {}).items()}
+        for p, rng in ranges.items():
+            if rng[1] > self._covered[p]:
+                self._covered[p] = rng[1]  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        wm = int(payload.get("watermark", 0))
+        for p in ranges:
+            if wm > self._wm[p]:
+                self._wm[p] = wm  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        self._fold_windows_locked(payload.get("closed", {}),
+                                  member=member, span=span, ranges=ranges,
+                                  kind="closed")
+        open_windows = payload.get("open", {})
+        if payload.get("release") or payload.get("final"):
+            self._fold_windows_locked(open_windows, member=member,
+                                      span=span, ranges=ranges,
+                                      kind="final-open")
+            self._carry.pop(member, None)
+        else:
+            self._carry[member] = {"windows": open_windows,  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+                                   "span": span, "ranges": ranges}
+        if payload.get("final"):
+            for p in ranges:
+                self._final[p] = True  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+
+    def _replay_fence_locked(self, member: str) -> None:
+        """One journaled fence, re-applied: promote the member's carry
+        into the pending barrier exactly as ``_fence_locked`` did live."""
+        carry = self._carry.pop(member, None)
+        if carry:
+            self._fold_windows_locked(
+                carry.get("windows", {}), member=member,
+                span=carry.get("span") or {},
+                ranges=carry.get("ranges"), kind="carry-promoted")
+
+    def close(self) -> None:
+        """Release the journal (final fsync + file close). The
+        coordinator has no other owned resources; safe to call twice."""
+        if self._journal is not None:
+            self._journal.close()
 
     # ---- heartbeat / assignment ------------------------------------------
 
@@ -524,18 +675,31 @@ class MeshCoordinator:
     def submit(self, member_id: str, payload) -> dict:
         """Accept one member contribution (codec bytes or decoded dict).
         Returns {"ok": True} or {"ok": False, "reason": ...}."""
+        raw = None
         if isinstance(payload, (bytes, bytearray)):
-            payload = codec.decode(bytes(payload))
+            raw = bytes(payload)
+            payload = codec.decode(raw)
         t_recv = time.time()
         span = payload.get("span") or {}
         fold = []
         accepted = False
+        duplicate = False
         reject_reason = None
         with self._lock:
             m = self._members.get(member_id)
             if m is None or not m.alive:
                 self._m["rejected"].inc(reason="fenced")
                 reject_reason = "fenced"
+            elif span.get("sub") is not None and \
+                    int(span["sub"]) <= m.last_sub:
+                # lost-ack retry of an ALREADY-ACCEPTED submission:
+                # idempotent accept — fold nothing, journal nothing. The
+                # frontier-extend check alone cannot catch this when the
+                # retried ranges are empty ([covered, covered] — a final
+                # or idle-flush submission with no new offsets), and
+                # re-folding its closed windows would double-count them.
+                m.last_hb = self._time()
+                duplicate = True
             else:
                 m.last_hb = self._time()
                 ranges = payload.get("ranges", {})
@@ -554,6 +718,31 @@ class MeshCoordinator:
                     fold = self._accept_locked(m, member_id, payload,
                                                t_recv, span)
                     accepted = True
+                    if self._journal is not None:
+                        # under _lock so journal order == accept order;
+                        # a buffered append, never an fsync (sync below)
+                        self._journal.append(
+                            "sub", {"member": member_id},
+                            raw if raw is not None
+                            else codec.encode(payload))
+        if self._journal is not None and (accepted or
+                                          reject_reason == "range"):
+            # group-commit durability barrier BEFORE the ok ack: an
+            # acked submission is always recoverable. The fsync runs
+            # with no coordinator lock held; concurrent acks share one
+            # disk flush. A "range" rejection journaled a FENCE record
+            # (the carry promotion) — it must not linger unfsynced with
+            # no later ack to flush it, or the lag gauge would sit
+            # frozen while the record stays undurable.
+            self._journal.sync()
+        if duplicate:
+            TRACER.record("mesh_submit_accept", t_recv, time.time(),
+                          member=member_id, sub=span.get("sub"),
+                          chunk=span.get("chunk"), duplicate=True,
+                          windows=0)
+            log.info("mesh member %s resubmitted sub=%s (lost ack); "
+                     "acked idempotently", member_id, span.get("sub"))
+            return {"ok": True, "duplicate": True}
         if fold:
             self._run_merges(fold)
         if accepted:
@@ -579,6 +768,8 @@ class MeshCoordinator:
 
     def _accept_locked(self, m: _Member, member_id: str, payload: dict,
                        t_recv: float, span: dict):
+        if span.get("sub") is not None:
+            m.last_sub = max(m.last_sub, int(span["sub"]))
         for p, rng in payload.get("ranges", {}).items():
             self._covered[int(p)] = int(rng[1])  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
         wm = int(payload.get("watermark", 0))
@@ -760,6 +951,13 @@ class MeshCoordinator:
             for sink in self.sinks:
                 sink.write(name, rows)
             t_emitted = time.time()
+            if self._journal is not None:
+                # AFTER the sink writes: a crash inside the sink-write ->
+                # journal gap re-merges and re-emits this window on
+                # recovery — the same irreducible at-least-once window
+                # as the worker's flush -> snapshot gap
+                self._journal.append("merged", {"model": name,
+                                                "slot": int(slot)})
             n_rows = self._count_rows(rows)
             TRACER.record("mesh_emit", t_merged, t_emitted, model=name,
                           slot=slot, rows=n_rows)
@@ -815,6 +1013,8 @@ class MeshCoordinator:
                             member=str(c.get("member") or "unknown"))
             log.info("mesh merged window model=%s slot=%d contribs=%d",
                      name, slot, len(payloads))
+        if ready and self._journal is not None:
+            self._journal.sync()
         if ready and self.serve is not None:
             # wake the flowserve publisher (no lock held here); the
             # fan-out/extract runs on ITS thread, never the submitter's

@@ -41,12 +41,27 @@ from ..engine.prefetch import PrefetchConsumer
 from ..engine.windowed import WindowedHeavyHitter
 from ..engine.worker import StreamWorker, WorkerConfig
 from ..models.window_agg import WindowAggregator
-from ..obs import get_logger
+from ..obs import REGISTRY, get_logger
 from ..obs.trace import TRACER
+from ..utils.faults import FAULTS
+from ..utils.retry import retry_call
 from . import codec
 from .scope import ClockSync
 
 log = get_logger("mesh")
+
+# flowchaos retry discipline on the member->coordinator HTTP edge
+# (submit/sync/join/leave): bounded exponential backoff + jitter around
+# transient transport failures. Retrying a submit is SAFE: if the lost
+# ack was actually an accept, the coordinator dedupes on the span's
+# per-member submission id and acks idempotently (folding nothing) —
+# and for payloads without a span id, the frontier-extend contract
+# rejects the non-extending ranges, after which the member abandons and
+# rejoins, replaying from the covered frontier (no loss, no double
+# count either way; tests/test_chaos.py pins both paths).
+COORD_RETRIES = 5
+COORD_BACKOFF = 0.05
+COORD_BACKOFF_MAX = 1.0
 
 
 class MeshMember:
@@ -115,6 +130,56 @@ class MeshMember:
         # a second role="worker" series would be a double identity
         self.config = dataclasses.replace(self.config,
                                           build_role="member")
+        # flowchaos: last coordinator-unreachable warning stamp (the
+        # sync path retries every step — one log line per outage window,
+        # not one per attempt)
+        # flowlint: unguarded -- driver thread only
+        self._last_down_log = 0.0
+        self.m_retries = REGISTRY.counter(
+            "mesh_member_retries_total",
+            "member->coordinator calls retried after a transport "
+            "failure (label: op)")
+
+    # ---- coordinator transport (flowchaos retries) ------------------------
+
+    def _coord_call(self, op: str, fn):
+        """One coordinator round-trip under the bounded retry policy.
+        ``op`` is the fault-injection site suffix and the retry-counter
+        label; OSError (real or injected) backs off and retries, the
+        final failure propagates to the caller's recovery path.
+
+        A coordinator dying MID-RESPONSE surfaces from the HTTP
+        transport as ``http.client.HTTPException`` (IncompleteRead,
+        BadStatusLine) or a ``json.JSONDecodeError`` on the truncated
+        body — neither is an OSError, so they are normalized here:
+        every transport-shaped failure must reach the same retry and
+        keep-alive paths, or the exact outage flowchaos exists to
+        survive would kill the member thread instead."""
+        import http.client
+        import json
+
+        site = f"mesh.{op if op in ('submit', 'sync') else 'sync'}"
+
+        def call():
+            if FAULTS.active:
+                FAULTS.check(site)
+            try:
+                return fn()
+            except (http.client.HTTPException,
+                    json.JSONDecodeError) as e:
+                raise ConnectionError(
+                    f"coordinator {op} transport failure: "
+                    f"{type(e).__name__}: {e}") from e
+
+        def on_retry(i, exc, delay):
+            self.m_retries.inc(op=op)
+            log.warning("mesh member %s %s to coordinator failed (%s); "
+                        "retry %d/%d in %.2fs", self.member_id, op, exc,
+                        i + 1, COORD_RETRIES - 1, delay)
+
+        return retry_call(call, attempts=COORD_RETRIES,
+                          base=COORD_BACKOFF, cap=COORD_BACKOFF_MAX,
+                          retry_on=(OSError,), on_retry=on_retry)
 
     # ---- capture hooks ----------------------------------------------------
 
@@ -152,8 +217,9 @@ class MeshMember:
         reported back on the next call so the coordinator always holds
         a fresh per-member clock alignment for /debug/trace."""
         t0 = time.time()
-        resp = self.coordinator.sync(self.member_id,
-                                     clock=self._clock.report())
+        resp = self._coord_call(
+            "sync", lambda: self.coordinator.sync(
+                self.member_id, clock=self._clock.report()))
         t1 = time.time()
         now = resp.get("now")
         if now is not None:
@@ -162,9 +228,10 @@ class MeshMember:
 
     def _sync(self) -> None:
         if not self._joined:
-            self.coordinator.join(self.member_id,
-                                  provider=self._query_state,
-                                  trace_url=self.trace_url)
+            self._coord_call(
+                "join", lambda: self.coordinator.join(
+                    self.member_id, provider=self._query_state,
+                    trace_url=self.trace_url))
             self._joined = True
         resp = self._call_sync()
         action = resp.get("action")
@@ -218,14 +285,31 @@ class MeshMember:
         if self.worker is not None:
             w = self.worker
             w.finalize()  # force-close -> capture hooks fire
-            self._submit(release=True)
+            ok = self._submit(release=True)
             self.worker = None
             self._close_consumer(w)
+            if not ok and self._joined:
+                # transport failure mid-resync: the release never
+                # landed and the worker is already torn down — rejoin
+                # fresh. join()'s rejoin-fence promotes our last
+                # ACCEPTED carry; everything since replays from the
+                # frontier (the same exactness path as a death).
+                self._abandon()
+                self._joined = False
         else:
-            self.coordinator.submit(self.member_id, codec.encode({
-                "member": self.member_id, "ranges": {}, "watermark": 0,
-                "closed": {}, "open": {}, "flows": 0, "release": True,
-                "final": False, "span": self._next_span((), ())}))
+            try:
+                payload = codec.encode({
+                    "member": self.member_id, "ranges": {},
+                    "watermark": 0, "closed": {}, "open": {}, "flows": 0,
+                    "release": True, "final": False,
+                    "span": self._next_span((), ())})
+                self._coord_call(
+                    "submit", lambda: self.coordinator.submit(
+                        self.member_id, payload))
+            except OSError as e:
+                log.warning("mesh member %s empty-release submit failed "
+                            "(%s); rejoining fresh", self.member_id, e)
+                self._joined = False
         self._captured = {}
         self._audit_captured = {}
         self._frontier = {}
@@ -357,8 +441,31 @@ class MeshMember:
             "release": release,
             "span": span,
         }
-        resp = self.coordinator.submit(self.member_id,
-                                       codec.encode(payload))
+        encoded = codec.encode(payload)
+        try:
+            resp = self._coord_call(
+                "submit", lambda: self.coordinator.submit(
+                    self.member_id, encoded))
+        except OSError as e:
+            # transport exhausted (coordinator down/restarting): restore
+            # the captured windows — nothing else ran on this thread
+            # since they were popped — and retry on a later step. If the
+            # lost ack was actually an accept, the retried ranges no
+            # longer extend the frontier: the coordinator rejects them,
+            # and the rejection path below abandons + rejoins (exact by
+            # the frontier-extend contract).
+            log.warning("mesh member %s submission transport failure "
+                        "(%s); keeping state for retry",
+                        self.member_id, e)
+            TRACER.record("mesh_submit", span["sent"], time.time(),
+                          member=self.member_id, sub=span["sub"],
+                          chunk=span["chunk"], ok=False,
+                          windows=len(closed))
+            for slot, models in closed.items():
+                self._captured.setdefault(slot, {}).update(models)
+            for slot, parts in audit_closed.items():
+                self._audit_captured.setdefault(slot, {}).update(parts)
+            return False
         TRACER.record("mesh_submit", span["sent"], time.time(),
                       member=self.member_id, sub=span["sub"],
                       chunk=span["chunk"], ok=bool(resp.get("ok")),
@@ -390,7 +497,19 @@ class MeshMember:
             else min(self.sync_interval, 0.05)
         if now - self._last_sync >= interval:
             self._last_sync = now
-            self._sync()
+            try:
+                self._sync()
+            except OSError as e:
+                # coordinator unreachable past the retry budget (it may
+                # be restarting from its journal): stay alive, keep our
+                # state, and heartbeat again next step. One log line per
+                # outage window — not one per retry.
+                if time.monotonic() - self._last_down_log >= 5.0:
+                    self._last_down_log = time.monotonic()
+                    log.warning("mesh member %s: coordinator "
+                                "unreachable (%s); will keep retrying",
+                                self.member_id, e)
+                return False
         w = self.worker  # kill() may null the attribute mid-step
         if w is None or self._dead:
             return False
@@ -434,11 +553,23 @@ class MeshMember:
         if self.worker is not None:
             w = self.worker
             w.finalize()  # capture hooks grab all open windows
-            self._submit(final=True)
+            if not self._submit(final=True):
+                log.error("mesh member %s final submission failed; the "
+                          "coordinator will fence this member and "
+                          "promote its last accepted carry",
+                          self.member_id)
             self.worker = None
             self._close_consumer(w)
         if self._joined:
-            self.coordinator.leave(self.member_id)
+            try:
+                self._coord_call(
+                    "leave",
+                    lambda: self.coordinator.leave(self.member_id))
+            except OSError as e:
+                # best effort: an unreachable coordinator fences us by
+                # heartbeat timeout, which is the same protocol path
+                log.warning("mesh member %s leave failed (%s); relying "
+                            "on heartbeat expiry", self.member_id, e)
             self._joined = False
 
     def kill(self) -> None:

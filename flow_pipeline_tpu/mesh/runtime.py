@@ -85,14 +85,16 @@ class InProcessMesh:
                  member_sinks: Sequence[Any] = (),
                  heartbeat_timeout: float = 30.0,
                  submit_every: int = 0,
-                 sync_interval: float = 0.05):
+                 sync_interval: float = 0.05,
+                 journal: Optional[str] = None):
         self.bus = bus
         self.topic = topic
         # one throwaway model set derives the merge specs — members
         # build their own fresh sets per assignment epoch
         self.coordinator = MeshCoordinator(
             spec_from_models(model_factory()), bus.partitions(topic),
-            sinks=sinks, heartbeat_timeout=heartbeat_timeout)
+            sinks=sinks, heartbeat_timeout=heartbeat_timeout,
+            journal=journal)
         self.members = []
         for i in range(n_workers):
             mid = f"w{i}"
@@ -166,12 +168,13 @@ class InProcessMesh:
 
     def finalize(self) -> None:
         """Stop member threads, final-submit every live member, merge
-        everything outstanding."""
+        everything outstanding, release the coordinator's journal."""
         self._stop.set()
         for t in self._threads:
             t.join(timeout=60)
         for m in self.members:
             m.finalize()
+        self.coordinator.close()
 
     def run(self, idle_rounds: int = 20, timeout: float = 300.0) -> float:
         """start() -> wait_idle() -> finalize(); returns the wall-clock

@@ -28,6 +28,10 @@ from __future__ import annotations
 # (handlers delegate to the coordinator/member objects, which carry
 # their own locking contracts; the servers themselves only bind
 # immutable attributes after __init__)
+# flowlint: net-checked
+# (every urlopen here crosses a process boundary during churn — the
+# exact moment a peer may be hung; the r13 trace fan-out bug was one
+# missing timeout in this module's class of call)
 
 import json
 import threading

@@ -12,6 +12,9 @@ from __future__ import annotations
 # flowlint: lock-checked
 # (each worker thread owns its private _Worker stats; aggregation reads
 # them only after join() — no shared mutable state while running)
+# flowlint: net-checked
+# (a load generator with an unbounded read wedges the whole bench when
+# the server under test hangs — exactly the condition being measured)
 
 import http.client
 import threading

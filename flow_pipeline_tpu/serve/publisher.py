@@ -162,10 +162,25 @@ class MeshServePublisher:
     """Publishes the mesh coordinator's MERGED view on its own thread."""
 
     def __init__(self, coordinator, store: Optional[SnapshotStore] = None,
-                 refresh: float = 2.0, range_slots: int = 0):
+                 refresh: float = 2.0, range_slots: int = 0,
+                 err_backoff_base: float = 0.5,
+                 err_backoff_max: float = 30.0,
+                 err_log_interval: float = 30.0):
         self.coordinator = coordinator
         self.store = store or SnapshotStore()
         self.refresh = refresh
+        # flowchaos failure-path discipline: exponential backoff between
+        # failed publishes (a flapping member previously drove a retry —
+        # and a full log.exception — every wake) and a rate limit on the
+        # traceback logging; serve_publish_failures_total carries the
+        # signal the suppressed log lines used to
+        self.err_backoff_base = err_backoff_base
+        self.err_backoff_max = err_backoff_max
+        self.err_log_interval = err_log_interval
+        # flowlint: unguarded -- publisher thread only
+        self._fail_streak = 0
+        # flowlint: unguarded -- publisher thread only
+        self._last_err_log = 0.0
         self.ledger = RangeLedger(
             (), **({"max_slots": range_slots} if range_slots else {}))
         # flowlint: unguarded -- the events themselves; bound once
@@ -208,11 +223,42 @@ class MeshServePublisher:
         while not self._stop.is_set():
             try:
                 self.publish_now()
-            except Exception:  # noqa: BLE001 -- serving must outlive a flaky member fetch
-                log.exception("flowserve mesh publish failed; retrying "
-                              "at the next wake")
+                self._fail_streak = 0
+            except Exception as e:  # noqa: BLE001 -- serving must outlive a flaky member fetch
+                self._on_publish_error(e)
+                # backoff honors the failure streak and IGNORES merge
+                # wakes: a flapping member must not convert every merge
+                # into an immediate doomed retry (+ a logged traceback)
+                self._stop.wait(self._error_backoff())
+                continue
             self._wake.wait(self.refresh if self.refresh > 0 else None)
             self._wake.clear()
+
+    def _on_publish_error(self, exc: BaseException) -> None:
+        """Count + rate-limit one failed publish. Readers keep the
+        previous snapshot — the counter (and the backoff) are the
+        operator signal, not a log flood."""
+        self._fail_streak += 1
+        self.store.m_publish_failures.inc()
+        now = time.monotonic()
+        if now - self._last_err_log >= self.err_log_interval:
+            self._last_err_log = now
+            log.exception("flowserve mesh publish failed (streak %d); "
+                          "backing off %.1fs between retries "
+                          "(serve_publish_failures_total counts the "
+                          "suppressed repeats)",
+                          self._fail_streak, self._error_backoff())
+        else:
+            log.debug("flowserve mesh publish failed (streak %d): %s",
+                      self._fail_streak, exc)
+
+    def _error_backoff(self) -> float:
+        """Exponential in the failure streak, floored at the refresh
+        cadence, capped at err_backoff_max."""
+        base = max(self.err_backoff_base,
+                   self.refresh if self.refresh > 0 else 0.0)
+        return min(self.err_backoff_max,
+                   base * (2 ** max(0, self._fail_streak - 1)))
 
     def publish_now(self) -> Snapshot:
         """One fan-out PER TOP-K FAMILY (the provider protocol is
@@ -220,6 +266,12 @@ class MeshServePublisher:
         reader until the next publish, where the pre-r14 path paid a
         fan-out per QUERY."""
         from ..mesh import merge as merge_ops
+        from ..utils.faults import FAULTS
+
+        if FAULTS.active:  # flowchaos seam: a failed fan-out/publish —
+            # readers keep the previous snapshot, the error path above
+            # counts + backs off
+            FAULTS.check("serve.publish")
 
         coord = self.coordinator
         families = {}

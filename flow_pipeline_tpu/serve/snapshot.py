@@ -66,6 +66,10 @@ SERVE_METRICS = {
                   "flowserve HTTP responses by status code (label: "
                   "code) — the 5xx-rate alert's denominator-free "
                   "signal"),
+    "publish_failures": ("serve_publish_failures_total",
+                         "mesh snapshot publish attempts that failed "
+                         "(flaky member fetch / injected fault) — "
+                         "readers keep the previous snapshot"),
 }
 
 
@@ -241,6 +245,8 @@ class SnapshotStore:
         self.m_timestamp = REGISTRY.gauge(*SERVE_METRICS["timestamp"])
         self.m_age = REGISTRY.gauge(*SERVE_METRICS["age"])
         self.m_responses = REGISTRY.counter(*SERVE_METRICS["responses"])
+        self.m_publish_failures = REGISTRY.counter(
+            *SERVE_METRICS["publish_failures"])
 
     @property
     def current(self) -> Optional[Snapshot]:

@@ -10,6 +10,9 @@ query them. Here:
   reference-shaped tables — the zero-dependency stand-in for Postgres.
 - ``PostgresSink`` / ``ClickHouseSink``: gated on their drivers; emit the
   same schemas so the reference's Grafana dashboards keep working.
+- ``ResilientSink``: flowchaos retry + dead-letter wrapper around any of
+  the above (``-sink.retries`` / ``-sink.deadletter``; replay with
+  ``flowtpu-replay``).
 - ``ddl``: the schema DDL for all targets, as code.
 
 All sinks implement write(table, rows) and must tolerate repeated partial
@@ -21,6 +24,7 @@ from .base import MemorySink, StdoutSink, rows_to_records
 from .sqlite import SQLiteSink
 from .postgres import PostgresSink
 from .clickhouse import ClickHouseSink
+from .resilient import ResilientSink, replay_deadletter
 from . import ddl
 
 __all__ = [
@@ -29,6 +33,8 @@ __all__ = [
     "SQLiteSink",
     "PostgresSink",
     "ClickHouseSink",
+    "ResilientSink",
+    "replay_deadletter",
     "rows_to_records",
     "ddl",
 ]

@@ -11,6 +11,11 @@ aggregator emits.
 
 from __future__ import annotations
 
+# flowlint: net-checked
+# (sink writes run on the worker/flusher hot path; a hung ClickHouse
+# endpoint must surface as a timeout the retry ladder can handle, not
+# an eternally blocked flush thread)
+
 import ipaddress
 import json
 import urllib.error

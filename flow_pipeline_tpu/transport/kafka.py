@@ -12,6 +12,8 @@ from __future__ import annotations
 
 from typing import Iterable, Optional
 
+from ..utils.faults import FAULTS
+
 _IMPORT_ERROR: Optional[str] = None
 try:  # pragma: no cover - depends on environment
     from kafka import KafkaConsumer as _KC, KafkaProducer as _KP  # type: ignore
@@ -44,6 +46,8 @@ class KafkaProducerAdapter:
     def send(self, msg, partition: Optional[int] = None) -> None:
         """``partition`` pins the message (the flowmesh key-hash shard
         contract); None keeps the client's default partitioner."""
+        if FAULTS.active:  # flowchaos seam: a produce-side broker fault
+            FAULTS.check("kafka.send")
         data = (
             self._wire.encode_frame(msg)
             if self.fixedlen
@@ -117,6 +121,8 @@ class KafkaConsumerAdapter:
         records for several partitions at once; every partition's records
         are batched and queued — none are dropped (the client has already
         advanced its fetch positions past them)."""
+        if FAULTS.active:  # flowchaos seam: a fetch-side broker fault
+            FAULTS.check("kafka.poll")
         if self._pending:
             return self._pending.popleft()
         if not self._seeked:

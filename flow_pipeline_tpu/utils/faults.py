@@ -1,0 +1,178 @@
+"""flowchaos deterministic fault injection.
+
+The only way the repo exercised failure before r17 was hand-written
+kill-one-worker legs; every OTHER seam where a network-wide answer is
+assembled — sink writes, the member->coordinator submit/sync hops, the
+Kafka adapters, the serve publisher fan-out — ran fault-free in every
+test. This module injects faults at exactly those seams, DETERMINISTICALLY,
+so a chaos leg is a reproducible test, not a flake generator:
+
+- A **fault plan** names sites and per-call failure probabilities::
+
+      sink.write:p=0.05;mesh.submit:p=0.02@seed=7
+
+  parsed by :func:`parse_plan`; configured via the ``-faults=`` flag or
+  the ``FLOWTPU_FAULTS`` env fallback (flagless processes — the same
+  contract as ``FLOWTPU_TRACE``).
+
+- Each site draws from its OWN ``random.Random`` seeded by
+  ``(seed, site)``, so the Bernoulli sequence at one site is a pure
+  function of (plan, call index at that site) — thread interleaving
+  ACROSS sites, or adding a new site to the plan, cannot change another
+  site's outcomes. Same plan + same per-site call order => same faults.
+
+- An injected fault raises :class:`FaultInjected`, a subclass of
+  ``OSError`` — the same type family real transport failures surface
+  as, so every retry/dead-letter/rejoin path treats injected and real
+  faults identically (the whole point: the chaos soak drives the REAL
+  recovery machinery, not a parallel test-only path).
+
+- **Off mode is one attribute read**: call sites guard with
+  ``if FAULTS.active and FAULTS.should_fail("site"): ...`` — with no
+  plan configured, the seam costs a single attribute load (the
+  ``bench.py chaos`` paired A/B pins the engaged-but-never-firing cost
+  under 2% as well).
+
+Known sites (kept in :data:`KNOWN_SITES` so a typo'd plan fails loudly
+instead of silently injecting nothing): ``sink.write``,
+``mesh.submit``, ``mesh.sync``, ``kafka.send``, ``kafka.poll``,
+``serve.publish``.
+"""
+
+from __future__ import annotations
+
+# flowlint: lock-checked
+# (fault rolls happen on every pipeline thread — worker, flusher,
+# member drivers, publisher; one lock guards the per-site RNG streams)
+
+import random
+import threading
+from typing import Optional
+
+from ..obs import REGISTRY
+
+# The seams the dataplane actually threads FAULTS through. configure()
+# rejects unknown sites: a chaos leg whose plan names a site nothing
+# checks would "pass" by injecting nothing.
+KNOWN_SITES = frozenset({
+    "sink.write", "mesh.submit", "mesh.sync", "kafka.send", "kafka.poll",
+    "serve.publish",
+})
+
+
+class FaultInjected(OSError):
+    """An injected transport/IO fault. Subclasses OSError so the normal
+    retry/recovery paths handle it exactly like a real failure."""
+
+
+def parse_plan(spec: str) -> tuple[dict[str, float], int]:
+    """``"site:p=0.05;site2:p=0.02@seed=7"`` -> ({site: p}, seed).
+    Raises ValueError on malformed specs, unknown sites, or
+    probabilities outside [0, 1]."""
+    spec = spec.strip()
+    seed = 0
+    if "@" in spec:
+        spec, _, tail = spec.rpartition("@")
+        key, _, val = tail.partition("=")
+        if key.strip() != "seed":
+            raise ValueError(f"expected @seed=N, got @{tail!r}")
+        seed = int(val)
+    sites: dict[str, float] = {}
+    for part in filter(None, (p.strip() for p in spec.split(";"))):
+        site, sep, params = part.partition(":")
+        site = site.strip()
+        if not sep:
+            raise ValueError(f"fault site {part!r} needs :p=<prob>")
+        if site not in KNOWN_SITES:
+            raise ValueError(
+                f"unknown fault site {site!r} (known: "
+                f"{', '.join(sorted(KNOWN_SITES))})")
+        key, _, val = params.partition("=")
+        if key.strip() != "p":
+            raise ValueError(f"fault site {site!r}: expected p=<prob>, "
+                             f"got {params!r}")
+        p = float(val)
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"fault site {site!r}: p={p} outside [0, 1]")
+        sites[site] = p
+    return sites, seed
+
+
+class _Site:
+    __slots__ = ("p", "rng", "rolls", "injected")
+
+    def __init__(self, p: float, seed: int, name: str):
+        self.p = p
+        # per-site stream: the site name folds into the seed so streams
+        # are independent — call interleaving across sites cannot shift
+        # another site's Bernoulli sequence
+        self.rng = random.Random(f"{seed}:{name}")
+        self.rolls = 0
+        self.injected = 0
+
+
+class FaultPlan:
+    """The process-global fault plan. ``configure(spec)`` arms it;
+    ``configure(None)`` / ``configure("")`` disarms (tests MUST disarm
+    in teardown — the plan is process state like TRACER)."""
+
+    def __init__(self):
+        # flowlint: unguarded -- armed/disarmed once at configure time (before the threads that read it); hot-path reads are a racy-but-monotone bool by design
+        self.active = False
+        # flowlint: unguarded -- the lock itself; bound once
+        self._lock = threading.Lock()
+        self._sites: dict[str, _Site] = {}  # guarded-by: _lock
+        # flowlint: unguarded -- rebound only under configure (single caller at startup)
+        self.spec = ""
+        self.m_injected = REGISTRY.counter(
+            "faults_injected_total",
+            "flowchaos injected faults (label: site)")
+
+    def configure(self, spec: Optional[str]) -> None:
+        """Arm/disarm from a plan spec. Empty/None = off."""
+        with self._lock:
+            if not spec:
+                self._sites = {}
+                self.active = False
+                self.spec = ""
+                return
+            sites, seed = parse_plan(spec)
+            self._sites = {name: _Site(p, seed, name)
+                           for name, p in sites.items()}
+            self.spec = spec
+            self.active = any(s.p > 0 for s in self._sites.values())
+
+    def should_fail(self, site: str) -> bool:
+        """One Bernoulli roll on the site's deterministic stream. Call
+        guarded: ``if FAULTS.active and FAULTS.should_fail(...)``."""
+        with self._lock:
+            st = self._sites.get(site)
+            if st is None or st.p <= 0.0:
+                # p=0 sites still exist (the bench A/B runs the armed
+                # path with p=0) but consume no roll — a zero-p site
+                # must not perturb its own future stream
+                return False
+            st.rolls += 1
+            hit = st.rng.random() < st.p
+            if hit:
+                st.injected += 1
+        if hit:
+            self.m_injected.inc(site=site)
+        return hit
+
+    def check(self, site: str) -> None:
+        """Raise FaultInjected when the site's roll fails."""
+        if self.active and self.should_fail(site):
+            raise FaultInjected(f"injected fault at {site} "
+                                f"(plan {self.spec!r})")
+
+    def snapshot(self) -> dict:
+        """{site: {"p", "rolls", "injected"}} — the bench artifact's
+        injection record."""
+        with self._lock:
+            return {name: {"p": st.p, "rolls": st.rolls,
+                           "injected": st.injected}
+                    for name, st in self._sites.items()}
+
+
+FAULTS = FaultPlan()

@@ -41,11 +41,15 @@ KNOWN_FLAGS = frozenset({
     "ingest.native_group", "ingest.fused",
     "checkpoint.path", "flush.count", "metrics.addr", "sink", "in",
     "listen.feed", "query.addr", "obs.trace", "obs.audit",
+    # flowchaos (utils/faults.py, sink/resilient.py, mesh/journal.py)
+    "faults", "sink.retries", "sink.deadletter",
+    # flowtpu-replay (the dead-letter re-ingestion subcommand)
+    "replay.dir", "replay.delete",
     # flowserve (serve/)
     "serve.addr", "serve.refresh",
     # flowmesh (mesh/)
     "mesh.workers", "mesh.role", "mesh.coordinator", "mesh.id",
-    "mesh.listen", "mesh.heartbeat",
+    "mesh.listen", "mesh.heartbeat", "mesh.journal",
     # meshscope lineage CLI (the `lineage` subcommand)
     "lineage.model", "lineage.slot", "lineage.raw",
     # inserter

@@ -1,0 +1,22 @@
+"""Durable-filesystem helpers shared by the flowchaos write paths
+(the coordinator journal and the sink dead-letter spill)."""
+
+from __future__ import annotations
+
+import os
+
+
+def fsync_dir(path: str) -> None:
+    """Make a directory entry durable: fsyncing file CONTENTS alone
+    does not persist a freshly created or renamed name — power loss
+    can drop the file after its data was synced, silently voiding a
+    durability contract. Best-effort on platforms whose directories
+    cannot be opened for sync."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - platform-dependent
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)

@@ -88,6 +88,12 @@ class TestCompose:
         assert "-mesh.role coordinator" in coord
         assert "-bus.partitions 8" in coord
         assert "-query.addr" in coord  # the mesh-aware /topk surface
+        # flowchaos: restart:always + the write-ahead journal on the
+        # durable volume = a crashed coordinator container actually
+        # recovers its frontier/epoch/ledger (docs/FAULT_TOLERANCE.md)
+        assert "-mesh.journal /data/journal" in coord
+        assert "-sink.deadletter /data/spill" in coord
+        assert "meshdata:/data" in services["coordinator"]["volumes"]
         # flowserve: the merged-snapshot read surface (lock-free /query/*)
         assert "-serve.addr" in coord
         assert any("8083" in p for p in
@@ -355,6 +361,32 @@ class TestGrafana:
         assert "sketch_audit_sampled_keys" in exprs
         assert "sketch_audit_cohort_overflow_total" in exprs
 
+    def test_pipeline_dashboard_flowchaos_panels(self):
+        """Round-17 flowchaos panels: sink retry/dead-letter rates, the
+        dead-letter backlog depth next to the mesh transport retries
+        and injected-fault rate, and the coordinator journal's WAL rate
+        + durability lag."""
+        with open(os.path.join(DEPLOY, "grafana", "dashboards",
+                               "pipeline.json")) as f:
+            dash = json.load(f)
+        panels = {p["title"]: p for p in dash["panels"]}
+        retry = panels["Sink write retries and dead-letter rate"]
+        exprs = " ".join(t["expr"] for t in retry["targets"])
+        assert "sink_write_retries_total" in exprs
+        assert "sink_write_failures_total" in exprs
+        assert "sink_deadletter_total" in exprs
+        depth = panels["Dead-letter depth and transport retries"]
+        exprs = " ".join(t["expr"] for t in depth["targets"])
+        assert "sink_deadletter_depth" in exprs
+        assert "mesh_member_retries_total" in exprs
+        assert "faults_injected_total" in exprs
+        wal = panels["Mesh coordinator journal (WAL rate, durability "
+                     "lag)"]
+        exprs = " ".join(t["expr"] for t in wal["targets"])
+        assert "mesh_journal_records_total" in exprs
+        assert "mesh_journal_lag_seconds" in exprs
+        assert "mesh_journal_unsynced_records" in exprs
+
     def test_traffic_dashboards_have_four_topn_tables(self):
         # reference viz.json serves four top-N tables: src/dst IPs AND
         # src/dst ports — both dashboard variants must carry all four
@@ -432,16 +464,21 @@ class TestDashboardHonesty:
 
         from flow_pipeline_tpu.engine import Supervisor
 
-        from flow_pipeline_tpu.mesh import MeshCoordinator
+        from flow_pipeline_tpu.mesh import MeshCoordinator, MeshMember
         from flow_pipeline_tpu.serve import SnapshotStore
+        from flow_pipeline_tpu.sink import MemorySink, ResilientSink
+        from flow_pipeline_tpu.utils import faults as _faults
 
         reg = MetricsRegistry()
         CollectorServer(None, CollectorConfig(netflow_addr=None,
                                               sflow_addr=None), registry=reg)
         StreamWorker(consumer=None, models={})  # registers on the global
         Supervisor(lambda: None)  # worker_restarts_total
-        MeshCoordinator([], 2)  # mesh_* families (eager registration)
+        MeshCoordinator([], 2)  # mesh_* families (incl. journal_*)
+        MeshMember("honesty", None, None, None)  # mesh_member_retries
         SnapshotStore()  # serve_* families (eager registration)
+        ResilientSink(MemorySink())  # sink retry/dead-letter families
+        assert _faults.FAULTS.m_injected is not None  # faults_injected
         names = set(reg._metrics) | set(REGISTRY._metrics)
         for text in (reg.render(), REGISTRY.render()):
             for line in text.splitlines():
@@ -500,6 +537,13 @@ class TestDashboardHonesty:
         assert checked >= 8
         # the audit error-ratio p99 rule the r15 satellite names
         assert any("sketch_estimate_error_ratio_bucket" in r["expr"]
+                   for r in rules)
+        # the flowchaos rules the r17 satellite names: dead-letter
+        # backlog (> 0 pages), sink retry rate, coordinator journal lag
+        assert any("sink_deadletter_depth" in r["expr"] for r in rules)
+        assert any("sink_write_retries_total" in r["expr"]
+                   for r in rules)
+        assert any("mesh_journal_lag_seconds" in r["expr"]
                    for r in rules)
 
     def test_alerts_wired_into_prometheus_and_compose(self):

@@ -1496,6 +1496,94 @@ class TestJsonRuleNarrowing:
         assert data["rules"] == ["lock-order"]
 
 
+class TestNetTimeout:
+    """r17 satellite: every urlopen/socket/requests call in net-checked
+    modules must carry an explicit timeout (the r13 mesh trace fan-out
+    bug was exactly this class)."""
+
+    def test_unmarked_module_not_checked(self, tmp_path):
+        out = _lint(tmp_path, """
+            import urllib.request
+            def f(url):
+                return urllib.request.urlopen(url).read()
+            """, rules=("net-timeout",))
+        assert out == []
+
+    def test_urlopen_without_timeout_flagged(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: net-checked
+            import urllib.request
+            def f(url):
+                return urllib.request.urlopen(url).read()
+            """, rules=("net-timeout",))
+        assert _rules(out) == ["net-timeout"]
+        assert "urlopen" in out[0].message
+
+    def test_aliased_urlopen_still_matched(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: net-checked
+            import urllib.request as _rq
+            def f(url):
+                return _rq.urlopen(url).read()
+            """, rules=("net-timeout",))
+        assert _rules(out) == ["net-timeout"]
+
+    def test_timeout_kw_and_positional_accepted(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: net-checked
+            import socket
+            import urllib.request
+            def f(url, addr):
+                a = urllib.request.urlopen(url, timeout=5).read()
+                b = urllib.request.urlopen(url, None, 5).read()
+                c = socket.create_connection(addr, 2.0)
+                d = socket.create_connection(addr, timeout=2.0)
+                return a, b, c, d
+            """, rules=("net-timeout",))
+        assert out == []
+
+    def test_http_connection_and_requests_checked(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: net-checked
+            import http.client
+            import requests
+            import socket
+            def f(host, addr, url):
+                c1 = http.client.HTTPConnection(host, 80)
+                c2 = http.client.HTTPConnection(host, 80, timeout=3)
+                r1 = requests.get(url)
+                r2 = requests.get(url, timeout=3)
+                s1 = socket.create_connection(addr)
+                return c1, c2, r1, r2, s1
+            """, rules=("net-timeout",))
+        assert _rules(out) == ["net-timeout"] * 3
+        lines = sorted(f.line for f in out)
+        assert lines == [7, 9, 11]
+
+    def test_suppression_with_reason_accepted(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: net-checked
+            import urllib.request
+            def f(url):
+                # deliberate: blocks until the endless stream closes
+                return urllib.request.urlopen(url).read()  # flowlint: disable=net-timeout -- endless tail follow, bounded by caller's thread lifetime
+            """, rules=("net-timeout",))
+        assert out == []
+
+    def test_repo_net_modules_are_marked(self):
+        """The modules that actually open cross-process sockets must
+        stay opted in — deleting a marker would silently de-fang the
+        rule exactly where it matters."""
+        from tools.flowlint.core import load_files
+
+        rels = ["flow_pipeline_tpu/mesh/server.py",
+                "flow_pipeline_tpu/serve/loadgen.py",
+                "flow_pipeline_tpu/sink/clickhouse.py",
+                "flow_pipeline_tpu/cli.py"]
+        for sf in load_files(REPO, rels):
+            assert "net-checked" in sf.markers, sf.rel
+
+
 class TestRepoRegression:
     def test_repo_lints_clean(self):
         findings = run_lint(REPO)

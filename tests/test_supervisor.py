@@ -1,0 +1,262 @@
+"""engine/supervisor.py under repeated crash-restore cycles (flowchaos
+satellite): backoff reset after a healthy era, factory/restore crashes
+riding the same ladder as run crashes, and the checkpoint-restore
+integration — a worker crash-looping through sink failures recovers to
+EXACT output. (The basic restart/give-up tests live in
+test_feed_supervisor.py, which is skipped without grpcio; this file
+has no such gate — the supervisor itself needs none.)"""
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.engine import (StreamWorker, Supervisor,
+                                      SupervisorConfig, WorkerConfig)
+from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+from flow_pipeline_tpu.models import WindowAggConfig, WindowAggregator
+from flow_pipeline_tpu.sink import MemorySink
+from flow_pipeline_tpu.transport import Consumer, InProcessBus
+
+
+class _Clock:
+    """Injectable monotonic clock: sleeps advance it, tests can jump it."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def time(self):
+        return self.now
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.now += s
+
+
+class TestBackoffLadder:
+    def _crashing_supervisor(self, clock, crashes, **cfg):
+        state = {"n": 0}
+
+        class Worker:
+            def run(self):
+                state["n"] += 1
+                if state["n"] <= crashes:
+                    raise RuntimeError(f"crash {state['n']}")
+
+            def finalize(self):
+                pass
+
+        sup = Supervisor(Worker,
+                         SupervisorConfig(**cfg),
+                         time_fn=clock.time, sleep_fn=clock.sleep)
+        return sup
+
+    def test_backoff_resets_after_healthy_era(self):
+        """Crashes separated by more than window_seconds are unrelated
+        incidents: the backoff must restart from backoff_initial, not
+        keep compounding forever."""
+        clock = _Clock()
+        state = {"n": 0}
+
+        class Worker:
+            def run(self):
+                state["n"] += 1
+                if state["n"] in (1, 2):
+                    raise RuntimeError("burst 1")
+                if state["n"] == 3:
+                    clock.now += 1000.0  # a long healthy run...
+                    raise RuntimeError("fresh incident")  # ...then crash
+                # state 4: clean exit
+
+            def finalize(self):
+                pass
+
+        sup = Supervisor(Worker,
+                         SupervisorConfig(max_restarts=5,
+                                          window_seconds=300.0,
+                                          backoff_initial=0.5,
+                                          backoff_max=30.0),
+                         time_fn=clock.time, sleep_fn=clock.sleep)
+        sup.run()
+        # burst 1: 0.5 then 1.0; the post-healthy-era crash resets to 0.5
+        assert clock.sleeps == [0.5, 1.0, 0.5]
+        assert sup.restarts == 3
+
+    def test_crash_burst_gives_up(self):
+        clock = _Clock()
+        sup = self._crashing_supervisor(clock, crashes=99,
+                                        max_restarts=2,
+                                        window_seconds=300.0,
+                                        backoff_initial=0.1,
+                                        backoff_max=0.2)
+        with pytest.raises(RuntimeError):
+            sup.run()
+        assert sup.restarts == 3  # 2 allowed restarts + the final crash
+        assert clock.sleeps == [0.1, 0.2]  # capped at backoff_max
+
+    def test_factory_crash_counts_as_restart(self):
+        """A crash DURING restore/build (factory()) must ride the same
+        backoff ladder — regression: it previously propagated straight
+        out, so one corrupt-checkpoint read killed the supervisor that
+        exists to absorb exactly that."""
+        clock = _Clock()
+        calls = []
+
+        def factory():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("restore failed (corrupt checkpoint)")
+
+            class Worker:
+                def run(self):
+                    pass
+
+                def finalize(self):
+                    pass
+
+            return Worker()
+
+        sup = Supervisor(factory,
+                         SupervisorConfig(max_restarts=5,
+                                          backoff_initial=0.1),
+                         time_fn=clock.time, sleep_fn=clock.sleep)
+        sup.run()
+        assert len(calls) == 3
+        assert sup.restarts == 2
+
+    def test_factory_crash_loop_still_gives_up(self):
+        clock = _Clock()
+
+        def factory():
+            raise RuntimeError("permanently corrupt")
+
+        sup = Supervisor(factory,
+                         SupervisorConfig(max_restarts=2,
+                                          backoff_initial=0.01),
+                         time_fn=clock.time, sleep_fn=clock.sleep)
+        with pytest.raises(RuntimeError, match="permanently corrupt"):
+            sup.run()
+        assert sup.restarts == 3
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-restore integration: crash cycles recover to exact output
+# ---------------------------------------------------------------------------
+
+
+N_FLOWS = 16_384
+BATCH = 2048
+
+
+def _bus():
+    bus = InProcessBus()
+    bus.create_topic("flows", 1)
+    gen = FlowGenerator(ZipfProfile(n_keys=50, alpha=1.2), seed=4,
+                        rate=60.0)  # multi-window: several flushes
+    from flow_pipeline_tpu.schema import wire
+
+    done = 0
+    while done < N_FLOWS:
+        n = min(8192, N_FLOWS - done)
+        bus.produce_many("flows", wire.iter_raw_frames(
+            gen.batch(n).to_wire()))
+        done += n
+    return bus
+
+
+def _fold(tables):
+    acc = {}
+    for rec in tables.get("flows_5m", []):
+        key = (rec["timeslot"], rec["src_as"], rec["dst_as"],
+               rec["etype"])
+        v = acc.setdefault(key, np.zeros(3, np.uint64))
+        v += np.array([rec["bytes"], rec["packets"], rec["count"]],
+                      np.uint64)
+    return acc
+
+
+class _SinkCrashingBefore:
+    """Fails the first ``fails`` write ATTEMPTS before touching the
+    inner sink — the flush dies, the step never commits, a restart
+    replays the window from the checkpoint (at-least-once with no
+    partial rows)."""
+
+    def __init__(self, inner, fails):
+        self.inner = inner
+        self.fails = fails
+        self.attempts = 0
+
+    def write(self, table, rows):
+        self.attempts += 1
+        if self.attempts <= self.fails:
+            raise ConnectionResetError(
+                f"sink down (attempt {self.attempts})")
+        self.inner.write(table, rows)
+
+
+def _models():
+    return {"flows_5m": WindowAggregator(
+        WindowAggConfig(batch_size=BATCH))}
+
+
+def test_repeated_crash_restore_cycles_stay_exact(tmp_path):
+    """The worker-side recovery primitive, end to end: the sink kills
+    the worker twice mid-stream (FlushError), the supervisor rebuilds
+    through the checkpoint each time, and the folded flows_5m output
+    equals a never-crashed run's exactly — replay re-emits only what
+    was never committed."""
+    # reference run: same stream, healthy sink
+    clean = MemorySink()
+    StreamWorker(Consumer(_bus(), "flows", fixedlen=True), _models(),
+                 [clean],
+                 WorkerConfig(poll_max=BATCH, snapshot_every=4)
+                 ).run(stop_when_idle=True)
+
+    sink = MemorySink()
+    flaky = _SinkCrashingBefore(sink, fails=2)
+    ckpt = str(tmp_path / "ckpt")
+    bus = _bus()
+
+    def factory():
+        worker = StreamWorker(
+            Consumer(bus, "flows", fixedlen=True), _models(), [flaky],
+            WorkerConfig(poll_max=BATCH, snapshot_every=4,
+                         checkpoint_path=ckpt))
+        worker.restore()  # no-op on the first boot, the cycle after
+        return worker
+
+    sup = Supervisor(factory,
+                     SupervisorConfig(max_restarts=5,
+                                      backoff_initial=0.01,
+                                      backoff_max=0.02),
+                     stop_when_idle=True)
+    sup.run()
+    assert sup.restarts == 2  # both sink crashes absorbed
+    f_clean, f_crashy = _fold(clean.tables), _fold(sink.tables)
+    assert set(f_clean) == set(f_crashy)
+    for k in f_clean:
+        assert (f_crashy[k] == f_clean[k]).all(), k
+
+
+def test_crash_during_restore_then_recovers(tmp_path):
+    """Crash cycle where the RESTORE itself fails once (corrupt/locked
+    checkpoint store): the supervisor must absorb it and the eventual
+    run still drains the stream."""
+    sink = MemorySink()
+    bus = _bus()
+    state = {"n": 0}
+
+    def factory():
+        state["n"] += 1
+        if state["n"] == 1:
+            raise OSError("checkpoint store unavailable")
+        return StreamWorker(
+            Consumer(bus, "flows", fixedlen=True), _models(), [sink],
+            WorkerConfig(poll_max=BATCH, snapshot_every=4))
+
+    sup = Supervisor(factory,
+                     SupervisorConfig(max_restarts=3,
+                                      backoff_initial=0.01),
+                     stop_when_idle=True)
+    sup.run()
+    assert sup.restarts == 1
+    assert sum(r["count"] for r in sink.tables["flows_5m"]) == N_FLOWS

@@ -1,0 +1,93 @@
+"""net-timeout: every network call in marked modules carries an
+explicit timeout.
+
+In modules marked ``# flowlint: net-checked`` (the modules that open
+sockets to other processes: the mesh HTTP transport, the serve load
+generator, the ClickHouse sink, the cli's lineage fetch), every call
+that opens a network connection must pass an EXPLICIT timeout — a
+defaulted ``urlopen`` blocks on the global socket default (usually
+forever), and a single missing timeout is how the r13 mesh trace
+fan-out stacked 5-second stalls per dead member onto a handler thread.
+The class of bug is silent: the call works perfectly until the peer
+hangs, which is exactly when the caller is least able to afford it.
+
+Checked calls (matched on the dotted callee name, so aliased imports
+like ``_rq.urlopen`` still match):
+
+- ``*.urlopen(...)``                 needs ``timeout=`` (or the 3rd
+                                     positional arg)
+- ``socket.create_connection(...)``  needs ``timeout=`` (or the 2nd
+                                     positional arg)
+- ``*.HTTPConnection(...)`` /        needs ``timeout=``
+  ``*.HTTPSConnection(...)``
+- ``requests.get/post/...(...)``     needs ``timeout=`` (requests has
+                                     NO default timeout at all)
+
+Suppress a deliberate unbounded call with
+``# flowlint: disable=net-timeout -- <why unbounded is safe>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile, dotted_name
+
+RULE = "net-timeout"
+MARKER = "net-checked"
+
+_REQUESTS_METHODS = {"get", "post", "put", "delete", "head", "patch",
+                     "request"}
+
+
+def _timeout_satisfied(call: ast.Call, positional_slot: int | None) -> bool:
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    if positional_slot is not None and len(call.args) > positional_slot:
+        return True
+    return False
+
+
+def _classify(call: ast.Call) -> tuple[str, int | None] | None:
+    """(description, positional timeout slot) when this call must carry
+    a timeout, else None."""
+    d = dotted_name(call.func)
+    if d is None:
+        return None
+    last = d.rsplit(".", 1)[-1]
+    if last == "urlopen":
+        # urllib.request.urlopen(url, data=None, timeout=...) — slot 2
+        return d, 2
+    if d == "socket.create_connection":
+        # create_connection(address, timeout=...) — slot 1
+        return d, 1
+    if last in ("HTTPConnection", "HTTPSConnection"):
+        # http.client.HTTPConnection(host, port=None, timeout=...):
+        # positional timeout (slot 2) is legal but unreadable — accept
+        # it anyway, the rule is about boundedness, not style
+        return d, 2
+    if d.startswith("requests.") and last in _REQUESTS_METHODS:
+        return d, None  # keyword-only in practice
+    return None
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        if sf.tree is None or MARKER not in sf.markers:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = _classify(node)
+            if hit is None:
+                continue
+            name, slot = hit
+            if not _timeout_satisfied(node, slot):
+                findings.append(Finding(
+                    RULE, sf.rel, node.lineno,
+                    f"network call `{name}(...)` without an explicit "
+                    "timeout in a net-checked module — a hung peer "
+                    "blocks this thread forever; pass timeout= (or "
+                    "suppress with a reason)"))
+    return sorted(findings, key=lambda f: (f.path, f.line))

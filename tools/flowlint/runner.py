@@ -18,6 +18,7 @@ from . import (
     rules_flags,
     rules_lockorder,
     rules_locks,
+    rules_net,
     rules_purity,
 )
 from .core import (
@@ -30,7 +31,8 @@ from .core import (
 
 DEFAULT_SUBDIRS = ("flow_pipeline_tpu", "bench.py", "tests")
 ALL_RULES = ("jit-purity", "uint64-discipline", "lock-discipline",
-             "lock-order", "flag-registry", "abi-contract")
+             "lock-order", "flag-registry", "abi-contract",
+             "net-timeout")
 
 
 def run_lint(root: str, rel_paths: list[str] | None = None,
@@ -64,6 +66,8 @@ def run_lint(root: str, rel_paths: list[str] | None = None,
         result.extend_filtered(by_rel, rules_flags.check(files, root))
     if "abi-contract" in selected:
         result.extend_filtered(by_rel, rules_abi.check(files, root))
+    if "net-timeout" in selected:
+        result.extend_filtered(by_rel, rules_net.check(files))
     # suppressions themselves must be justified + must still bite;
     # unused-reporting is only sound when every rule actually ran
     result.findings.extend(suppression_findings(
