@@ -6,15 +6,18 @@
 .PHONY: all native test bench proto clean services-test lint native-san \
 	hostsketch-parity fused-parity fused-parity-traced mesh-parity \
 	mesh-parity-traced serve-load audit-parity invertible-parity \
-	chaos-parity
+	chaos-parity gateway-parity
 
 all: native
 
 native:
 	$(MAKE) -C native
 
+# fast suite: the tier-1 budget excludes @pytest.mark.slow soaks —
+# the parity targets below (gateway-parity, chaos-parity) run their
+# suites unfiltered, slow legs included
 test:
-	python -m pytest tests/ -x -q
+	python -m pytest tests/ -x -q -m "not slow"
 
 bench:
 	python bench.py
@@ -105,6 +108,18 @@ fused-parity-traced:
 chaos-parity:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
 		tests/test_supervisor.py -v
+
+# flowgate (gateway/): the read-tier gates — every /query/* answer
+# served through a gateway replica must be BYTE-identical to the
+# direct snapshot path's at the same version (worker AND mesh
+# publishers, table AND invertible sketches, full-ship AND delta-fed
+# mirrors), the delta codec must reconstruct bit-exactly through
+# torn/reordered/extreme-u64 damage (resync, never guess), and the
+# churn legs — kill-one-gateway behind the consistent-hash client,
+# kill-one-mesh-worker under gateway read load — must surface zero
+# 5xx with monotone versions (docs/ARCHITECTURE.md "flowgate").
+gateway-parity:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_gateway.py -v
 
 # sketchwatch (obs/audit.py): the accuracy-observability suite — the
 # audit must be purely observational (audit-on vs audit-off sink rows

@@ -1267,6 +1267,9 @@ SERVE_FLOWS = 800_000
 SERVE_PROCS = 2      # reader subprocesses (honest concurrency: no GIL
 SERVE_THREADS = 4    # sharing with the server) x connections each
 SERVE_PAIRS = 4
+GATEWAY_PAIRS = 2    # direct-vs-gateway alternating A/B pairs (r18)
+TRICKLE_PUBLISHES = 4   # production-cadence delta-efficiency samples
+TRICKLE_FLOWS = 4096    # stream between trickle publishes (~4s modeled)
 
 
 def bench_serve() -> None:
@@ -1316,7 +1319,10 @@ def bench_serve() -> None:
         baseline); "pub" = flowserve wired (publisher in the batch
         loop, snapshots publishing, server up) but NO readers — what
         the serving MACHINERY costs the dataplane; "load" = "pub" plus
-        the reader processes for ``load_s`` inside the ingest window.
+        the reader processes for ``load_s`` inside the ingest window;
+        "gwload" = "load" with the readers pointed at a flowgate
+        REPLICA mirroring the serve surface over HTTP (delta-fed; the
+        load stats gain the feed's bytes-per-publish ledger).
         Returns (ingest flows/s, load stats | None, max age | None,
         server | None — still running, for the idle-ceiling leg)."""
         worker = StreamWorker(
@@ -1330,6 +1336,15 @@ def bench_serve() -> None:
             # production deployment pays (window closes + 2s cadence)
             pub = attach_worker(worker, refresh=2.0)
             server = ServeServer(pub.store, port=0).start()
+        gw = gws = None
+        if mode == "gwload":
+            from flow_pipeline_tpu.gateway import SnapshotGateway
+            from flow_pipeline_tpu.serve import ServeServer as _SS
+
+            gw = SnapshotGateway([f"127.0.0.1:{server.port}"],
+                                 poll=0.05)
+            gws = _SS(gw.store, port=0).start()
+            gw.serve_on(gws).start()
         dt = {}
 
         def ingest():
@@ -1339,17 +1354,26 @@ def bench_serve() -> None:
 
         t = threading.Thread(target=ingest, daemon=True)
         t.start()
-        if mode == "load":
-            assert wait_ready("127.0.0.1", server.port, timeout=60)
+        if mode in ("load", "gwload"):
+            read_port = gws.port if mode == "gwload" else server.port
+            assert wait_ready("127.0.0.1", read_port, timeout=60)
             done = threading.Event()
-            sampler, ages = sample_ages("127.0.0.1", server.port, done)
-            load = run_load_procs("127.0.0.1", server.port,
+            sampler, ages = sample_ages("127.0.0.1", read_port, done)
+            load = run_load_procs("127.0.0.1", read_port,
                                   procs=SERVE_PROCS,
                                   threads=SERVE_THREADS,
                                   duration=load_s)
             done.set()
             sampler.join(timeout=10)
         t.join()
+        if mode == "gwload":
+            # the upstream feed's shipping-cost ledger IS the honest
+            # delta-efficiency evidence (encoded sizes per observed
+            # publish, both codings)
+            feed = server._feed
+            load["feed_stats"] = feed.stats() if feed else None
+            gw.stop()
+            gws.stop()
         return (SERVE_FLOWS / dt["s"] if dt.get("s") else 0.0, load,
                 max(ages) if ages else None, server)
 
@@ -1402,6 +1426,138 @@ def bench_serve() -> None:
                           procs=SERVE_PROCS, threads=SERVE_THREADS,
                           duration=2.0)
     idle_server.stop()
+    # flowgate leg (r18): the same reader fleet through a delta-fed
+    # gateway REPLICA, paired alternating-order against the direct
+    # path (r11 methodology — same box, adjacent legs, the RATIO is
+    # the claim; absolutes are box-bound like everything here). The
+    # gateway mirrors over real HTTP /sub/snapshot polls, so the leg
+    # also produces the honest delta-vs-full bytes-per-publish ledger.
+    from flow_pipeline_tpu.obs import REGISTRY as _REG
+
+    syncs0 = {k: _REG.counter("gateway_syncs_total").value(kind=k)
+              for k in ("full", "delta", "none")}
+    gw_loads, gw_direct_loads, feed_ledgers = [], [], []
+    for i in range(GATEWAY_PAIRS):
+        order = ("gwload", "load") if i % 2 == 0 else ("load", "gwload")
+        for m in order:
+            _, load, _, srv = run_leg(m, load_s)
+            srv.stop()
+            if m == "gwload":
+                gw_loads.append(load)
+                if load.get("feed_stats"):
+                    feed_ledgers.append(load["feed_stats"])
+            else:
+                gw_direct_loads.append(load)
+    sync_kinds = {k: _REG.counter("gateway_syncs_total").value(kind=k)
+                  - syncs0[k] for k in syncs0}
+
+    # delta efficiency at PRODUCTION cadence: the saturated legs above
+    # compress ~400s of event time into one refresh interval, dirtying
+    # every CMS tile — the honest worst case (delta ~= full + tile
+    # overhead). The append-mostly regime the codec targets is a
+    # publish per FEW SECONDS of traffic; this leg measures it with a
+    # real worker: full 800k warmup, then TRICKLE_FLOWS of additional
+    # stream per publish (at -produce.rate 1000 that is ~4s of modeled
+    # open-window traffic between versions).
+    def delta_trickle_ledger():
+        from flow_pipeline_tpu.gateway import SnapshotFeed
+
+        bus = InProcessBus()
+        bus.create_topic("flows", 2)
+        gen = _make_generator(vals)
+        produced = 0
+        while produced < SERVE_FLOWS:
+            bus.produce_many("flows", _batch_frames(gen.batch(16384)))
+            produced += 16384
+        worker = StreamWorker(
+            Consumer(bus, fixedlen=True), _build_models(vals), [],
+            WorkerConfig(poll_max=vals["processor.batch"],
+                         snapshot_every=0, ingest_native_group=True))
+        pub = attach_worker(worker, refresh=0.0)
+        while worker.run_once():
+            pass
+        with worker.lock:
+            pub.publish(worker)
+        feed = SnapshotFeed(pub.store)
+        feed.frame_since(0)  # observe the warmed-up full
+        for _ in range(TRICKLE_PUBLISHES):
+            bus.produce_many("flows",
+                             _batch_frames(gen.batch(TRICKLE_FLOWS)))
+            while worker.run_once():
+                pass
+            with worker.lock:
+                pub.publish(worker)
+            feed.frame_since(0)  # observe -> the ledger records the delta
+        return feed.stats()
+
+    trickle = delta_trickle_ledger()
+    gw_qps = statistics.median(x["qps"] for x in gw_loads)
+    gw_direct_qps = statistics.median(x["qps"]
+                                      for x in gw_direct_loads)
+    gw_codes: dict[str, int] = {}
+    for x in gw_loads:
+        for c, n in x["codes"].items():
+            gw_codes[c] = gw_codes.get(c, 0) + n
+    fed = {
+        "publishes": sum(f["publishes"] for f in feed_ledgers),
+        "deltas": sum(f["deltas"] for f in feed_ledgers),
+        "full_bytes": sum(f["full_bytes"] for f in feed_ledgers),
+        "delta_bytes": sum(f["delta_bytes"] for f in feed_ledgers),
+    } if feed_ledgers else {}
+    gateway_section = {
+        "replica_qps": round(gw_qps, 1),
+        "replica_p50_ms": round(statistics.median(
+            x["p50_ms"] for x in gw_loads), 3),
+        "replica_p99_ms": round(statistics.median(
+            x["p99_ms"] for x in gw_loads), 3),
+        "direct_qps": round(gw_direct_qps, 1),
+        "direct_p50_ms": round(statistics.median(
+            x["p50_ms"] for x in gw_direct_loads), 3),
+        "direct_p99_ms": round(statistics.median(
+            x["p99_ms"] for x in gw_direct_loads), 3),
+        "qps_ratio_gateway_vs_direct": round(
+            gw_qps / gw_direct_qps, 3) if gw_direct_qps else None,
+        "pairs": GATEWAY_PAIRS,
+        "poll_s": 0.05,
+        "codes": gw_codes,
+        "zero_5xx": not any(c.startswith("5") for c in gw_codes),
+        "transport_errors": sum(x["errors"] for x in gw_loads),
+        "sync_kinds": sync_kinds,
+        "bytes_per_publish_full": round(
+            fed["full_bytes"] / fed["publishes"], 1)
+        if fed.get("publishes") else None,
+        "bytes_per_publish_delta": round(
+            fed["delta_bytes"] / fed["deltas"], 1)
+        if fed.get("deltas") else None,
+        "delta_to_full_bytes_ratio": round(
+            (fed["delta_bytes"] / fed["deltas"])
+            / (fed["full_bytes"] / fed["publishes"]), 4)
+        if fed.get("deltas") and fed.get("publishes") else None,
+        "trickle": {
+            "flows_per_publish": TRICKLE_FLOWS,
+            "publishes": trickle.get("deltas", 0),
+            "bytes_per_publish_full": trickle.get(
+                "full_bytes_per_publish"),
+            "bytes_per_publish_delta": trickle.get(
+                "delta_bytes_per_publish"),
+            "delta_to_full_bytes_ratio": round(
+                trickle["delta_bytes_per_publish"]
+                / trickle["full_bytes_per_publish"], 4)
+            if trickle.get("delta_bytes_per_publish")
+            and trickle.get("full_bytes_per_publish") else None,
+        },
+        "note": (
+            "paired alternating-order direct-vs-gateway legs on the "
+            "SAME box: readers, dataplane AND the mirror thread share "
+            "nproc cores, so the ratio (not either absolute) is the "
+            "honest statistic. bytes_per_publish_* come from the "
+            "upstream feed's encoded-frame ledger: the load legs "
+            "compress ~400s of event time into one refresh interval "
+            "(every CMS tile dirty — delta ~= full, the recorded "
+            "worst case); `trickle` is the append-mostly regime the "
+            "codec targets — a publish per few seconds of modeled "
+            "open-window traffic"),
+    }
     qps = statistics.median(x["qps"] for x in loads)
     codes: dict[str, int] = {}
     for x in loads + [idle]:
@@ -1455,6 +1611,7 @@ def bench_serve() -> None:
         "overhead_budget_pct": 2.0,
         "within_budget": pub_overhead < 2.0,
         "reader_contention_pct": round(contention, 2),
+        "gateway": gateway_section,
         "native_capabilities": native_lib.capabilities(),
         "native_decode": _NATIVE,
         "platform": _PLATFORM,

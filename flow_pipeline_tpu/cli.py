@@ -1037,6 +1037,62 @@ def replay_main(argv=None) -> int:
     return 0
 
 
+def gateway_main(argv=None) -> int:
+    """flowgate replica: mirror upstream snapshot streams (worker or
+    mesh-coordinator flowserve surfaces, ``/sub/snapshot``) into a
+    local store and serve ``/query/*`` from this process's own cores.
+    Run K of these behind client-side consistent hashing
+    (gateway/ring.py) for a horizontally scaled read tier — see
+    docs/ARCHITECTURE.md "flowgate"."""
+    fs = FlagSet("gateway")
+    fs.string("loglevel", "info", "Log level")
+    fs.string("gateway.upstream", "",
+              "Comma-separated upstream flowserve host:port list to "
+              "subscribe to (first = the primary stream this replica "
+              "serves)")
+    fs.string("gateway.listen", "127.0.0.1:8084",
+              "host:port the gateway serves /query/* on")
+    fs.number("gateway.poll", 0.25,
+              "Subscription poll cadence in seconds (deltas ship "
+              "between versions; a gap forces a full resync)")
+    fs.string("metrics.addr", "", "host:port for /metrics (empty "
+                                  "disables)")
+    fs.string("faults", "", "flowchaos deterministic fault plan "
+                            "(gateway.poll is the flowgate seam)",
+              env="FLOWTPU_FAULTS")
+    vals = fs.parse(argv if argv is not None else sys.argv[2:])
+    set_level(vals["loglevel"])
+    if not vals["gateway.upstream"]:
+        log.error("gateway needs -gateway.upstream host:port[,host:port]")
+        return 2
+    from .gateway import SnapshotGateway
+    from .serve import ServeServer
+    from .utils.faults import FAULTS
+
+    FAULTS.configure(vals["faults"])
+    server = _start_metrics(vals["metrics.addr"], 8081)
+    gw = SnapshotGateway(
+        [u.strip() for u in vals["gateway.upstream"].split(",")
+         if u.strip()],
+        poll=vals["gateway.poll"])
+    host, port = _host_port(vals["gateway.listen"], 8084)
+    serve = ServeServer(gw.store, port, host).start()
+    gw.serve_on(serve).start()
+    log.info("flowgate replica serving %s on http://%s:%d/query",
+             vals["gateway.upstream"], host, serve.port)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        gw.stop()
+        serve.stop()
+        if server:
+            server.stop()
+    return 0
+
+
 def collector_main(argv=None) -> int:
     """UDP flow collector (in-framework GoFlow replacement): listens for
     sFlow on 6343 and NetFlow/IPFIX on 2055, produces FlowMessages."""
@@ -1110,6 +1166,7 @@ _COMMANDS = {
     "collector": collector_main,
     "lineage": lineage_main,
     "replay": replay_main,
+    "gateway": gateway_main,
 }
 
 
@@ -1117,7 +1174,7 @@ def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if not argv or argv[0] in ("-h", "-help", "--help"):
         print("usage: flow_pipeline_tpu.cli <mocker|processor|inserter|"
-              "pipeline|collector|lineage|replay> [-flags]\n"
+              "pipeline|collector|lineage|replay|gateway> [-flags]\n"
               "Run '<cmd> -help' for flags.")
         return 0 if argv else 2
     cmd = _COMMANDS.get(argv[0])
@@ -1157,6 +1214,10 @@ def lineage_entry() -> None:
 
 def replay_entry() -> None:
     sys.exit(main(["replay"] + sys.argv[1:]))
+
+
+def gateway_entry() -> None:
+    sys.exit(main(["gateway"] + sys.argv[1:]))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,343 @@
+"""flowgate snapshot delta codec.
+
+A published :class:`~..serve.snapshot.Snapshot` is megabytes (the CMS
+planes dominate), but consecutive versions are append-mostly: between
+window closes only the open window's touched buckets and the freshest
+top-K rows move. Shipping the whole snapshot at the ``-serve.refresh``
+cadence would make gateway fan-in cost O(snapshot) per publish; this
+codec makes it O(change):
+
+- :func:`snapshot_state` lowers a snapshot to its **canonical state
+  dict** — plain numpy arrays only (top-K row columns, the frozen
+  uint64 CMS planes, range-slot row sets) plus JSON-safe metadata. The
+  state dict is the unit of comparison AND of reconstruction:
+  :func:`state_to_snapshot` rebuilds an immutable ``Snapshot`` whose
+  arrays are bit-identical to the source's, which is what makes every
+  gateway-served answer exact by construction.
+- :func:`diff_states` emits a **delta**: per family the scalar metadata
+  (tiny, always shipped), the ranked rows only when any column changed,
+  and the CMS per depth row as either a **sparse dirty-column patch**
+  (changed column indices + their values across all planes — hashed
+  updates spread uniformly, so this is the append-mostly coding) or
+  **dirty tiles** (``TILE_W``-wide column slabs, the dense-row
+  fallback); comparison is uint64 equality — exact, no tolerance.
+  Range tables ship the authoritative slot list plus the row sets of
+  new or changed slots; everything else is copied forward by reference
+  on apply.
+- Frames are ``FGWD1`` + ``u32 len | u32 crc32`` around a
+  mesh-codec body (the same no-pickle JSON-tree + npz split the mesh
+  envelope uses — dtype/shape/word exact on the uint64 envelope). A
+  torn or corrupted frame raises :class:`DeltaError`; an out-of-order
+  delta raises :class:`DeltaGapError`. Both are the subscriber's cue to
+  fall back to a full-snapshot resync — never to guess.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ..mesh import codec
+from ..serve.snapshot import FamilyView, FrozenCms, Snapshot
+
+MAGIC = b"FGWD1\n"
+_HEAD = struct.Struct("<II")  # body_len, crc32(body)
+
+# CMS dirty-tile width (uint64 words along the last plane axis), the
+# DENSE-row coding: when most of a depth row changed, whole column
+# slabs ship with one coordinate per TILE_W words.
+TILE_W = 256
+
+# Sparse-row threshold: hashed updates spread UNIFORMLY over a depth
+# row, so even a few thousand touched buckets dirty almost every tile
+# — tile granularity alone cannot expose append-mostly sparsity
+# (measured: a 4096-flow trickle shipped ~full-size deltas). A row
+# whose changed-column fraction is below this ships as (column
+# indices, column values) instead; per changed column that costs
+# 8 bytes of index + (P+1)*8 bytes of values, which beats the full
+# row slab up to ~70% density for the 3-plane default.
+SPARSE_FRAC = 0.5
+
+
+class DeltaError(ValueError):
+    """A torn, truncated, or CRC-failing frame — resync, don't guess."""
+
+
+class DeltaGapError(DeltaError):
+    """A delta whose ``from`` version does not match the local state —
+    the chain has a hole (missed publish, evicted history); resync."""
+
+
+# ---- snapshot <-> canonical state ------------------------------------------
+
+
+def snapshot_state(snap: Snapshot) -> dict:
+    """Lower one immutable snapshot to the canonical state dict. CMS
+    planes are materialized here (``FrozenCms.get`` — the lazy f32→u64
+    freeze runs on the CALLER's thread: the feed/reader side, never the
+    dataplane, the same discipline as a first estimate reader)."""
+    families = {}
+    for name, f in snap.families.items():
+        families[name] = {
+            "kind": f.kind,
+            "window_start": (None if f.window_start is None
+                             else int(f.window_start)),
+            "depth": int(f.depth),
+            "key_lanes": int(f.key_lanes),
+            "value_cols": list(f.value_cols),
+            "rows": {c: np.asarray(v) for c, v in f.rows.items()},
+            "cms": None if f.cms is None else np.asarray(f.cms.get()),
+        }
+    ranges = {
+        table: [[int(slot), {c: np.asarray(v) for c, v in rows.items()}]
+                for slot, rows in slots]
+        for table, slots in snap.ranges.items()
+    }
+    return {
+        "version": int(snap.version),
+        "created": float(snap.created),
+        "watermark": float(snap.watermark),
+        "flows_seen": (None if snap.flows_seen is None
+                       else int(snap.flows_seen)),
+        "source": snap.source,
+        "families": families,
+        "ranges": ranges,
+        "audit": dict(snap.audit),
+    }
+
+
+def state_to_snapshot(state: dict) -> Snapshot:
+    """Rebuild the immutable read view from a canonical state dict.
+    Arrays are used as-is (never copied): the reconstructed snapshot's
+    answers are bit-identical to the source's because they ARE the same
+    words."""
+    families = {}
+    for name, f in state["families"].items():
+        cms = f["cms"]
+        families[name] = FamilyView(
+            name=name, kind=f["kind"], window_start=f["window_start"],
+            depth=int(f["depth"]), rows=dict(f["rows"]),
+            key_lanes=int(f["key_lanes"]),
+            cms=None if cms is None else FrozenCms(value=cms),
+            value_cols=tuple(f["value_cols"]))
+    ranges = {table: tuple((int(slot), dict(rows))
+                           for slot, rows in slots)
+              for table, slots in state["ranges"].items()}
+    return Snapshot(
+        version=int(state["version"]), created=float(state["created"]),
+        watermark=float(state["watermark"]),
+        flows_seen=state["flows_seen"], source=state["source"],
+        families=families, ranges=ranges, audit=dict(state["audit"]))
+
+
+# ---- diff / apply ----------------------------------------------------------
+
+
+def _arrays_equal(a, b) -> bool:
+    a, b = np.asarray(a), np.asarray(b)
+    return a.dtype == b.dtype and a.shape == b.shape and \
+        bool(np.array_equal(a, b))
+
+
+def _rows_equal(a: dict, b: dict) -> bool:
+    if set(a) != set(b):
+        return False
+    return all(_arrays_equal(a[c], b[c]) for c in a)
+
+
+def _cms_diff(prev: np.ndarray,
+              cur: np.ndarray) -> Optional[tuple[list, list]]:
+    """Per-depth-row dirty coding: (sparse, tiles), or None when the
+    shapes/dtypes force a full-plane ship. A mostly-clean row ships
+    sparse ``[d, cols, vals]`` (``vals = cur[:, d, cols]`` — the
+    column slice across every plane: a bucket's counts and sums dirty
+    in lockstep); a dense row falls back to ``[d, w0, block]``
+    TILE_W-wide slabs."""
+    if prev.shape != cur.shape or prev.dtype != cur.dtype:
+        return None
+    sparse: list = []
+    tiles: list = []
+    depth, width = cur.shape[1], cur.shape[2]
+    for d in range(depth):
+        changed = (prev[:, d, :] != cur[:, d, :]).any(axis=0)
+        cols = np.flatnonzero(changed)
+        if cols.size == 0:
+            continue
+        if cols.size <= SPARSE_FRAC * width:
+            sparse.append([int(d), cols.astype(np.int64),
+                           np.ascontiguousarray(cur[:, d, cols])])
+            continue
+        for w0 in range(0, width, TILE_W):
+            if changed[w0:w0 + TILE_W].any():
+                tiles.append([int(d), int(w0), np.ascontiguousarray(
+                    cur[:, d, w0:w0 + TILE_W])])
+    return sparse, tiles
+
+
+def diff_states(prev: dict, cur: dict) -> dict:
+    """The delta tree from ``prev`` to ``cur`` (both canonical state
+    dicts). The family and range-table maps in the delta are COMPLETE
+    (their scalar metadata is tiny and carrying the full key set lets
+    apply drop removed entries without a tombstone protocol); the
+    arrays inside ship only where they changed."""
+    families = {}
+    for name, f in cur["families"].items():
+        pf = prev["families"].get(name)
+        entry = {
+            "kind": f["kind"], "window_start": f["window_start"],
+            "depth": f["depth"], "key_lanes": f["key_lanes"],
+            "value_cols": list(f["value_cols"]),
+        }
+        if pf is None or not _rows_equal(pf["rows"], f["rows"]):
+            entry["rows"] = f["rows"]
+        if f["cms"] is None:
+            if pf is None or pf["cms"] is not None:
+                entry["cms"] = None
+        elif pf is None or pf["cms"] is None:
+            entry["cms"] = f["cms"]
+        else:
+            diff = _cms_diff(pf["cms"], f["cms"])
+            if diff is None:
+                entry["cms"] = f["cms"]
+            else:
+                sparse, tiles = diff
+                if sparse:
+                    entry["cms_sparse"] = sparse
+                if tiles:
+                    entry["cms_tiles"] = tiles
+                # neither: apply carries pf["cms"] forward untouched
+        families[name] = entry
+    ranges = {}
+    for table, slots in cur["ranges"].items():
+        pslots = dict((int(s), rows)
+                      for s, rows in prev["ranges"].get(table, []))
+        chunks = {}
+        for slot, rows in slots:
+            old = pslots.get(int(slot))
+            if old is None or not _rows_equal(old, rows):
+                chunks[int(slot)] = rows
+        ranges[table] = {"slots": [int(s) for s, _ in slots],
+                         "chunks": chunks}
+    delta = {
+        "from": int(prev["version"]), "to": int(cur["version"]),
+        "created": cur["created"], "watermark": cur["watermark"],
+        "flows_seen": cur["flows_seen"], "source": cur["source"],
+        "families": families, "ranges": ranges,
+    }
+    if cur["audit"] != prev["audit"]:
+        delta["audit"] = cur["audit"]
+    return delta
+
+
+def apply_delta(prev: dict, delta: dict) -> dict:
+    """``prev`` + one delta tree -> the next canonical state dict.
+    Unchanged arrays are carried forward BY REFERENCE (states are
+    immutable once built — the same RCU discipline as the snapshots
+    they reconstruct). Raises :class:`DeltaGapError` on a chain hole."""
+    if int(delta["from"]) != int(prev["version"]):
+        raise DeltaGapError(
+            f"delta chains from v{delta['from']} but local state is "
+            f"v{prev['version']}")
+    families = {}
+    for name, entry in delta["families"].items():
+        pf = prev["families"].get(name)
+        rows = entry.get("rows")
+        if rows is None:
+            if pf is None:
+                raise DeltaError(
+                    f"delta introduces family {name!r} without rows")
+            rows = pf["rows"]
+        if "cms" in entry:
+            cms = entry["cms"]
+        elif "cms_tiles" in entry or "cms_sparse" in entry:
+            if pf is None or pf["cms"] is None:
+                raise DeltaError(
+                    f"delta patches CMS planes for {name!r} with no "
+                    "base planes")
+            cms = pf["cms"].copy()
+            for d, w0, block in entry.get("cms_tiles", ()):
+                d, w0 = int(d), int(w0)
+                cms[:, d, w0:w0 + block.shape[-1]] = block
+            for d, cols, vals in entry.get("cms_sparse", ()):
+                cms[:, int(d), np.asarray(cols, np.int64)] = vals
+        else:
+            cms = None if pf is None else pf["cms"]
+        families[name] = {
+            "kind": entry["kind"], "window_start": entry["window_start"],
+            "depth": int(entry["depth"]),
+            "key_lanes": int(entry["key_lanes"]),
+            "value_cols": list(entry["value_cols"]),
+            "rows": rows, "cms": cms,
+        }
+    ranges = {}
+    for table, spec in delta["ranges"].items():
+        pslots = dict((int(s), rows)
+                      for s, rows in prev["ranges"].get(table, []))
+        chunks = {int(s): rows for s, rows in spec["chunks"].items()}
+        out = []
+        for slot in spec["slots"]:
+            slot = int(slot)
+            rows = chunks.get(slot, pslots.get(slot))
+            if rows is None:
+                raise DeltaError(
+                    f"delta names range slot {table}:{slot} it neither "
+                    "ships nor the base holds")
+            out.append([slot, rows])
+        ranges[table] = out
+    return {
+        "version": int(delta["to"]), "created": float(delta["created"]),
+        "watermark": float(delta["watermark"]),
+        "flows_seen": delta["flows_seen"], "source": delta["source"],
+        "families": families, "ranges": ranges,
+        "audit": delta["audit"] if "audit" in delta else prev["audit"],
+    }
+
+
+# ---- frames ----------------------------------------------------------------
+
+
+def _frame(tree: dict) -> bytes:
+    body = codec.encode(tree)
+    return MAGIC + _HEAD.pack(len(body), zlib.crc32(body)) + body
+
+
+def encode_full(state: dict) -> bytes:
+    return _frame({"t": "full", "to": int(state["version"]),
+                   "state": state})
+
+
+def encode_delta(prev: dict, cur: dict) -> bytes:
+    return _frame({"t": "delta", **diff_states(prev, cur)})
+
+
+def encode_none(version: int) -> bytes:
+    """The "you are current" frame — a poll answer, so the subscriber
+    can tell an idle upstream from a dead one."""
+    return _frame({"t": "none", "to": int(version)})
+
+
+def decode_frames(data: bytes) -> Iterator[dict]:
+    """Yield every frame tree in ``data``. Raises :class:`DeltaError`
+    on a bad magic, torn header/body, or CRC mismatch — subscription
+    transports are expected to deliver whole responses, so any damage
+    means resync, not salvage."""
+    off = 0
+    while off < len(data):
+        if data[off:off + len(MAGIC)] != MAGIC:
+            raise DeltaError("bad frame magic")
+        off += len(MAGIC)
+        head = data[off:off + _HEAD.size]
+        if len(head) < _HEAD.size:
+            raise DeltaError("torn frame header")
+        body_len, crc = _HEAD.unpack(head)
+        off += _HEAD.size
+        body = data[off:off + body_len]
+        if len(body) < body_len:
+            raise DeltaError("torn frame body")
+        if zlib.crc32(body) != crc:
+            raise DeltaError("frame CRC mismatch")
+        off += body_len
+        yield codec.decode(body)

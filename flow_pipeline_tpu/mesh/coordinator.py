@@ -147,12 +147,17 @@ MESH_METRICS = {
                     "age of the oldest unfsynced journal record "
                     "(0 = clean; sustained > 0 means acks are running "
                     "ahead of durability)"),
+    "journal_bytes": ("mesh_journal_bytes",
+                      "coordinator WAL file size on disk — compaction "
+                      "(checkpoint + truncate at merged-window "
+                      "boundaries) is what keeps this bounded at "
+                      "production cadence"),
 }
 
 # Which MESH_METRICS keys register as what (everything else: counter).
 _MESH_GAUGES = frozenset(
     {"members", "epoch", "partitions", "commit_wm", "member_wm",
-     "wm_skew", "journal_unsynced", "journal_lag"})
+     "wm_skew", "journal_unsynced", "journal_lag", "journal_bytes"})
 _MESH_HISTOGRAMS = {
     "merge_s": MERGE_SECONDS_BUCKETS,
     "barrier_s": BARRIER_SECONDS_BUCKETS,
@@ -234,7 +239,8 @@ class MeshCoordinator:
                  sinks: Sequence[Any] = (),
                  heartbeat_timeout: float = 5.0,
                  time_fn: Callable[[], float] = time.monotonic,
-                 journal: Optional[str] = None):
+                 journal: Optional[str] = None,
+                 journal_compact_bytes: int = 64 << 20):
         self.specs = tuple(specs)
         self._by_name = {s.name: s for s in self.specs}
         self.n_partitions = int(n_partitions)
@@ -258,6 +264,12 @@ class MeshCoordinator:
         # replaced on every accepted submission, promoted on death
         self._carry: dict[str, dict] = {}  # guarded-by: _lock
         self._merged_keys: set[tuple[str, int]] = set()  # guarded-by: _lock
+        # windows popped off the barrier but not yet emitted+journaled:
+        # _pop_ready_locked marks a window merged BEFORE the lock-free
+        # merge/sink-emit runs, so a checkpoint taken in that gap would
+        # record it merged while its rows exist nowhere durable —
+        # compaction defers while this is non-empty
+        self._inflight_keys: set[tuple[str, int]] = set()  # guarded-by: _lock
         # (model, slot) -> [rows emitted] (late wagg partials append)
         self.merged: dict[tuple[str, int], list] = {}  # guarded-by: _merge_lock
         # meshscope lineage ledger: per (model, slot), who contributed
@@ -315,6 +327,12 @@ class MeshCoordinator:
         # ledger from them (mesh/journal.py states the contract).
         # flowlint: unguarded -- bound once here; the journal carries its own lock
         self._journal = None
+        # compaction trigger (r18): checkpoint + truncate once the WAL
+        # crosses this size, checked at merged-window boundaries (the
+        # point where carries/subs become provably superseded). 0
+        # disables the automatic trigger; compact_journal() stays
+        # callable either way.
+        self.journal_compact_bytes = int(journal_compact_bytes)
         if journal:
             from .journal import CoordinatorJournal
 
@@ -322,6 +340,7 @@ class MeshCoordinator:
                 "records": self._m["journal_records"],
                 "unsynced": self._m["journal_unsynced"],
                 "lag": self._m["journal_lag"],
+                "bytes": self._m["journal_bytes"],
             })
             with self._lock:
                 ready = self._recover_locked()
@@ -535,7 +554,12 @@ class MeshCoordinator:
         n = 0
         for kind, meta, blob in self._journal.replay():
             n += 1
-            if kind == "sub":
+            if kind == "chk":
+                # a compaction checkpoint: the full recoverable state
+                # at the moment of compaction — everything before it
+                # was folded in; later records replay on top
+                self._restore_checkpoint_locked(codec.decode(blob))
+            elif kind == "sub":
                 self._replay_submission_locked(meta["member"],
                                                codec.decode(blob))
             elif kind == "fence":
@@ -602,6 +626,70 @@ class MeshCoordinator:
         if payload.get("final"):
             for p in ranges:
                 self._final[p] = True  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+
+    def _checkpoint_state_locked(self) -> dict:
+        """The journal-compaction checkpoint: exactly the state
+        ``_recover_locked`` rebuilds by replay — the offset frontier,
+        watermarks, finality, epoch, the CURRENT carries (every
+        superseded carry envelope is dropped here: this is the 379
+        MB -> small lever), the pending barrier contributions, and the
+        merged-window keys late detection needs. Lineage/metrics are
+        deliberately NOT durable (same contract as uncompacted replay,
+        which never rebuilt them either)."""
+        return {
+            "v": 1,
+            "epoch": int(self.epoch),
+            "covered": [int(x) for x in self._covered],
+            "wm": [int(x) for x in self._wm],
+            "final": [bool(x) for x in self._final],
+            "carry": self._carry,
+            "pending": self._pending,
+            "merged_keys": sorted([list(k) for k in self._merged_keys]),
+        }
+
+    def _restore_checkpoint_locked(self, state: dict) -> None:
+        self._covered = [int(x) for x in state["covered"]]  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        self._wm = [int(x) for x in state["wm"]]  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        self._final = [bool(x) for x in state["final"]]  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        if int(state["epoch"]) > self.epoch:
+            self.epoch = int(state["epoch"])  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        self._carry = {m: dict(c) for m, c in state["carry"].items()}  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        self._pending = {(str(k[0]), int(k[1])): list(v)  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+                         for k, v in state["pending"].items()}
+        self._merged_keys = {(str(m), int(s))  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+                             for m, s in state["merged_keys"]}
+
+    def compact_journal(self) -> bool:
+        """Checkpoint + truncate the WAL NOW (r17's named follow-on).
+        Runs under the coordinator lock so no append can race into the
+        about-to-be-replaced file; the journal swap is atomic and
+        fsynced (mesh/journal.py states the crash-safety argument).
+        Returns whether a compaction ran — it DEFERS (False) while any
+        window is popped-but-unemitted on another submit thread: such a
+        window is already in ``_merged_keys`` but its rows are in no
+        sink and its ``merged`` record unwritten, so a checkpoint taken
+        now would truncate the ``sub`` records recovery needs to
+        re-merge it (the trigger simply fires again at the next merge
+        boundary)."""
+        if self._journal is None:
+            return False
+        with self._lock:
+            if self._inflight_keys:
+                return False
+            state = self._checkpoint_state_locked()
+            self._journal.compact({"epoch": int(self.epoch)},
+                                  codec.encode(state))
+        return True
+
+    def _maybe_compact(self) -> None:
+        """Merged-window-boundary compaction trigger: every superseded
+        carry/sub envelope up to this barrier is now dead weight, so
+        once the WAL crosses the size threshold, fold it into one
+        checkpoint record."""
+        if self._journal is None or self.journal_compact_bytes <= 0:
+            return
+        if self._journal.size_bytes() >= self.journal_compact_bytes:
+            self.compact_journal()
 
     def _replay_fence_locked(self, member: str) -> None:
         """One journaled fence, re-applied: promote the member's carry
@@ -932,6 +1020,7 @@ class MeshCoordinator:
                     lin["barrier_released"] = now
                 ready.append((name, slot, self._pending.pop(key), lin))
                 self._merged_keys.add(key)
+                self._inflight_keys.add(key)
         return ready
 
     # ---- merging ----------------------------------------------------------
@@ -958,6 +1047,13 @@ class MeshCoordinator:
                 # as the worker's flush -> snapshot gap
                 self._journal.append("merged", {"model": name,
                                                 "slot": int(slot)})
+            # only now is the window safe to checkpoint as merged: its
+            # rows are in the sinks and (if journaling) its "merged"
+            # record is appended. A merge that raises leaves the key
+            # in-flight — compaction stays deferred, preserving the
+            # uncompacted journal's recovery exactly
+            with self._lock:
+                self._inflight_keys.discard((name, slot))
             n_rows = self._count_rows(rows)
             TRACER.record("mesh_emit", t_merged, t_emitted, model=name,
                           slot=slot, rows=n_rows)
@@ -1015,6 +1111,7 @@ class MeshCoordinator:
                      name, slot, len(payloads))
         if ready and self._journal is not None:
             self._journal.sync()
+            self._maybe_compact()
         if ready and self.serve is not None:
             # wake the flowserve publisher (no lock held here); the
             # fan-out/extract runs on ITS thread, never the submitter's

@@ -56,10 +56,11 @@ _REASONS = {200: "OK", 304: "Not Modified", 400: "Bad Request",
 
 
 def _http_response(code: int, body: bytes = b"",
-                   etag: str | None = None) -> bytes:
+                   etag: str | None = None,
+                   ctype: str = "application/json") -> bytes:
     """One fully assembled HTTP/1.1 response (single sendall)."""
     head = [f"HTTP/1.1 {code} {_REASONS.get(code, 'OK')}",
-            "Content-Type: application/json",
+            f"Content-Type: {ctype}",
             f"Content-Length: {len(body)}"]
     if etag:
         head.append(f"ETag: {etag}")
@@ -74,6 +75,10 @@ class _ServeHandler(socketserver.BaseRequestHandler):
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.settimeout(60.0)
+        with outer._conns_lock:
+            if outer._stopping:
+                return  # raced a stop(): don't serve from a dead replica
+            outer._conns.add(sock)
         rfile = sock.makefile("rb", buffering=65536)
         try:
             while True:
@@ -109,6 +114,8 @@ class _ServeHandler(socketserver.BaseRequestHandler):
         except OSError:
             return  # client went away mid-request; nothing to salvage
         finally:
+            with outer._conns_lock:
+                outer._conns.discard(sock)
             try:
                 rfile.close()
             except OSError:
@@ -134,6 +141,21 @@ class ServeServer:
         # urlparse/parse_qs entirely (same version discipline; distinct
         # spellings of one normalized query just spend alias slots)
         self._alias: dict = {}  # guarded-by: _cache_lock
+        # flowgate subscription feed (gateway/feed.py): built lazily on
+        # the first /sub/snapshot poll, so a plain serve deployment
+        # never allocates it. Lock-free lazy init is fine: feeds over
+        # one store are interchangeable (worst case two subscribers
+        # race one redundant construction).
+        # flowlint: unguarded -- idempotent lazy bind (any winner is equivalent); read-mostly after
+        self._feed = None
+        # live keep-alive connections: stop() must actually sever them
+        # — a "stopped" replica whose established connections keep
+        # answering is a zombie serving an ever-staler snapshot, which
+        # is exactly what the flowgate replica-kill story must not do
+        # flowlint: unguarded -- the lock itself; bound once
+        self._conns_lock = threading.Lock()
+        self._conns: set = set()  # guarded-by: _conns_lock
+        self._stopping = False  # guarded-by: _conns_lock
         self._server = _Server((host, port), _ServeHandler)
         self._server.outer = self
         self.host = host
@@ -178,13 +200,12 @@ class ServeServer:
                 return _http_response(200, json.dumps(
                     {"ok": True,
                      "version": snap.version if snap else 0}).encode())
-            handler = {
-                "/query/version": self._version,
-                "/query/topk": self._topk,
-                "/query/estimate": self._estimate,
-                "/query/range": self._range,
-                "/query/audit": self._audit,
-            }.get(endpoint)
+            if endpoint == "/sub/snapshot":
+                # flowgate subscription poll: binary frames, never the
+                # JSON cache (since= changes every poll; the feed
+                # memoizes per version on its own)
+                return self._sub_snapshot(url)
+            handler = self._handler_for(endpoint)
             if handler is None:
                 return _http_response(404, json.dumps(
                     {"error": f"unknown path {endpoint}"}).encode())
@@ -213,6 +234,54 @@ class ServeServer:
         finally:
             self.store.observe_query(
                 endpoint, time.perf_counter() - t0, snap)
+
+    def _handler_for(self, endpoint: str):
+        return {
+            "/query/version": self._version,
+            "/query/topk": self._topk,
+            "/query/estimate": self._estimate,
+            "/query/range": self._range,
+            "/query/audit": self._audit,
+        }.get(endpoint)
+
+    # ---- flowgate subscription + pre-render --------------------------------
+
+    def _sub_snapshot(self, url) -> bytes:
+        if self._feed is None:
+            from ..gateway.feed import SnapshotFeed
+
+            self._feed = SnapshotFeed(self.store)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        _, _, frames = self._feed.frame_since(int(q.get("since", 0)))
+        return _http_response(200, frames,
+                              ctype="application/octet-stream")
+
+    def warm(self, targets) -> int:
+        """Pre-render responses for ``targets`` into the (version,
+        query) cache against the CURRENT snapshot — the flowgate
+        tail-latency lever: the gateway calls this the moment a
+        mirrored snapshot lands, so the hot query set is a dict lookup
+        before any reader asks. Returns how many targets rendered
+        (unknown paths and handler errors are skipped — warming is an
+        optimization, never a failure source)."""
+        snap = self.store.current
+        if snap is None:
+            return 0
+        n = 0
+        for target in targets:
+            url = urlparse(target)
+            handler = self._handler_for(url.path)
+            if handler is None or url.path == "/query/version":
+                continue  # version is live by definition — not cached
+            try:
+                q = {k: v[0] for k, v in parse_qs(url.query).items()}
+                key = (url.path, tuple(sorted(q.items())))
+                self._cached(snap, key, lambda: handler(snap, q), target)
+                n += 1
+            except Exception:  # noqa: BLE001 -- a bad warm target must not take down the mirror thread
+                log.debug("flowserve warm failed for %s", target,
+                          exc_info=True)
+        return n
 
     # ---- response cache ----------------------------------------------------
 
@@ -388,3 +457,15 @@ class ServeServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        with self._conns_lock:
+            self._stopping = True
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass  # already gone
+            try:
+                sock.close()
+            except OSError:
+                pass

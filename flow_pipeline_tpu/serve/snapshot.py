@@ -275,6 +275,25 @@ class SnapshotStore:
         self.m_age.set(0.0)
         return snap
 
+    def publish_snapshot(self, snap: Snapshot) -> Optional[Snapshot]:
+        """Publish an ALREADY-BUILT immutable snapshot, preserving its
+        version — the flowgate mirror path (the gateway reconstructs
+        the upstream's snapshot and must serve it under the upstream's
+        version so gateway answers compare at "the same version").
+        Versions are MONOTONE by construction: a snapshot at or behind
+        the current one is refused (returns None) — a flapping upstream
+        or replayed response can never move a reader backwards."""
+        with self._pub_lock:
+            prev = self._current
+            if prev is not None and snap.version <= prev.version:
+                return None
+            self._current = snap  # the RCU publish: one reference swap
+        self.m_published.inc()
+        self.m_version.set(snap.version)
+        self.m_timestamp.set(snap.created)
+        self.m_age.set(snap.age())
+        return snap
+
     def observe_query(self, endpoint: str, seconds: float,
                       snap: Optional[Snapshot]) -> None:
         """Per-request metrics hook (the serve server calls it after the

@@ -16,6 +16,8 @@ import threading
 from dataclasses import dataclass
 from typing import Iterable, Optional
 
+from ..utils.faults import FAULTS
+
 
 @dataclass(frozen=True)
 class BusMessage:
@@ -50,6 +52,8 @@ class InProcessBus:
         """Append one message. Without an explicit partition, round-robin —
         the reference's keyless async produce does the same
         (ref: mocker/mocker.go:103-106)."""
+        if FAULTS.active:  # flowchaos seam: collector-side produce
+            FAULTS.check("bus.produce")
         with self._lock:
             if topic not in self._topics:
                 self.create_topic(topic)
@@ -69,6 +73,8 @@ class InProcessBus:
         """Bulk append under ONE lock acquisition. With no explicit
         partition the values round-robin across partitions in order,
         continuing the same counter single-message produce uses."""
+        if FAULTS.active:  # flowchaos seam: collector-side produce
+            FAULTS.check("bus.produce")
         values = list(values)
         with self._lock:
             if topic not in self._topics:
@@ -86,6 +92,8 @@ class InProcessBus:
 
     def fetch(self, topic: str, partition: int, offset: int,
               max_messages: int = 1024) -> list[BusMessage]:
+        if FAULTS.active:  # flowchaos seam: consumer-side poll
+            FAULTS.check("bus.poll")
         with self._lock:
             log = self._topics[topic][partition]
             end = min(len(log), offset + max_messages)
@@ -103,6 +111,8 @@ class InProcessBus:
         wants exactly the concatenation, so materializing one BusMessage
         per flow — the dominant consume-side cost at high rates — is pure
         waste. Per-message consumers keep using fetch()."""
+        if FAULTS.active:  # flowchaos seam: consumer-side poll
+            FAULTS.check("bus.poll")
         with self._lock:
             log = self._topics[topic][partition]
             end = min(len(log), offset + max_messages)

@@ -36,7 +36,7 @@ so a chaos leg is a reproducible test, not a flake generator:
 Known sites (kept in :data:`KNOWN_SITES` so a typo'd plan fails loudly
 instead of silently injecting nothing): ``sink.write``,
 ``mesh.submit``, ``mesh.sync``, ``kafka.send``, ``kafka.poll``,
-``serve.publish``.
+``serve.publish``, ``bus.produce``, ``bus.poll``, ``gateway.poll``.
 """
 
 from __future__ import annotations
@@ -57,6 +57,10 @@ from ..obs import REGISTRY
 KNOWN_SITES = frozenset({
     "sink.write", "mesh.submit", "mesh.sync", "kafka.send", "kafka.poll",
     "serve.publish",
+    # r18: the in-process bus (collector-side chaos — the produce path
+    # a collector/mocker rides and the fetch path every consumer rides)
+    # and the flowgate subscription poll
+    "bus.produce", "bus.poll", "gateway.poll",
 })
 
 

@@ -47,6 +47,8 @@ KNOWN_FLAGS = frozenset({
     "replay.dir", "replay.delete",
     # flowserve (serve/)
     "serve.addr", "serve.refresh",
+    # flowgate (gateway/)
+    "gateway.listen", "gateway.upstream", "gateway.poll",
     # flowmesh (mesh/)
     "mesh.workers", "mesh.role", "mesh.coordinator", "mesh.id",
     "mesh.listen", "mesh.heartbeat", "mesh.journal",

@@ -1041,3 +1041,262 @@ def test_serve_zero_5xx_under_publish_faults():
     assert codes and all(c < 500 for c in codes), codes
     assert versions == sorted(versions)  # monotone under failures
     assert pub.store.m_publish_failures.value() > 0
+
+
+# ---------------------------------------------------------------------------
+# r18 satellites: journal compaction + in-process-bus / gateway fault seams
+# ---------------------------------------------------------------------------
+
+
+class TestJournalCompaction:
+    """r17's named follow-on: at merged-window boundaries the WAL drops
+    superseded carry envelopes and checkpoints+truncates. The gate is
+    bit-exactness: recovery from a compacted journal must equal
+    recovery from the uncompacted replay — same frontier, same pending
+    barrier, same carries, same merged-window keys, same sink rows."""
+
+    def _drive(self, path, compact=False, sink=None):
+        c = MeshCoordinator([_wagg_spec()], 1,
+                            sinks=[sink] if sink else (),
+                            journal=path)
+        c.join("m")
+        c.sync("m")
+        c.submit("m", codec.encode(_contrib(
+            {0: [0, 10]}, wm=900, closed={300: _wagg_win(7, 50)})))
+        c.submit("m", codec.encode(_contrib(
+            {0: [10, 20]}, wm=950, open_={600: _wagg_win(3, 9)})))
+        c.submit("m", codec.encode(_contrib(
+            {0: [20, 25]}, wm=980, closed={600: _wagg_win(3, 2)},
+            open_={900: _wagg_win(4, 5)})))
+        if compact:
+            assert c.compact_journal()
+        c.close()
+        return c
+
+    @staticmethod
+    def _protocol_state(c):
+        st = c.status()
+        return {k: st[k] for k in ("covered", "watermarks", "final",
+                                   "pending_windows")}
+
+    def test_recovery_after_compaction_bit_exact_vs_uncompacted(
+            self, tmp_path):
+        self._drive(str(tmp_path / "a"), compact=True)
+        self._drive(str(tmp_path / "b"), compact=False)
+        sa, sb = ListSink(), ListSink()
+        ra = MeshCoordinator([_wagg_spec()], 1, sinks=[sa],
+                             journal=str(tmp_path / "a"))
+        rb = MeshCoordinator([_wagg_spec()], 1, sinks=[sb],
+                             journal=str(tmp_path / "b"))
+        assert self._protocol_state(ra) == self._protocol_state(rb)
+        assert ra._merged_keys == rb._merged_keys
+        assert sorted(ra._carry) == sorted(rb._carry)
+        # drive both recovered coordinators to completion identically:
+        # the pending window and the recovered carries must merge to
+        # bit-identical sink rows
+        for c in (ra, rb):
+            c.join("n")
+            c.sync("n")
+            c.submit("n", codec.encode(_contrib(
+                {0: [25, 30]}, wm=2000, closed={900: _wagg_win(4, 1)},
+                final=True)))
+        assert set(sa.tables) == set(sb.tables) and sa.tables
+        for table in sa.tables:
+            wa = [{k: np.asarray(v).tolist() for k, v in r.items()}
+                  for r in sa.tables[table]]
+            wb = [{k: np.asarray(v).tolist() for k, v in r.items()}
+                  for r in sb.tables[table]]
+            assert wa == wb
+
+    def test_compaction_drops_superseded_envelopes(self, tmp_path):
+        """The 379MB-for-35-records lever: after compaction the file
+        holds ONE chk record (+ later appends), and its size is a
+        fraction of the replaced history's."""
+        c = self._drive(str(tmp_path / "wal"), compact=False)
+        big = c._journal.size_bytes()
+        c2 = MeshCoordinator([_wagg_spec()], 1,
+                             journal=str(tmp_path / "wal"))
+        pre = c2._journal.size_bytes()
+        assert c2.compact_journal()
+        post = c2._journal.size_bytes()
+        assert post < pre and post < big
+        kinds = [k for k, _, _ in replay_journal(
+            str(tmp_path / "wal" / "coordinator.journal"))]
+        assert kinds[0] == "chk"
+        # recovery fences journaled during c2's own recovery are gone:
+        # the checkpoint absorbed them
+        assert "sub" not in kinds
+        c2.close()
+
+    def test_compaction_defers_while_a_merge_is_in_flight(self, tmp_path):
+        """The checkpoint races the lock-free merge path: a window
+        popped off the barrier is in _merged_keys BEFORE its rows reach
+        any sink or its "merged" record the WAL. A checkpoint taken in
+        that gap would record it merged while truncating the sub
+        records recovery needs to re-merge it — a crash then loses the
+        window silently. compact_journal() must defer until the merge
+        lands (the size trigger simply fires at the next boundary)."""
+        gate_enter, gate_release = threading.Event(), threading.Event()
+
+        class GateSink:
+            def __init__(self):
+                self.tables = {}
+
+            def write(self, table, rows):
+                gate_enter.set()
+                assert gate_release.wait(10)
+                self.tables.setdefault(table, []).append(rows)
+
+        sink = GateSink()
+        c = MeshCoordinator([_wagg_spec()], 1, sinks=[sink],
+                            journal=str(tmp_path / "wal"))
+        c.join("m")
+        c.sync("m")
+        t = threading.Thread(target=c.submit, args=("m", codec.encode(
+            _contrib({0: [0, 10]}, wm=900,
+                     closed={300: _wagg_win(7, 50)}))))
+        t.start()
+        assert gate_enter.wait(10)  # popped off the barrier, mid-emit
+        try:
+            assert not c.compact_journal()  # deferred: merge in flight
+        finally:
+            gate_release.set()
+            t.join(10)
+        assert c.compact_journal()  # landed -> checkpoint is safe now
+        c.close()
+        # the deferral lost nothing: recovery from the checkpoint still
+        # knows the window merged (its rows reached the sink first)
+        r = MeshCoordinator([_wagg_spec()], 1,
+                            journal=str(tmp_path / "wal"))
+        assert ("flows_5m", 300) in r._merged_keys
+        r.close()
+
+    def test_records_after_checkpoint_replay_on_top(self, tmp_path):
+        sink = ListSink()
+        c = self._drive(str(tmp_path / "wal"), compact=True, sink=sink)
+        # reopen, accept MORE submissions after the checkpoint
+        c2 = MeshCoordinator([_wagg_spec()], 1,
+                             journal=str(tmp_path / "wal"))
+        c2.join("n")
+        c2.sync("n")
+        c2.submit("n", codec.encode(_contrib(
+            {0: [25, 40]}, wm=1000, open_={900: _wagg_win(4, 6)})))
+        c2.close()
+        # crash again: chk + post-checkpoint subs both replay
+        c3 = MeshCoordinator([_wagg_spec()], 1,
+                             journal=str(tmp_path / "wal"))
+        assert c3.status()["covered"] == [40]
+        # both incarnations' carries were promoted into pending
+        assert "flows_5m:900" in c3.status()["pending_windows"]
+
+    def test_mesh_journal_bytes_gauge_tracks_the_file(self, tmp_path):
+        c = MeshCoordinator([_wagg_spec()], 1,
+                            journal=str(tmp_path / "wal"))
+        g0 = c._m["journal_bytes"].value()
+        assert g0 > 0  # magic written eagerly
+        c.join("m")
+        c.sync("m")
+        c.submit("m", codec.encode(_contrib(
+            {0: [0, 5]}, wm=100, open_={300: _wagg_win(1, 1)})))
+        grown = c._m["journal_bytes"].value()
+        assert grown > g0
+        chk0 = c._m["journal_records"].value(kind="chk")
+        assert c.compact_journal()
+        # the gauge is the file: flush + compare against the on-disk
+        # truth (a tiny history can legitimately checkpoint BIGGER —
+        # the shrink claim lives in test_compaction_drops_superseded_
+        # envelopes where the history dominates)
+        c._journal.sync()
+        assert c._m["journal_bytes"].value() == os.path.getsize(
+            str(tmp_path / "wal" / "coordinator.journal"))
+        # DELTA, not absolute: the counter is process-global (the r17
+        # wait-condition lesson, re-applied)
+        assert c._m["journal_records"].value(kind="chk") == chk0 + 1.0
+        c.close()
+
+    def test_auto_compaction_at_merged_window_boundary(self, tmp_path):
+        """The trigger rides _run_merges: once the WAL crosses
+        journal_compact_bytes, the next merged-window boundary
+        compacts without anyone calling compact_journal()."""
+        c = MeshCoordinator([_wagg_spec()], 1,
+                            journal=str(tmp_path / "wal"),
+                            journal_compact_bytes=1)  # always over
+        c.join("m")
+        c.sync("m")
+        # wm past the barrier: merges (and therefore compacts) NOW
+        c.submit("m", codec.encode(_contrib(
+            {0: [0, 10]}, wm=900, closed={300: _wagg_win(7, 50)})))
+        kinds = [k for k, _, _ in replay_journal(
+            str(tmp_path / "wal" / "coordinator.journal"))]
+        assert "chk" in kinds
+        # recovery still lands on the merged state (nothing re-emits)
+        s2 = ListSink()
+        c2 = MeshCoordinator([_wagg_spec()], 1, sinks=[s2],
+                             journal=str(tmp_path / "wal"))
+        assert "flows_5m" not in s2.tables  # merged pre-crash: no re-emit
+        assert c2.status()["covered"] == [10]
+        c.close()
+        c2.close()
+
+
+class TestBusAndGatewayFaultSeams:
+    """r17's other named follow-on: collector-side chaos is now
+    expressible — the in-process bus produce/poll paths and the
+    flowgate subscription poll consult the fault plan."""
+
+    def test_new_sites_are_known(self):
+        sites, _ = parse_plan(
+            "bus.produce:p=0.1;bus.poll:p=0.1;gateway.poll:p=0.1")
+        assert set(sites) == {"bus.produce", "bus.poll", "gateway.poll"}
+
+    def test_unknown_site_still_rejected(self):
+        with pytest.raises(ValueError):
+            parse_plan("bus.nope:p=0.1")
+
+    def test_bus_produce_seam_fires(self):
+        bus = InProcessBus()
+        bus.create_topic("t", 1)
+        FAULTS.configure("bus.produce:p=1@seed=3")
+        with pytest.raises(OSError):
+            bus.produce("t", b"x")
+        with pytest.raises(OSError):
+            bus.produce_many("t", [b"x", b"y"])
+        FAULTS.configure(None)
+        bus.produce("t", b"x")
+        assert FAULTS.active is False
+
+    def test_bus_poll_seam_fires(self):
+        bus = InProcessBus()
+        bus.create_topic("t", 1)
+        bus.produce("t", b"x")
+        FAULTS.configure("bus.poll:p=1@seed=3")
+        with pytest.raises(OSError):
+            bus.fetch("t", 0, 0)
+        with pytest.raises(OSError):
+            bus.fetch_span("t", 0, 0)
+        FAULTS.configure(None)
+        assert len(bus.fetch("t", 0, 0)) == 1
+
+    def test_off_mode_bus_cost_is_one_attribute_read(self):
+        bus = InProcessBus()
+        bus.create_topic("t", 1)
+        FAULTS.configure(None)
+        bus.produce("t", b"x")  # no roll consumed
+        assert FAULTS.snapshot() == {}
+
+    def test_gateway_poll_seam_drives_the_real_failure_path(self):
+        """The injected gateway.poll fault rides the SAME OSError path
+        a dead upstream does: the mirror keeps its snapshot and
+        recovers when the plan disarms (tests/test_gateway.py has the
+        serving-side chaos leg)."""
+        from flow_pipeline_tpu.gateway import SnapshotGateway
+        from flow_pipeline_tpu.serve import SnapshotStore
+
+        store = SnapshotStore()
+        gw = SnapshotGateway([store], poll=60)
+        FAULTS.configure("gateway.poll:p=1@seed=1")
+        with pytest.raises(OSError):
+            gw.sync_once()
+        assert FAULTS.snapshot()["gateway.poll"]["injected"] >= 1
+        FAULTS.configure(None)
+        assert gw.sync_once() == "none"  # empty upstream, healthy poll

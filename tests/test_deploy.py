@@ -327,6 +327,49 @@ class TestGrafana:
         assert "serve_snapshot_age_seconds" in exprs
         assert "serve_snapshots_published_total" in exprs
 
+    def test_pipeline_dashboard_gateway_panels(self):
+        """Round-18 flowgate panels: subscription sync rate/bytes by
+        coding kind with the resync rate (a climbing resync rate means
+        the delta chain keeps breaking), and mirror freshness (upstream
+        version minus served version) next to the pre-render rate and
+        poll health."""
+        with open(os.path.join(DEPLOY, "grafana", "dashboards",
+                               "pipeline.json")) as f:
+            dash = json.load(f)
+        panels = {p["title"]: p for p in dash["panels"]}
+        sub = panels["Gateway subscription (delta vs full rate, "
+                     "resyncs)"]
+        exprs = " ".join(t["expr"] for t in sub["targets"])
+        assert "gateway_syncs_total" in exprs
+        assert "gateway_sync_bytes_total" in exprs
+        assert "gateway_resyncs_total" in exprs
+        fresh = panels["Gateway freshness (mirror lag, pre-render, "
+                       "poll health)"]
+        exprs = " ".join(t["expr"] for t in fresh["targets"])
+        assert "gateway_upstream_version" in exprs
+        assert "serve_snapshot_version" in exprs
+        assert "gateway_prerendered_total" in exprs
+        assert "gateway_poll_failures_total" in exprs
+
+    def test_mesh_topology_gateway_tier(self):
+        """Round-18 flowgate compose: two stateless gateway replicas
+        front the coordinator's snapshot stream (the '2 gateways over
+        the 4-worker mesh' read-tier topology), each with a real
+        /healthz healthcheck."""
+        doc = load("compose/mesh.yml")
+        services = doc["services"]
+        gateways = [n for n in services if n.startswith("gateway-")]
+        assert len(gateways) == 2
+        for g in gateways:
+            svc = services[g]
+            cmd = svc["command"]
+            assert "flowtpu-gateway" in cmd
+            assert "-gateway.upstream coordinator:8083" in cmd
+            assert "-gateway.listen" in cmd
+            assert svc.get("restart") == "always"
+            hc = svc["healthcheck"]["test"]
+            assert "8084/healthz" in " ".join(hc), g
+
     def test_pipeline_dashboard_sketchwatch_panels(self):
         """Round-15 sketchwatch panels: the sampled-audit error ratio
         off the aggregable le buckets, CMS fill / table occupancy and
@@ -417,6 +460,8 @@ class TestDashboardHonesty:
 
     PROM_FUNCS = {"rate", "irate", "sum", "avg", "max", "min", "increase",
                   "by", "histogram_quantile", "time", "le",
+                  # scrape-level label (vector-match key in alert exprs)
+                  "instance",
                   # binary-op/matching keywords (alert exprs)
                   "and", "or", "unless", "on", "ignoring"}
     SQL_KEYWORDS = {"select", "from", "where", "group", "by", "order",
@@ -464,6 +509,7 @@ class TestDashboardHonesty:
 
         from flow_pipeline_tpu.engine import Supervisor
 
+        from flow_pipeline_tpu.gateway import SnapshotGateway
         from flow_pipeline_tpu.mesh import MeshCoordinator, MeshMember
         from flow_pipeline_tpu.serve import SnapshotStore
         from flow_pipeline_tpu.sink import MemorySink, ResilientSink
@@ -477,6 +523,7 @@ class TestDashboardHonesty:
         MeshCoordinator([], 2)  # mesh_* families (incl. journal_*)
         MeshMember("honesty", None, None, None)  # mesh_member_retries
         SnapshotStore()  # serve_* families (eager registration)
+        SnapshotGateway([SnapshotStore()])  # gateway_* families
         ResilientSink(MemorySink())  # sink retry/dead-letter families
         assert _faults.FAULTS.m_injected is not None  # faults_injected
         names = set(reg._metrics) | set(REGISTRY._metrics)
