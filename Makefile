@@ -63,10 +63,16 @@ invertible-parity:
 # the staged group->sketch path, run against a FRESHLY BUILT library —
 # one C pass (group + cascade + sketch) must reproduce the staged
 # pipeline's flows_5m rows, CMS counters and top-K tables exactly
-# (docs/ARCHITECTURE.md "fused dataplane" states the contract).
+# (docs/ARCHITECTURE.md "fused dataplane" states the contract). Includes
+# the r19 flowspeed thread-sweep leg (TestThreadDeterminism: every
+# kernel bit-identical at threads {1,2,8}, table AND invertible, fused
+# AND staged) and the native lane-builder twins (TestLaneBuilders vs
+# the numpy fallback) — docs/ARCHITECTURE.md "flowspeed".
 fused-parity:
 	$(MAKE) -C native
-	JAX_PLATFORMS=cpu python -m pytest tests/test_fusedplane.py -v
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fusedplane.py \
+		"tests/test_hostfused.py::TestLaneBuilders" \
+		"tests/test_driver_seam.py::test_bench_fused_staging" -v
 
 # Oracle-exactness of the flowmesh (mesh/): N in {1,2,4} in-process
 # meshes vs a single-worker oracle over the identical key-hash-sharded

@@ -152,6 +152,49 @@ def _build_models(vals):
             models["flows_5m"] = ShardedWindowAggregator(cfg, mesh)
         else:
             models["flows_5m"] = WindowAggregator(cfg)
+    # -hh.sketch=auto (the r19 default): CASCADE families — those whose
+    # key set is a strict subset of another enabled hh family's (the
+    # exact condition engine/hostfused.py _fam_plan regroups on; cli hh
+    # families share value/scale columns by construction) — default to
+    # the invertible sketch: their decode sets are small (a src/dst-IP
+    # family groups 3-4x under its 5-tuple parent, far below the
+    # depth*width peel budget) and the admission machinery they'd
+    # otherwise pay is pure hot-path cost (BENCH_r16: 67% of host_fused
+    # on the table leg, 0% invertible). ROOT families keep the table
+    # sketch. The flip engages only where the invertible family can
+    # actually serve: the host sketch dataplane, no device mesh —
+    # elsewhere auto means table, so a default worker never degrades to
+    # the per-model numpy path. -hh.sketch=table|invertible overrides
+    # every family, exactly as before.
+    hh_families = []
+    if vals["model.talkers"]:
+        hh_families.append(("top_talkers",
+                            ("src_addr", "dst_addr", "src_port",
+                             "dst_port", "proto")))
+    if vals["model.ips"]:
+        hh_families.append(("top_src_ips", ("src_addr",)))
+        hh_families.append(("top_dst_ips", ("dst_addr",)))
+
+    def resolve_hh_sketch(key_cols) -> str:
+        mode = vals.get("hh.sketch", "auto")
+        if mode != "auto":
+            return mode
+        if mesh or vals.get("sketch.backend", "device") != "host":
+            return "table"
+        if not vals.get("processor.fused", True):
+            # -processor.fused=false skips pipeline construction
+            # entirely: an invertible family would land on the slow
+            # per-model numpy path, exactly what auto must never choose
+            return "table"
+        from .engine.hostfused import HostGroupPipeline
+
+        if not HostGroupPipeline.eligible(
+                vals.get("processor.hostassist", "auto")):
+            return "table"  # no host pipeline -> nothing to serve it
+        cascade = any(set(key_cols) < set(other)
+                      for _, other in hh_families)
+        return "invertible" if cascade else "table"
+
     def windowed_hh(key_cols):
         cfg = HeavyHitterConfig(
             key_cols=key_cols,
@@ -161,7 +204,7 @@ def _build_models(vals):
             cms_impl=vals["sketch.cms"],
             table_prefilter=vals["sketch.prefilter"],
             table_admission=vals["sketch.admission"],
-            hh_sketch=vals.get("hh.sketch", "table"),
+            hh_sketch=resolve_hh_sketch(key_cols),
         )
         if mesh:
             if cfg.hh_sketch == "invertible":
@@ -180,15 +223,12 @@ def _build_models(vals):
                                        mesh=mesh)
         return WindowedHeavyHitter(cfg, k=vals["sketch.topk"])
 
-    if vals["model.talkers"]:
-        models["top_talkers"] = windowed_hh(
-            ("src_addr", "dst_addr", "src_port", "dst_port", "proto")
-        )
-    if vals["model.ips"]:
-        # Top src/dst IP tables (ref: viz.json "Top source/destination
-        # IPs"); per-address windowed HH, one per direction.
-        models["top_src_ips"] = windowed_hh(("src_addr",))
-        models["top_dst_ips"] = windowed_hh(("dst_addr",))
+    # top_talkers (5-tuple) + top src/dst IP tables (ref: viz.json "Top
+    # source/destination IPs"; per-address windowed HH, one per
+    # direction) — the set collected above so auto sketch resolution
+    # sees every family before any is built.
+    for name, key_cols in hh_families:
+        models[name] = windowed_hh(key_cols)
     if vals["model.ports"]:
         # Top src/dst port tables (ref: viz.json top port panels). The
         # 2^16 port space fits a dense EXACT accumulator — one segment
@@ -243,14 +283,19 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
               "Sketch step executor: device (jitted CMS/top-K apply) | "
               "host (native threaded uint64 engine; needs the "
               "host-grouped pipeline)")
-    fs.string("hh.sketch", "table",
-              "Heavy-hitter sketch family: table (CMS + top-K admission "
-              "table — prefilter, admission CMS queries, table merge) | "
-              "invertible (linear key-recovery sketch: no admission "
-              "machinery on the hot path, heavy keys decoded from the "
-              "sketch at window close, mesh merge a plain u64 sum; "
-              "ignores -sketch.prefilter/-sketch.admission and forces "
-              "the plain CMS update; wants -sketch.backend=host)")
+    fs.string("hh.sketch", "auto",
+              "Heavy-hitter sketch family: auto (cascade families — "
+              "key sets that are strict subsets of another hh family's "
+              "— run invertible when the host sketch dataplane serves "
+              "and no device mesh is configured; root families and "
+              "every other deployment keep table) | table (CMS + top-K "
+              "admission table — prefilter, admission CMS queries, "
+              "table merge) | invertible (linear key-recovery sketch: "
+              "no admission machinery on the hot path, heavy keys "
+              "decoded from the sketch at window close, mesh merge a "
+              "plain u64 sum; ignores -sketch.prefilter/-sketch."
+              "admission and forces the plain CMS update; wants "
+              "-sketch.backend=host)")
     fs.string("sketch.admission", "est",
               "Top-K table admission: est (space-saving, CMS-seeded) | "
               "plain (batch-sum merge; benchmarking A/B only)")
@@ -271,6 +316,11 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.integer("ingest.depth", 2, "Prepared batches held ahead of the "
                                   "device step")
     fs.integer("ingest.flush_queue", 8, "Max queued background flush jobs")
+    fs.integer("ingest.threads", 0,
+               "Worker threads inside the native dataplane kernels "
+               "(fused pass, sketch engine, lane building, wagg fold); "
+               "deterministic at any count — 0 keeps the conservative "
+               "auto count (half the cores, capped at 4)")
     fs.boolean("ingest.native_group", True,
                "Group with the native radix kernel (libflowdecode); "
                "falls back to numpy when unbuilt")
@@ -492,6 +542,7 @@ def _worker_config(vals) -> "WorkerConfig":
         ingest_shards=vals["ingest.shards"],
         ingest_depth=vals["ingest.depth"],
         ingest_flush_queue=vals["ingest.flush_queue"],
+        ingest_threads=vals["ingest.threads"],
         ingest_native_group=vals["ingest.native_group"],
         ingest_fused=vals["ingest.fused"],
         obs_audit=vals["obs.audit"],

@@ -379,12 +379,34 @@ class HostGroupPipeline(FusedPipeline):
                                     native=self._native)
 
     def _wagg_rows(self, m, cols: dict, n: int):
-        cfg = m.config
+        lanes, planes = self._build_wagg_inputs(m.config, cols, n)
+        return self._group_exact_planes(lanes, planes)
+
+    # ---- lane building seams (r19 flowspeed) -------------------------------
+    #
+    # The three lane layouts the prepare half extracts from decoded
+    # columns, behind override points so the hostsketch pipeline can
+    # route them through the native ff_build_lanes / ff_build_planes
+    # kernels. These numpy bodies are the bit-exact twins AND the
+    # fallback when the library predates the lane builders — parity is
+    # pinned by tests/test_hostfused.py TestLaneBuilders.
+
+    def _build_key_lanes(self, cols: dict, key_cols) -> np.ndarray:
+        return _key_lanes_into(cols, key_cols)
+
+    def _build_value_planes(self, cols: dict, value_cols,
+                            scale_col) -> np.ndarray:
+        return np.ascontiguousarray(
+            _value_planes_np(cols, value_cols, scale_col),
+            dtype=np.float32)
+
+    def _build_wagg_inputs(self, cfg, cols: dict, n: int):
+        """(lanes [N, 1+W(+1)] u32, planes [N, P] u64-saturated) for one
+        wagg model: slot first, key lanes, rate lane LAST, matching
+        group_cols(cfg) — lanes filled straight into one preallocated
+        buffer (the no-concat discipline of _key_lanes_into)."""
         t = np.minimum(cols["time_received"], _U32_MAX).astype(np.uint32)
         slot = t - t % np.uint32(cfg.window_seconds)
-        # lanes built straight into one preallocated buffer (the same
-        # no-concat discipline as _key_lanes_into): slot first, key
-        # lanes, rate lane LAST, matching group_cols(cfg)
         key_lanes = [_u32_lane(cols[name]) for name in cfg.key_cols]
         total = 1 + sum(1 if a.ndim == 1 else a.shape[1]
                         for a in key_lanes) + (1 if cfg.scale_col else 0)
@@ -394,7 +416,7 @@ class HostGroupPipeline(FusedPipeline):
         if cfg.scale_col:
             lanes[:, off] = _u32_lane(cols[cfg.scale_col])
         planes = [np.minimum(cols[name], _U32_MAX) for name in cfg.value_cols]
-        return self._group_exact_planes(lanes, np.stack(planes, axis=1))
+        return lanes, np.stack(planes, axis=1)
 
     def _group_exact_planes(self, lanes: np.ndarray, planes: np.ndarray):
         """Exact groupby-sum of stacked [N, P] uint64 planes — the
@@ -412,8 +434,9 @@ class HostGroupPipeline(FusedPipeline):
             if plan[0] != "own":
                 continue
             cfg = w.config
-            lanes = _key_lanes_np(cols, cfg.key_cols)
-            vals = _value_planes_np(cols, cfg.value_cols, cfg.scale_col)
+            lanes = self._build_key_lanes(cols, cfg.key_cols)
+            vals = self._build_value_planes(cols, cfg.value_cols,
+                                            cfg.scale_col)
             uniq, sums, counts = self._group(lanes, [vals], exact=False)
             out[i] = (uniq, sums[0], counts)
         for i, plan in enumerate(self._fam_plan):
@@ -433,9 +456,9 @@ class HostGroupPipeline(FusedPipeline):
                     p_uniq[:, list(sel)], [p_vsum[:, plane]], exact=False)
                 out.append((uniq, sums[0].astype(np.float32)))
             else:
-                lanes = _key_lanes_np(cols, ("dst_addr",))
-                vals = _value_planes_np(cols, (dcfg.value_col,),
-                                        dcfg.scale_col)[:, 0]
+                lanes = self._build_key_lanes(cols, ("dst_addr",))
+                vals = self._build_value_planes(
+                    cols, (dcfg.value_col,), dcfg.scale_col)[:, 0]
                 uniq, sums, _ = self._group(lanes, [vals], exact=False)
                 out.append((uniq, sums[0].astype(np.float32)))
         return out

@@ -76,6 +76,13 @@ class WorkerConfig:
     ingest_shards: int = 0       # grouping shards: 0 auto, 1 disables
     ingest_depth: int = 2        # prepared batches held ready
     ingest_flush_queue: int = 8  # queued background flush jobs (bound)
+    # Worker threads inside the native dataplane kernels (the fused
+    # pass, the staged sketch engine, lane building, the wagg fold) —
+    # every kernel is deterministic at ANY count, so this is purely a
+    # throughput knob. 0 keeps the hostsketch engine's conservative
+    # auto count (half the cores, capped at 4): the kernels are
+    # memory-bound and extra threads thrash small hosts' shared cache.
+    ingest_threads: int = 0
     ingest_native_group: bool = False  # C hash-group kernel (numpy fallback)
     # Single-pass fused native dataplane (native/flowfused.cc): "auto"
     # runs group->cascade->sketch in one C pass whenever the host sketch
@@ -137,6 +144,10 @@ class StreamWorker:
             raise ValueError(
                 f"ingest_fused must be auto|on|off, "
                 f"got {config.ingest_fused!r}")
+        if config.ingest_threads < 0:
+            raise ValueError(
+                f"ingest_threads must be >= 0 (0 = auto), "
+                f"got {config.ingest_threads}")
         if config.ingest_fused == "on" and config.sketch_backend != "host":
             raise ValueError(
                 "ingest_fused='on' requires sketch_backend='host' — the "
@@ -163,7 +174,8 @@ class StreamWorker:
                         models, shards=config.ingest_shards,
                         native_group=config.ingest_native_group,
                         fused=config.ingest_fused,
-                        audit=config.obs_audit)
+                        audit=config.obs_audit,
+                        threads=config.ingest_threads)
                 elif config.sketch_backend == "host":
                     # the host engine consumes the host-grouped prepare
                     # tables; without them there is nothing to feed it
@@ -181,7 +193,7 @@ class StreamWorker:
                     self.fused = FusedPipeline(models)
             else:
                 log.info("model set not fusable; using per-model updates")
-        if hh_sketch == "invertible" and self.fused is not None:
+        if hh_sketch in ("invertible", "mixed") and self.fused is not None:
             from ..hostsketch import HostSketchPipeline
 
             if not isinstance(self.fused, HostSketchPipeline):
@@ -358,7 +370,12 @@ class StreamWorker:
             and getattr(m.model, "snapshot_kind", None) == "windowed_hh"}
         if not modes:
             return "none"
-        return "invertible" if "invertible" in modes else "table"
+        if modes == {"table"}:
+            return "table"
+        # any invertible family needs the host sketch pipeline (the
+        # fallback check below keys off this); a table+invertible mix
+        # (-hh.sketch=auto's cascade flip) is labeled honestly
+        return "invertible" if modes == {"invertible"} else "mixed"
 
     # ---- main loop --------------------------------------------------------
 

@@ -131,7 +131,14 @@ class _Upstream:
             self.conn = http.client.HTTPConnection(
                 *self._http, timeout=self.timeout)
         try:
-            self.conn.request("GET", f"/sub/snapshot?since={since}")
+            # ETag-conditional poll (r19): the server tags every
+            # subscription answer with the CURRENT feed version, so a
+            # mirror that is already at `since` revalidates instead of
+            # re-downloading the "none" frame — a quiet upstream costs
+            # headers, not bytes. 304 means exactly what an empty frame
+            # list means to _apply: nothing new.
+            self.conn.request("GET", f"/sub/snapshot?since={since}",
+                              headers={"If-None-Match": f'"sub-v{since}"'})
             resp = self.conn.getresponse()
             body = resp.read()
         except (OSError, http.client.HTTPException) as e:
@@ -147,6 +154,8 @@ class _Upstream:
             # exception killing the mirror thread
             raise ConnectionError(
                 f"upstream {self.name} died mid-response: {e!r}") from e
+        if resp.status == 304:
+            return b""  # already current: zero frames -> kind "none"
         if resp.status != 200:
             raise OSError(f"upstream {self.name} answered "
                           f"{resp.status} for /sub/snapshot")

@@ -36,9 +36,6 @@ from ..engine.hostfused import (
     PreparedChunk,
     _cached_apply,
     _degradation_reason,
-    _key_lanes_into,
-    _key_lanes_np,
-    _value_planes_np,
     mark_native_serving,
     report_native_degradation,
 )
@@ -110,11 +107,16 @@ class HostSketchPipeline(HostGroupPipeline):
                  pool: Optional[ShardPool] = None,
                  sketch_native: str = "auto",
                  fused: str = "auto",
-                 audit: str = "off"):
+                 audit: str = "off",
+                 threads: int = 0):
         super().__init__(models, shards=shards, native_group=native_group,
                          pool=pool, audit=audit)
+        # -ingest.threads: one thread source for the whole fused/staged
+        # dataplane (engine kernels, the fused pass, lane building, the
+        # wagg fold) — 0 keeps the engine's conservative auto count
         self._engine = HostSketchEngine(
-            [w.config for _, w in self._hh], use_native=sketch_native)
+            [w.config for _, w in self._hh], use_native=sketch_native,
+            threads=threads)
         if not self._engine.native and sketch_native != "numpy":
             report_native_degradation(
                 "sketch", _degradation_reason("hs_cms_update", "r8"))
@@ -148,12 +150,78 @@ class HostSketchPipeline(HostGroupPipeline):
         self._apply_stats = None
         # flowlint: unguarded -- group thread only (prepare half)
         self._group_stats = None
+        # r19 flowspeed: lanes built in C off the decoded columns when
+        # the library exports the builders; the numpy twins
+        # (_key_lanes_into / _value_planes_np / the wagg fill) remain
+        # the bit-exact fallback. Degradation reporting rides
+        # _init_fused (the engine must be native for it to matter).
+        # flowlint: unguarded -- resolved once at construction, read-only after
+        self._native_lanes = False
         from .. import native as _native
 
         if _native.available():
             self._apply_stats = _native.new_stats()
             self._group_stats = _native.new_stats()
+        if self._engine.native and _native.lanes_available():
+            self._native_lanes = True
+            mark_native_serving("lanes")
+        elif self._engine.native and sketch_native != "numpy":
+            report_native_degradation(
+                "lanes", _degradation_reason("ff_build_lanes", "r19"))
         self._init_fused(fused, sketch_native)
+
+    # ---- native lane building (r19 flowspeed) ------------------------------
+
+    def _native_build(self, fn, *args, **kw):
+        """Run one lane-builder kernel on the prepare half's stats
+        buffer and publish its `lanes` phase wall under host_group (the
+        stage that wraps the prepare half)."""
+        stats = self._group_stats
+        if stats is not None:
+            stats[:] = 0
+        out = fn(*args, threads=self._engine.threads, stats=stats, **kw)
+        if stats is not None:
+            _publish_stats("host_group", stats)
+        return out
+
+    def _build_key_lanes(self, cols, key_cols):
+        if not self._native_lanes:
+            return super()._build_key_lanes(cols, key_cols)
+        from .. import native
+
+        return self._native_build(
+            native.build_lanes, [cols[name] for name in key_cols])
+
+    def _build_value_planes(self, cols, value_cols, scale_col):
+        if not self._native_lanes:
+            return super()._build_value_planes(cols, value_cols,
+                                               scale_col)
+        from .. import native
+
+        return self._native_build(
+            native.build_planes_f32,
+            [cols[name] for name in value_cols],
+            scale=cols[scale_col] if scale_col else None)
+
+    def _build_wagg_inputs(self, cfg, cols, n):
+        if not self._native_lanes:
+            return super()._build_wagg_inputs(cfg, cols, n)
+        from .. import native
+
+        columns = [cols["time_received"]]
+        mods = [cfg.window_seconds]
+        for name in cfg.key_cols:
+            columns.append(cols[name])
+            mods.append(0)
+        if cfg.scale_col:
+            columns.append(cols[cfg.scale_col])
+            mods.append(0)
+        lanes = self._native_build(native.build_lanes, columns,
+                                   mods=mods)
+        planes = self._native_build(
+            native.build_planes_u64,
+            [cols[name] for name in cfg.value_cols])
+        return lanes, planes
 
     # ---- fused dataplane plan ---------------------------------------------
 
@@ -289,21 +357,21 @@ class HostSketchPipeline(HostGroupPipeline):
             # no hh family carries dst_addr: group raw rows exactly like
             # the staged path — this table never rides the fused pass
             dcfg = self._ddos[0][1].config
-            lanes = _key_lanes_np(cols, ("dst_addr",))
-            vals = _value_planes_np(cols, (dcfg.value_col,),
-                                    dcfg.scale_col)[:, 0]
+            lanes = self._build_key_lanes(cols, ("dst_addr",))
+            vals = self._build_value_planes(
+                cols, (dcfg.value_col,), dcfg.scale_col)[:, 0]
             uniq, sums, _ = self._group(lanes, [vals], exact=False)
             ddos_in = self._pad_ddos(uniq, sums[0].astype(np.float32))
         fused_in = []
         for ms, _plan in self._fused_trees:
             cfg = self._hh[ms[0]][1].config
-            # lanes built straight into one preallocated buffer — the
-            # extraction IS this path's prepare cost (ROADMAP 4a), so
-            # the concat's temporaries were pure overhead
-            lanes = _key_lanes_into(cols, cfg.key_cols)
-            vals = np.ascontiguousarray(
-                _value_planes_np(cols, cfg.value_cols, cfg.scale_col),
-                dtype=np.float32)
+            # lanes built in ONE pass — natively off the decoded
+            # columns when the library exports the builders (r19), else
+            # straight into one preallocated numpy buffer (r16): the
+            # extraction IS this path's prepare cost (ROADMAP 4a)
+            lanes = self._build_key_lanes(cols, cfg.key_cols)
+            vals = self._build_value_planes(cols, cfg.value_cols,
+                                            cfg.scale_col)
             fused_in.append((lanes, vals))
         audit_in = None
         if self.audit is not None:
@@ -341,7 +409,11 @@ class HostSketchPipeline(HostGroupPipeline):
             stats = self._group_stats
             if stats is not None:
                 stats[:] = 0
-            res = native.group_sum(lanes, planes, stats=stats)
+            # the wagg fold rides the threaded r19 kernel (grouping +
+            # per-group-range u64 fold — exact, bit-identical at any
+            # thread count); a pre-r19 .so serves the serial path
+            res = native.group_sum(lanes, planes, stats=stats,
+                                   threads=self._engine.threads)
             if stats is not None:
                 _publish_stats("host_group", stats)
             if res is not None:

@@ -319,8 +319,10 @@ class MeshCoordinator:
                     for s in self.specs if s.kind == "hh"}
         publish_build_info(
             "coordinator",
-            hh_sketch=("invertible" if "invertible" in hh_modes
-                       else "table" if hh_modes else "none"))
+            hh_sketch=("none" if not hh_modes
+                       else "table" if hh_modes == {"table"}
+                       else "invertible" if hh_modes == {"invertible"}
+                       else "mixed"))
         # flowchaos write-ahead journal (-mesh.journal=<dir>): accepted
         # submissions, fences, epoch bumps and merged-window keys become
         # durable; a restarted coordinator recovers its frontier/epoch/

@@ -104,6 +104,18 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int32),  # collided out
             ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
         ]
+    if hasattr(lib, "flow_hash_group_mt"):  # pre-r19 .so lacks it
+        lib.flow_hash_group_mt.restype = ctypes.c_longlong
+        lib.flow_hash_group_mt.argtypes = [
+            ctypes.c_void_p,  # [n, w] uint32 lanes
+            ctypes.c_longlong,
+            ctypes.c_longlong,
+            ctypes.c_void_p,  # [n] int32 perm out
+            ctypes.c_void_p,  # [n] int32 starts out
+            ctypes.POINTER(ctypes.c_int32),  # collided out
+            ctypes.c_int,     # threads
+            ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
+        ]
     if hasattr(lib, "hs_cms_update"):  # pre-r8 .so lacks the sketch engine
         lib.hs_cms_update.restype = ctypes.c_longlong
         lib.hs_cms_update.argtypes = [
@@ -187,6 +199,43 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_void_p,  # [n] int64 counts out
             ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
         ]
+    if hasattr(lib, "ff_group_sum_mt"):  # pre-r19 .so lacks it
+        lib.ff_group_sum_mt.restype = ctypes.c_longlong
+        lib.ff_group_sum_mt.argtypes = [
+            ctypes.c_void_p,  # [n, w] uint32 lanes
+            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, p] uint64 value planes
+            ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, w] uint32 uniq out
+            ctypes.c_void_p,  # [n, p] uint64 sums out
+            ctypes.c_void_p,  # [n] int64 counts out
+            ctypes.c_int,     # threads
+            ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
+        ]
+    if hasattr(lib, "ff_build_lanes"):  # pre-r19 .so lacks lane building
+        lib.ff_build_lanes.restype = ctypes.c_longlong
+        lib.ff_build_lanes.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),  # [ncols] column buffers
+            ctypes.c_void_p,  # [ncols] uint8 is64
+            ctypes.c_void_p,  # [ncols] int64 widths (1 or 4)
+            ctypes.c_void_p,  # [ncols] uint32 slot mods (NULL = none)
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, wtotal] uint32 lanes out
+            ctypes.c_int,     # threads
+            ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
+        ]
+        lib.ff_build_planes.restype = ctypes.c_longlong
+        lib.ff_build_planes.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),  # [p] scalar column buffers
+            ctypes.c_void_p,  # [p] uint8 is64
+            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # scale column (NULL = none; f32 mode only)
+            ctypes.c_int,     # scale_is64
+            ctypes.c_void_p,  # [n, p] float32 out (XOR with out_u64)
+            ctypes.c_void_p,  # [n, p] uint64 out (the wagg layout)
+            ctypes.c_int,     # threads
+            ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
+        ]
     if hasattr(lib, "ff_fused_update"):
         lib.ff_fused_update.restype = ctypes.c_longlong
         lib.ff_fused_update.argtypes = [
@@ -247,6 +296,8 @@ FF_STAT_SLOTS = {
     "fold": 6,       # root group-table accumulation (ns)
     "inv": 10,       # hs_inv_update / hs_inv_decode (the invertible
                      # family's whole sketch fold — no admission phases)
+    "lanes": 11,     # ff_build_lanes / ff_build_planes: native lane
+                     # building off the decoded columns (r19 flowspeed)
 }
 FF_STAT_PHASES = tuple(FF_STAT_SLOTS)  # ns-valued phase slots, in order
 FF_STAT_ROWS = 7
@@ -280,6 +331,9 @@ _FEATURE_SYMBOLS = {
     "sketch": "hs_cms_update",
     "fused": "ff_fused_update",
     "invsketch": "hs_inv_update",
+    # r19 flowspeed: native lane building off the decoded columns +
+    # the threaded groupby (one .so generation — witness either)
+    "lanes": "ff_build_lanes",
 }
 
 
@@ -344,15 +398,20 @@ def group_available() -> bool:
     return lib is not None and hasattr(lib, "flow_hash_group")
 
 
-def hash_group(lanes: np.ndarray, stats: Optional[np.ndarray] = None):
+def hash_group(lanes: np.ndarray, stats: Optional[np.ndarray] = None,
+               threads: int = 1):
     """Native hash-grouping of [N, W] uint32 key lanes.
 
     Computes the same 64-bit row hash as ops.hostgroup.hash_u64, radix-
     sorts it, and verifies lane equality within each hash group in one
     C pass. Returns (perm [N] int32, starts [G] int32, collided bool) —
     identical contract (and identical group order) to the numpy path, so
-    callers can switch per batch. Raises RuntimeError when the library
-    is missing or too old (callers gate on group_available())."""
+    callers can switch per batch. ``threads`` > 1 routes through the
+    r19 flow_hash_group_mt kernel (per-key-range partitioning,
+    per-partition stable sort) whose output is BIT-IDENTICAL to the
+    serial kernel at any thread count; a pre-r19 library quietly serves
+    the serial path. Raises RuntimeError when the library is missing or
+    too old (callers gate on group_available())."""
     lib = _load()
     if lib is None or not hasattr(lib, "flow_hash_group"):
         raise RuntimeError("libflowdecode.so missing flow_hash_group; "
@@ -362,13 +421,22 @@ def hash_group(lanes: np.ndarray, stats: Optional[np.ndarray] = None):
     perm = np.empty(n, np.int32)
     starts = np.empty(max(n, 1), np.int32)
     collided = ctypes.c_int32(0)
-    g = lib.flow_hash_group(
-        lanes.ctypes.data_as(ctypes.c_void_p), n, w,
-        perm.ctypes.data_as(ctypes.c_void_p),
-        starts.ctypes.data_as(ctypes.c_void_p),
-        ctypes.byref(collided),
-        _stats_ptr(stats),
-    )
+    if threads > 1 and hasattr(lib, "flow_hash_group_mt"):
+        g = lib.flow_hash_group_mt(
+            lanes.ctypes.data_as(ctypes.c_void_p), n, w,
+            perm.ctypes.data_as(ctypes.c_void_p),
+            starts.ctypes.data_as(ctypes.c_void_p),
+            ctypes.byref(collided), int(threads),
+            _stats_ptr(stats),
+        )
+    else:
+        g = lib.flow_hash_group(
+            lanes.ctypes.data_as(ctypes.c_void_p), n, w,
+            perm.ctypes.data_as(ctypes.c_void_p),
+            starts.ctypes.data_as(ctypes.c_void_p),
+            ctypes.byref(collided),
+            _stats_ptr(stats),
+        )
     if g < 0:
         raise ValueError("flow_hash_group failed (batch too large?)")
     return perm, starts[:g], bool(collided.value)
@@ -572,14 +640,17 @@ def fused_available() -> bool:
 
 
 def group_sum(lanes: np.ndarray, vals: np.ndarray,
-              stats: Optional[np.ndarray] = None):
+              stats: Optional[np.ndarray] = None, threads: int = 1):
     """Single-pass exact groupby-sum (ff_group_sum): the native twin of
     ops.hostgroup.group_by_key(exact=True) over integer planes.
 
     lanes [n, w] uint32; vals [n, p] uint64. Returns (uniq [G, w] u32,
     sums [G, p] u64, counts [G] i64), or None on a 64-bit hash collision
     between distinct key rows — the caller re-groups lexicographically,
-    the same contract the numpy path honors."""
+    the same contract the numpy path honors. ``threads`` > 1 rides the
+    r19 ff_group_sum_mt kernel (threaded grouping + per-group-range u64
+    fold — exact integer sums, bit-identical at any thread count); a
+    pre-r19 library quietly serves the serial kernel."""
     lib = _load()
     if lib is None or not hasattr(lib, "ff_group_sum"):
         raise RuntimeError("libflowdecode.so missing the fused dataplane; "
@@ -596,15 +667,161 @@ def group_sum(lanes: np.ndarray, vals: np.ndarray,
     uniq = np.empty((n, w), np.uint32)
     sums = np.empty((n, p), np.uint64)
     counts = np.empty(max(n, 1), np.int64)
-    g = lib.ff_group_sum(_c_arr(lanes), n, w, _c_arr(vals), p,
-                         _c_arr(uniq), _c_arr(sums), _c_arr(counts),
-                         _stats_ptr(stats))
+    if threads > 1 and hasattr(lib, "ff_group_sum_mt"):
+        g = lib.ff_group_sum_mt(_c_arr(lanes), n, w, _c_arr(vals), p,
+                                _c_arr(uniq), _c_arr(sums),
+                                _c_arr(counts), int(threads),
+                                _stats_ptr(stats))
+    else:
+        g = lib.ff_group_sum(_c_arr(lanes), n, w, _c_arr(vals), p,
+                             _c_arr(uniq), _c_arr(sums), _c_arr(counts),
+                             _stats_ptr(stats))
     if g == -2:
         return None  # 64-bit collision: caller takes the exact fallback
     if g < 0:
         raise ValueError(f"ff_group_sum failed (rc={g})")
     g = int(g)
     return uniq[:g], sums[:g], counts[:g]
+
+
+# ---- native lane building off the decoded columns (r19 flowspeed) ----------
+
+
+def lanes_available() -> bool:
+    """Whether the loaded library exports the lane-building kernels (an
+    .so built before r19 runs the fused dataplane fine but builds its
+    lanes in numpy — engine/hostfused.py's bit-exact twins)."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "ff_build_lanes")
+
+
+def _lane_cols(columns):
+    """(ptr array, is64, widths, contiguous keepalives) for a list of
+    decoded columns — [n] u32 / [n] u64 scalars or [n, 4] u32 words."""
+    keep = []
+    ptrs = (ctypes.c_void_p * len(columns))()
+    is64 = np.zeros(len(columns), np.uint8)
+    widths = np.empty(len(columns), np.int64)
+    for i, col in enumerate(columns):
+        a = np.ascontiguousarray(col)
+        if a.ndim == 2:
+            if a.shape[1] != 4 or a.dtype != np.uint32:
+                raise ValueError(
+                    f"column {i}: 2-D lanes must be [n, 4] uint32, got "
+                    f"{a.shape} {a.dtype}")
+            widths[i] = 4
+        elif a.dtype == np.uint64:
+            is64[i] = 1
+            widths[i] = 1
+        else:
+            a = np.ascontiguousarray(a, dtype=np.uint32)
+            widths[i] = 1
+        keep.append(a)
+        ptrs[i] = a.ctypes.data_as(ctypes.c_void_p).value
+    # must hold even under python -O: the C kernels read cols[c][r] for
+    # every r < n taken from column 0 — a shorter column would be read
+    # past its end (heap overread), not caught
+    for i, a in enumerate(keep[1:], start=1):
+        if a.shape[0] != keep[0].shape[0]:
+            raise ValueError(
+                f"column {i}: {a.shape[0]} rows, column 0 has "
+                f"{keep[0].shape[0]} — all columns must share n")
+    return ptrs, is64, widths, keep
+
+
+def build_lanes(columns, mods=None, threads: int = 1,
+                stats: Optional[np.ndarray] = None) -> np.ndarray:
+    """[n, W] uint32 key lanes built natively off decoded columns — the
+    C twin of engine/hostfused.py _key_lanes_into (u64 saturation, [n,4]
+    address words copied through, optional per-column slot transform
+    ``v - v % mods[i]`` for the wagg slot lane). Raises RuntimeError on
+    a pre-r19 library (callers gate on lanes_available())."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "ff_build_lanes"):
+        raise RuntimeError("libflowdecode.so missing the lane-building "
+                           "kernels; run `make native`")
+    ptrs, is64, widths, keep = _lane_cols(columns)
+    n = keep[0].shape[0]
+    wtotal = int(widths.sum())
+    out = np.empty((n, wtotal), np.uint32)
+    mods_arr = None
+    if mods is not None:
+        mods_arr = np.ascontiguousarray(mods, dtype=np.uint32)
+        if mods_arr.shape != (len(columns),):
+            # must hold even under python -O: a short mods array would
+            # send ff_build_lanes reading past its end
+            raise ValueError(
+                f"mods must have one entry per column "
+                f"({len(columns)}), got shape {mods_arr.shape}")
+    rc = lib.ff_build_lanes(
+        ptrs, _c_arr(is64), _c_arr(widths),
+        _c_arr(mods_arr) if mods_arr is not None else None,
+        len(keep), n, wtotal, _c_arr(out), int(threads),
+        _stats_ptr(stats))
+    del keep
+    if rc != 0:
+        raise ValueError(f"ff_build_lanes failed (rc={rc})")
+    return out
+
+
+def build_planes_f32(columns, scale=None, threads: int = 1,
+                     stats: Optional[np.ndarray] = None) -> np.ndarray:
+    """[n, P] float32 value planes built natively — the C twin of
+    _value_planes_np (u32 saturation, u32->f32 cast, one f32 multiply
+    by max(scale, 1) per cell)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "ff_build_planes"):
+        raise RuntimeError("libflowdecode.so missing the lane-building "
+                           "kernels; run `make native`")
+    ptrs, is64, widths, keep = _lane_cols(columns)
+    if (widths != 1).any():
+        raise ValueError("value planes take scalar columns only")
+    n = keep[0].shape[0]
+    out = np.empty((n, len(keep)), np.float32)
+    sptr = None
+    s64 = 0
+    if scale is not None:
+        s = np.ascontiguousarray(scale)
+        if s.dtype == np.uint64:
+            s64 = 1
+        else:
+            s = np.ascontiguousarray(s, dtype=np.uint32)
+        if s.shape[0] != n:
+            # same overread class as the mods/column checks above
+            raise ValueError(
+                f"scale has {s.shape[0]} rows, columns have {n}")
+        keep.append(s)
+        sptr = _c_arr(s)
+    rc = lib.ff_build_planes(ptrs, _c_arr(is64), len(is64), n, sptr,
+                             s64, _c_arr(out), None, int(threads),
+                             _stats_ptr(stats))
+    del keep
+    if rc != 0:
+        raise ValueError(f"ff_build_planes failed (rc={rc})")
+    return out
+
+
+def build_planes_u64(columns, threads: int = 1,
+                     stats: Optional[np.ndarray] = None) -> np.ndarray:
+    """[n, P] uint64 value planes saturated at U32_MAX — the C twin of
+    _wagg_rows' ``np.minimum(col, U32_MAX)`` plane stack (the exact
+    flows_5m substrate)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "ff_build_planes"):
+        raise RuntimeError("libflowdecode.so missing the lane-building "
+                           "kernels; run `make native`")
+    ptrs, is64, widths, keep = _lane_cols(columns)
+    if (widths != 1).any():
+        raise ValueError("value planes take scalar columns only")
+    n = keep[0].shape[0]
+    out = np.empty((n, len(keep)), np.uint64)
+    rc = lib.ff_build_planes(ptrs, _c_arr(is64), len(is64), n, None, 0,
+                             None, _c_arr(out), int(threads),
+                             _stats_ptr(stats))
+    del keep
+    if rc != 0:
+        raise ValueError(f"ff_build_planes failed (rc={rc})")
+    return out
 
 
 @dataclass(frozen=True)

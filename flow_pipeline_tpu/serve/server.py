@@ -204,7 +204,7 @@ class ServeServer:
                 # flowgate subscription poll: binary frames, never the
                 # JSON cache (since= changes every poll; the feed
                 # memoizes per version on its own)
-                return self._sub_snapshot(url)
+                return self._sub_snapshot(url, inm)
             handler = self._handler_for(endpoint)
             if handler is None:
                 return _http_response(404, json.dumps(
@@ -246,14 +246,24 @@ class ServeServer:
 
     # ---- flowgate subscription + pre-render --------------------------------
 
-    def _sub_snapshot(self, url) -> bytes:
+    def _sub_snapshot(self, url, inm: str | None) -> bytes:
         if self._feed is None:
             from ..gateway.feed import SnapshotFeed
 
             self._feed = SnapshotFeed(self.store)
         q = {k: v[0] for k, v in parse_qs(url.query).items()}
-        _, _, frames = self._feed.frame_since(int(q.get("since", 0)))
-        return _http_response(200, frames,
+        kind, cur, frames = self._feed.frame_since(
+            int(q.get("since", 0)))
+        # ETag-conditional polls (r19, the r18 named follow-on): a
+        # subscriber that is already current sends If-None-Match with
+        # the version it holds; when the feed is still at that version
+        # ("none") the poll costs headers, not a body. The etag encodes
+        # the CURRENT feed version, so it only ever matches a poll
+        # whose since == cur — a delta/full ship can never be masked.
+        etag = f'"sub-v{cur}"'
+        if kind == "none" and inm is not None and inm == etag:
+            return _http_response(304, b"", etag)
+        return _http_response(200, frames, etag,
                               ctype="application/octet-stream")
 
     def warm(self, targets) -> int:

@@ -38,7 +38,7 @@ KNOWN_FLAGS = frozenset({
     "sketch.capacity", "sketch.topk", "sketch.backend", "hh.sketch",
     "window.lateness", "archive.raw", "feed.prefetch",
     "ingest.mode", "ingest.shards", "ingest.depth", "ingest.flush_queue",
-    "ingest.native_group", "ingest.fused",
+    "ingest.native_group", "ingest.fused", "ingest.threads",
     "checkpoint.path", "flush.count", "metrics.addr", "sink", "in",
     "listen.feed", "query.addr", "obs.trace", "obs.audit",
     # flowchaos (utils/faults.py, sink/resilient.py, mesh/journal.py)

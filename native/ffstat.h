@@ -34,6 +34,9 @@ enum FfStat {
   FF_STAT_INV_NS = 10,       // hs_inv_update / hs_inv_decode (the
                              // invertible family's whole sketch fold —
                              // it has no cms/prefilter/topk phases)
+  FF_STAT_LANES_NS = 11,     // ff_build_lanes / ff_build_planes: native
+                             // lane building off the decoded columns
+                             // (the r19 flowspeed attribution slot)
 };
 
 constexpr int kFfStatsLen = 16;

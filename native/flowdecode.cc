@@ -19,11 +19,13 @@
 //   word order, addresses right-aligned to 16 bytes).
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <utility>
 #include <vector>
 
+#include "ffpar.h"   // shared spawn-and-join task helpers
 #include "ffstat.h"  // flowtrace stats out-struct: slots + ff_now_ns
 
 namespace {
@@ -382,6 +384,151 @@ long long flow_hash_group(const uint32_t* lanes, long long n, long long w,
     stats[FF_STAT_ROWS] += n;
     stats[FF_STAT_GROUPS] += n_groups;
     stats[FF_STAT_RADIX_PASSES] += 4;
+  }
+  return n_groups;
+}
+
+// Threaded hash-group — flow_hash_group's multi-core twin, BIT-
+// IDENTICAL output at any thread count (tests/test_fusedplane.py pins
+// it against the serial kernel). The parallelization is per-KEY-RANGE
+// with a deterministic merge:
+//
+//   1. the 64-bit row hash is computed in parallel over contiguous row
+//      blocks (pure per-row work);
+//   2. rows scatter into 256 partitions by the hash's TOP byte, block-
+//      ascending within each partition — so a partition holds its rows
+//      in ORIGINAL order, and partition boundaries can never split a
+//      hash value;
+//   3. each partition is stable-sorted by the full 64-bit hash
+//      independently (work-stealing over partitions). Concatenated in
+//      partition index order that is exactly "ascending h64, original
+//      row order on full ties" — the serial kernel's order — so the
+//      merge is free and deterministic: nothing to merge, only
+//      offsets to add;
+//   4. group boundaries, collision detection and the starts/perm fill
+//      run per partition against per-partition prefix-summed bases.
+//
+// Falls back to the serial kernel under 2 threads or small batches
+// (spawn/join overhead exceeds the win — the same gate discipline as
+// the hostsketch engine's serial-under-2048-groups rule).
+long long flow_hash_group_mt(const uint32_t* lanes, long long n,
+                             long long w, int32_t* perm, int32_t* starts,
+                             int32_t* collided, int threads,
+                             int64_t* stats) {
+  if (threads <= 1 || n < 4096) {
+    return flow_hash_group(lanes, n, w, perm, starts, collided, stats);
+  }
+  *collided = 0;
+  if (n > INT32_MAX) return -1;
+  int64_t t0 = ff_now_ns(stats);
+  constexpr int kParts = 256;
+  // fixed contiguous row blocks, one per worker: the scatter below
+  // writes each (partition, block) run in block-ascending order, which
+  // is what keeps partition contents in original row order
+  int nblk = static_cast<int>(std::min<long long>(
+      std::min(threads, 16), ff_n_blocks(n)));
+  std::vector<uint64_t> h(static_cast<size_t>(n));
+  std::vector<int64_t> cnt(static_cast<size_t>(nblk) * kParts, 0);
+  ff_parallel_tasks(nblk, threads, [&](long long b) {
+    int64_t lo = n * b / nblk, hi = n * (b + 1) / nblk;
+    int64_t* c = cnt.data() + b * kParts;
+    for (int64_t r = lo; r < hi; ++r) {
+      const uint32_t* row = lanes + r * w;
+      uint64_t h1 = mix_lanes(row, w, 0x9E3779B1U, 0x2545F491U);
+      uint64_t h0 = mix_lanes(row, w, 0x85EBCA77U, 0x27220A95U);
+      h[static_cast<size_t>(r)] = (h1 << 32) | h0;
+      ++c[h[static_cast<size_t>(r)] >> 56];
+    }
+  });
+  // partition-major, block-ascending prefix sum -> per-(block,
+  // partition) scatter cursors + per-partition base offsets
+  std::vector<int64_t> part_base(kParts + 1);
+  int64_t pos = 0;
+  for (int p = 0; p < kParts; ++p) {
+    part_base[p] = pos;
+    for (int b = 0; b < nblk; ++b) {
+      int64_t c = cnt[static_cast<size_t>(b) * kParts + p];
+      cnt[static_cast<size_t>(b) * kParts + p] = pos;
+      pos += c;
+    }
+  }
+  part_base[kParts] = n;
+  std::vector<uint64_t> hs(static_cast<size_t>(n));
+  std::vector<uint32_t> is(static_cast<size_t>(n));
+  ff_parallel_tasks(nblk, threads, [&](long long b) {
+    int64_t lo = n * b / nblk, hi = n * (b + 1) / nblk;
+    int64_t* c = cnt.data() + b * kParts;
+    for (int64_t r = lo; r < hi; ++r) {
+      int64_t dst = c[h[static_cast<size_t>(r)] >> 56]++;
+      hs[static_cast<size_t>(dst)] = h[static_cast<size_t>(r)];
+      is[static_cast<size_t>(dst)] = static_cast<uint32_t>(r);
+    }
+  });
+  // per-partition stable sort + boundary/collision scan. Disjoint
+  // slices of hs/is/pgroups per task; `coll` is the one shared word
+  // (a monotonic flag — relaxed atomic OR).
+  std::vector<int64_t> pgroups(kParts, 0);
+  std::atomic<int> coll{0};
+  ff_parallel_tasks(kParts, threads, [&](long long p) {
+    int64_t lo = part_base[p], hi = part_base[p + 1];
+    if (lo >= hi) return;
+    std::vector<std::pair<uint64_t, uint32_t>> tmp;
+    tmp.reserve(static_cast<size_t>(hi - lo));
+    for (int64_t r = lo; r < hi; ++r) {
+      tmp.emplace_back(hs[static_cast<size_t>(r)],
+                       is[static_cast<size_t>(r)]);
+    }
+    std::stable_sort(tmp.begin(), tmp.end(),
+                     [](const std::pair<uint64_t, uint32_t>& a,
+                        const std::pair<uint64_t, uint32_t>& b) {
+                       return a.first < b.first;
+                     });
+    int64_t g = 0;
+    const uint32_t* rep = nullptr;
+    int c = 0;
+    for (int64_t i = 0; i < hi - lo; ++i) {
+      hs[static_cast<size_t>(lo + i)] = tmp[static_cast<size_t>(i)].first;
+      is[static_cast<size_t>(lo + i)] = tmp[static_cast<size_t>(i)].second;
+      const uint32_t* row =
+          lanes + static_cast<int64_t>(tmp[static_cast<size_t>(i)].second)
+                      * w;
+      if (i == 0 || tmp[static_cast<size_t>(i)].first !=
+                        tmp[static_cast<size_t>(i - 1)].first) {
+        ++g;
+        rep = row;
+      } else if (!c &&
+                 std::memcmp(row, rep, static_cast<size_t>(w) *
+                                           sizeof(uint32_t)) != 0) {
+        c = 1;
+      }
+    }
+    pgroups[static_cast<size_t>(p)] = g;
+    if (c) coll.store(1, std::memory_order_relaxed);
+  });
+  int64_t t1 = ff_now_ns(stats);
+  std::vector<int64_t> gbase(kParts);
+  long long n_groups = 0;
+  for (int p = 0; p < kParts; ++p) {
+    gbase[p] = n_groups;
+    n_groups += pgroups[static_cast<size_t>(p)];
+  }
+  ff_parallel_tasks(kParts, threads, [&](long long p) {
+    int64_t lo = part_base[p], hi = part_base[p + 1];
+    int64_t g = gbase[static_cast<size_t>(p)];
+    for (int64_t r = lo; r < hi; ++r) {
+      perm[r] = static_cast<int32_t>(is[static_cast<size_t>(r)]);
+      if (r == lo || hs[static_cast<size_t>(r)] !=
+                         hs[static_cast<size_t>(r - 1)]) {
+        starts[g++] = static_cast<int32_t>(r);
+      }
+    }
+  });
+  *collided = coll.load(std::memory_order_relaxed);
+  if (stats != nullptr) {
+    stats[FF_STAT_RADIX_NS] += t1 - t0;
+    stats[FF_STAT_REFINE_NS] += ff_now_ns(stats) - t1;
+    stats[FF_STAT_ROWS] += n;
+    stats[FF_STAT_GROUPS] += n_groups;
   }
   return n_groups;
 }

@@ -40,15 +40,22 @@
 //   (hostsketch/engine.py update docstring), so testing the REAL group
 //   count is bit-exact.
 //
-// Threading: the radix groupby is serial (cache-friendly, ~tens of ns
-// per row); parallelism lives inside the hs_* kernels, which join
-// before returning. No state outlives a call.
+// Threading (r19 flowspeed): the whole pass is deterministic at ANY
+// thread count. Grouping rides flow_hash_group_mt (per-key-range
+// partitioning, per-partition stable sort — bit-identical to the
+// serial kernel by construction); group-table folds parallelize over
+// GROUP ranges (each group's permutation-order double accumulation is
+// untouched, so the f64 rounding sequence per group cannot change);
+// the hs_* sketch kernels partition per-(plane, depth) row. Everything
+// joins before returning; no state outlives a call. The staged
+// engine's serial-under-2048-groups gate is preserved at every seam.
 
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <vector>
 
+#include "ffpar.h"   // shared spawn-and-join task helpers
 #include "ffstat.h"  // flowtrace stats out-struct: slots + ff_now_ns
 
 extern "C" {
@@ -56,6 +63,10 @@ extern "C" {
 long long flow_hash_group(const uint32_t* lanes, long long n, long long w,
                           int32_t* perm, int32_t* starts, int32_t* collided,
                           int64_t* stats);
+long long flow_hash_group_mt(const uint32_t* lanes, long long n,
+                             long long w, int32_t* perm, int32_t* starts,
+                             int32_t* collided, int threads,
+                             int64_t* stats);
 long long hs_cms_update(uint64_t* cms, long long planes, long long depth,
                         long long width, const uint32_t* keys, long long n,
                         long long kw, const float* vals,
@@ -109,50 +120,64 @@ struct FamTable {
 long long group_lanes(const uint32_t* lanes, long long m, long long wk,
                       std::vector<int32_t>& perm,
                       std::vector<int32_t>& starts, int32_t* collided,
-                      int64_t* stats) {
+                      int threads, int64_t* stats) {
   perm.resize(static_cast<size_t>(m));
   starts.resize(static_cast<size_t>(std::max<long long>(m, 1)));
   *collided = 0;
-  return flow_hash_group(lanes, m, wk, perm.data(), starts.data(),
-                         collided, stats);
+  return flow_hash_group_mt(lanes, m, wk, perm.data(), starts.data(),
+                            collided, threads, stats);
+}
+
+// Serial gate shared by every fold below: under a few thousand rows
+// the spawn/join overhead exceeds the win (the hostsketch engine's
+// serial-under-2048-groups discipline applied to the fused folds).
+inline int fold_threads(long long rows, int threads) {
+  return rows < 4096 ? 1 : threads;
 }
 
 // Fold a grouping into a FamTable: representative keys, double value
 // sums in permutation order (reduceat parity), uint64 counts. Exactly
 // one of fsrc (raw f32 planes) / parent (cascade) provides the values.
+// Threaded over GROUP ranges: tasks own disjoint group indices, and a
+// group's rows still accumulate in permutation order inside one task,
+// so the f64 rounding sequence — the thing reduceat parity hangs on —
+// is independent of the thread count.
 void accumulate(const uint32_t* lanes, long long m, long long wk,
                 long long p, const float* fsrc, const FamTable* parent,
                 const std::vector<int32_t>& perm,
                 const std::vector<int32_t>& starts, long long g,
-                FamTable& out) {
+                int threads, FamTable& out) {
   out.g = g;
   out.wk = wk;
   out.keys.assign(static_cast<size_t>(g * wk), 0);
   out.vsum.assign(static_cast<size_t>(g * p), 0.0);
   out.cnt.assign(static_cast<size_t>(g), 0);
-  for (long long gi = 0; gi < g; ++gi) {
-    long long lo = starts[static_cast<size_t>(gi)];
-    long long hi = gi + 1 < g ? starts[static_cast<size_t>(gi + 1)] : m;
-    std::memcpy(out.keys.data() + gi * wk,
-                lanes + static_cast<long long>(perm[lo]) * wk,
-                static_cast<size_t>(wk) * sizeof(uint32_t));
-    double* acc = out.vsum.data() + gi * p;
-    uint64_t cnt = 0;
-    for (long long r = lo; r < hi; ++r) {
-      long long row = perm[static_cast<size_t>(r)];
-      if (parent != nullptr) {
-        const double* src = parent->vsum.data() + row * p;
-        for (long long pi = 0; pi < p; ++pi) acc[pi] += src[pi];
-        cnt += parent->cnt[static_cast<size_t>(row)];
-      } else {
-        const float* src = fsrc + row * p;
-        for (long long pi = 0; pi < p; ++pi)
-          acc[pi] += static_cast<double>(src[pi]);
-        ++cnt;
+  ff_parallel_rows(g, fold_threads(m, threads),
+                   [&](long long glo, long long ghi) {
+    for (long long gi = glo; gi < ghi; ++gi) {
+      long long lo = starts[static_cast<size_t>(gi)];
+      long long hi = gi + 1 < g ? starts[static_cast<size_t>(gi + 1)] : m;
+      std::memcpy(out.keys.data() + gi * wk,
+                  lanes + static_cast<long long>(perm[lo]) * wk,
+                  static_cast<size_t>(wk) * sizeof(uint32_t));
+      double* acc = out.vsum.data() + gi * p;
+      uint64_t cnt = 0;
+      for (long long r = lo; r < hi; ++r) {
+        long long row = perm[static_cast<size_t>(r)];
+        if (parent != nullptr) {
+          const double* src = parent->vsum.data() + row * p;
+          for (long long pi = 0; pi < p; ++pi) acc[pi] += src[pi];
+          cnt += parent->cnt[static_cast<size_t>(row)];
+        } else {
+          const float* src = fsrc + row * p;
+          for (long long pi = 0; pi < p; ++pi)
+            acc[pi] += static_cast<double>(src[pi]);
+          ++cnt;
+        }
       }
+      out.cnt[static_cast<size_t>(gi)] = cnt;
     }
-    out.cnt[static_cast<size_t>(gi)] = cnt;
-  }
+  });
 }
 
 // The sketch step for one family — hostsketch/engine.py update(),
@@ -168,19 +193,22 @@ long long sketch_family(const FamTable& fam, long long p, long long depth,
   long long g = fam.g;
   if (g <= 0) return 0;  // all-invalid chunk: CMS and table both no-ops
   long long planes = p + 1;  // + count plane
-  // f32 addend planes, cast exactly where _prep_device casts
-  std::vector<float> sums(static_cast<size_t>(g * planes));
-  for (long long gi = 0; gi < g; ++gi) {
-    for (long long pi = 0; pi < p; ++pi) {
-      sums[static_cast<size_t>(gi * planes + pi)] =
-          static_cast<float>(fam.vsum[static_cast<size_t>(gi * p + pi)]);
-    }
-    sums[static_cast<size_t>(gi * planes + p)] =
-        static_cast<float>(fam.cnt[static_cast<size_t>(gi)]);
-  }
   // same serial gate as HostSketchEngine.update: under 2048 groups the
   // spawn/join overhead exceeds the win
   int t = g < 2048 ? 1 : threads;
+  // f32 addend planes, cast exactly where _prep_device casts (per-group
+  // work on disjoint rows — threadable at the same gate)
+  std::vector<float> sums(static_cast<size_t>(g * planes));
+  ff_parallel_rows(g, t, [&](long long glo, long long ghi) {
+    for (long long gi = glo; gi < ghi; ++gi) {
+      for (long long pi = 0; pi < p; ++pi) {
+        sums[static_cast<size_t>(gi * planes + pi)] =
+            static_cast<float>(fam.vsum[static_cast<size_t>(gi * p + pi)]);
+      }
+      sums[static_cast<size_t>(gi * planes + p)] =
+          static_cast<float>(fam.cnt[static_cast<size_t>(gi)]);
+    }
+  });
   if (invertible) {
     // the whole admission path (prefilter -> admission CMS query ->
     // top-K merge) does not exist for the invertible family: one pure
@@ -244,37 +272,56 @@ extern "C" {
 // the group count; -1 on degenerate shapes / int32 overflow;
 // -2 when two DISTINCT key rows share a 64-bit hash (the caller falls
 // back to the lexicographic regroup, same contract as the numpy path).
-long long ff_group_sum(const uint32_t* lanes, long long n, long long w,
-                       const uint64_t* vals, long long p,
-                       uint32_t* uniq_out, uint64_t* sums_out,
-                       int64_t* counts_out, int64_t* stats) {
+long long ff_group_sum_mt(const uint32_t* lanes, long long n, long long w,
+                          const uint64_t* vals, long long p,
+                          uint32_t* uniq_out, uint64_t* sums_out,
+                          int64_t* counts_out, int threads,
+                          int64_t* stats) {
   if (n < 0 || w < 1 || p < 0) return -1;
   if (n == 0) return 0;
   std::vector<int32_t> perm, starts;
   int32_t collided = 0;
-  long long g = group_lanes(lanes, n, w, perm, starts, &collided, stats);
+  long long g = group_lanes(lanes, n, w, perm, starts, &collided,
+                            threads, stats);
   if (g < 0) return -1;
   if (collided) return -2;
   int64_t t_fold = ff_now_ns(stats);
-  for (long long gi = 0; gi < g; ++gi) {
-    long long lo = starts[static_cast<size_t>(gi)];
-    long long hi = gi + 1 < g ? starts[static_cast<size_t>(gi + 1)] : n;
-    std::memcpy(uniq_out + gi * w,
-                lanes + static_cast<long long>(perm[lo]) * w,
-                static_cast<size_t>(w) * sizeof(uint32_t));
-    uint64_t* acc = sums_out + gi * p;
-    for (long long pi = 0; pi < p; ++pi) acc[pi] = 0;
-    for (long long r = lo; r < hi; ++r) {
-      const uint64_t* src =
-          vals + static_cast<long long>(perm[static_cast<size_t>(r)]) * p;
-      for (long long pi = 0; pi < p; ++pi) acc[pi] += src[pi];
+  // u64 fold over disjoint group ranges — exact integer sums, so the
+  // thread partition cannot change a bit (the wagg exactness contract)
+  ff_parallel_rows(g, fold_threads(n, threads),
+                   [&](long long glo, long long ghi) {
+    for (long long gi = glo; gi < ghi; ++gi) {
+      long long lo = starts[static_cast<size_t>(gi)];
+      long long hi = gi + 1 < g ? starts[static_cast<size_t>(gi + 1)] : n;
+      std::memcpy(uniq_out + gi * w,
+                  lanes + static_cast<long long>(perm[lo]) * w,
+                  static_cast<size_t>(w) * sizeof(uint32_t));
+      uint64_t* acc = sums_out + gi * p;
+      for (long long pi = 0; pi < p; ++pi) acc[pi] = 0;
+      for (long long r = lo; r < hi; ++r) {
+        const uint64_t* src =
+            vals +
+            static_cast<long long>(perm[static_cast<size_t>(r)]) * p;
+        for (long long pi = 0; pi < p; ++pi) acc[pi] += src[pi];
+      }
+      counts_out[gi] = hi - lo;
     }
-    counts_out[gi] = hi - lo;
-  }
+  });
   if (stats != nullptr) {
     stats[FF_STAT_FOLD_NS] += ff_now_ns(stats) - t_fold;
   }
   return g;
+}
+
+// The r10 single-threaded entry, kept for ABI stability (a caller
+// built against the pre-r19 signature keeps working); new callers
+// pass a thread count through ff_group_sum_mt above.
+long long ff_group_sum(const uint32_t* lanes, long long n, long long w,
+                       const uint64_t* vals, long long p,
+                       uint32_t* uniq_out, uint64_t* sums_out,
+                       int64_t* counts_out, int64_t* stats) {
+  return ff_group_sum_mt(lanes, n, w, vals, p, uniq_out, sums_out,
+                         counts_out, 1, stats);
 }
 
 // The fused sketch dataplane over one family tree: group the root
@@ -369,12 +416,15 @@ long long ff_fused_update(const uint32_t* lanes, long long n, long long w,
       }
       m = pt.g;
       child_lanes.resize(static_cast<size_t>(m * wk));
-      for (long long r = 0; r < m; ++r) {
-        for (long long c = 0; c < wk; ++c) {
-          child_lanes[static_cast<size_t>(r * wk + c)] =
-              pt.keys[static_cast<size_t>(r * pt.wk + csel[c])];
+      ff_parallel_rows(m, fold_threads(m, threads),
+                       [&](long long rlo, long long rhi) {
+        for (long long r = rlo; r < rhi; ++r) {
+          for (long long c = 0; c < wk; ++c) {
+            child_lanes[static_cast<size_t>(r * wk + c)] =
+                pt.keys[static_cast<size_t>(r * pt.wk + csel[c])];
+          }
         }
-      }
+      });
       src_lanes = child_lanes.data();
       ptab = &pt;
     }
@@ -388,13 +438,13 @@ long long ff_fused_update(const uint32_t* lanes, long long n, long long w,
     // whole pass — lane gather above + grouping + fold — is "regroup"
     bool is_root = par < 0;
     long long g = group_lanes(src_lanes, m, wk, perm, starts, &collided,
-                              is_root ? stats : nullptr);
+                              threads, is_root ? stats : nullptr);
     if (g < 0) return -1;
     // collisions merge hash-identical tuples — the sketch families'
     // documented exact=False trade (ops.hostgroup.group_by_key)
     int64_t t_fold = ff_now_ns(stats);
     accumulate(src_lanes, m, wk, p, fsrc, ptab, perm, starts, g,
-               fams[static_cast<size_t>(f)]);
+               threads, fams[static_cast<size_t>(f)]);
     if (stats != nullptr) {
       if (is_root) {
         stats[FF_STAT_FOLD_NS] += ff_now_ns(stats) - t_fold;
@@ -428,35 +478,175 @@ long long ff_fused_update(const uint32_t* lanes, long long n, long long w,
   if (pt.g == 0) return 0;
   int64_t t_ddos = ff_now_ns(stats);
   child_lanes.resize(static_cast<size_t>(pt.g * ddos_sel_w));
-  for (long long r = 0; r < pt.g; ++r) {
-    for (long long c = 0; c < ddos_sel_w; ++c) {
-      child_lanes[static_cast<size_t>(r * ddos_sel_w + c)] =
-          pt.keys[static_cast<size_t>(r * pt.wk + ddos_sel[c])];
+  ff_parallel_rows(pt.g, fold_threads(pt.g, threads),
+                   [&](long long rlo, long long rhi) {
+    for (long long r = rlo; r < rhi; ++r) {
+      for (long long c = 0; c < ddos_sel_w; ++c) {
+        child_lanes[static_cast<size_t>(r * ddos_sel_w + c)] =
+            pt.keys[static_cast<size_t>(r * pt.wk + ddos_sel[c])];
+      }
     }
-  }
+  });
   long long g = group_lanes(child_lanes.data(), pt.g, ddos_sel_w, perm,
-                            starts, &collided, nullptr);
+                            starts, &collided, threads, nullptr);
   if (g < 0) return -1;
-  for (long long gi = 0; gi < g; ++gi) {
-    long long lo = starts[static_cast<size_t>(gi)];
-    long long hi = gi + 1 < g ? starts[static_cast<size_t>(gi + 1)] : pt.g;
-    std::memcpy(
-        ddos_keys_out + gi * ddos_sel_w,
-        child_lanes.data() +
-            static_cast<long long>(perm[lo]) * ddos_sel_w,
-        static_cast<size_t>(ddos_sel_w) * sizeof(uint32_t));
-    double acc = 0.0;
-    for (long long r = lo; r < hi; ++r) {
-      acc += pt.vsum[static_cast<size_t>(
-          static_cast<long long>(perm[static_cast<size_t>(r)]) * p +
-          ddos_plane)];
+  ff_parallel_rows(g, fold_threads(pt.g, threads),
+                   [&](long long glo, long long ghi) {
+    for (long long gi = glo; gi < ghi; ++gi) {
+      long long lo = starts[static_cast<size_t>(gi)];
+      long long hi =
+          gi + 1 < g ? starts[static_cast<size_t>(gi + 1)] : pt.g;
+      std::memcpy(
+          ddos_keys_out + gi * ddos_sel_w,
+          child_lanes.data() +
+              static_cast<long long>(perm[lo]) * ddos_sel_w,
+          static_cast<size_t>(ddos_sel_w) * sizeof(uint32_t));
+      double acc = 0.0;
+      for (long long r = lo; r < hi; ++r) {
+        acc += pt.vsum[static_cast<size_t>(
+            static_cast<long long>(perm[static_cast<size_t>(r)]) * p +
+            ddos_plane)];
+      }
+      ddos_sums_out[gi] = static_cast<float>(acc);
     }
-    ddos_sums_out[gi] = static_cast<float>(acc);
-  }
+  });
   if (stats != nullptr) {
     stats[FF_STAT_REGROUP_NS] += ff_now_ns(stats) - t_ddos;
   }
   return g;
+}
+
+// ---- native lane building off the decoded columns (r19 flowspeed) ---------
+//
+// The fused prepare half previously built its [n, W] uint32 key lanes
+// and [n, P] value planes in numpy: one saturation copy PER LANE
+// (np.minimum over the u64 columns) plus the buffer fill — measured as
+// the residual host_group share after the r16 prealloc rewrite proved
+// the concat was not the cost. These two kernels consume the decoded
+// columns (the exact buffers flow_decode_stream wrote) and emit the
+// lane layouts in ONE threaded pass each; the numpy builders
+// (engine/hostfused.py _key_lanes_into / _value_planes_np / the wagg
+// lane fill) stay as the bit-exact twins and the fallback when these
+// symbols are absent. Saturation, u32->f32 rounding and the f32 scale
+// multiply all match the numpy twins bit-for-bit:
+// (float)uint32 is round-to-nearest in both, and the slot transform
+// (v - v % mod) runs on the saturated u32 exactly like _wagg_rows.
+
+// Build [n, wtotal] uint32 lanes from `ncols` decoded columns.
+//   cols[c]:   [n] uint32, [n] uint64 (is64[c]) or [n, widths[c]]
+//              uint32 words (address columns, widths[c] == 4)
+//   is64[c]:   column is uint64 (saturates at U32_MAX; width-1 only)
+//   widths[c]: lanes this column contributes (1 or 4)
+//   mods[c]:   0, or the wagg slot transform v -> v - v % mods[c]
+//              applied AFTER saturation (width-1 only)
+// Returns 0, or -1 on degenerate shapes / an inconsistent layout.
+long long ff_build_lanes(const void** cols, const uint8_t* is64,
+                         const int64_t* widths, const uint32_t* mods,
+                         long long ncols, long long n, long long wtotal,
+                         uint32_t* out, int threads, int64_t* stats) {
+  if (n < 0 || ncols < 1 || wtotal < 1) return -1;
+  long long sum_w = 0;
+  for (long long c = 0; c < ncols; ++c) {
+    long long wc = widths[c];
+    if (wc != 1 && wc != 4) return -1;
+    if (wc != 1 && (is64[c] || (mods != nullptr && mods[c]))) return -1;
+    sum_w += wc;
+  }
+  if (sum_w != wtotal) return -1;
+  if (n == 0) return 0;
+  int64_t t0 = ff_now_ns(stats);
+  ff_parallel_rows(n, fold_threads(n, threads),
+                   [&](long long lo, long long hi) {
+    long long off = 0;
+    for (long long c = 0; c < ncols; ++c) {
+      long long wc = widths[c];
+      if (wc == 4) {
+        const uint32_t* src = static_cast<const uint32_t*>(cols[c]);
+        for (long long r = lo; r < hi; ++r) {
+          std::memcpy(out + r * wtotal + off, src + r * 4,
+                      4 * sizeof(uint32_t));
+        }
+      } else if (is64[c]) {
+        const uint64_t* src = static_cast<const uint64_t*>(cols[c]);
+        uint32_t mod = mods != nullptr ? mods[c] : 0;
+        for (long long r = lo; r < hi; ++r) {
+          uint64_t v = src[r];
+          uint32_t s = v > 0xFFFFFFFFull ? 0xFFFFFFFFu
+                                         : static_cast<uint32_t>(v);
+          out[r * wtotal + off] = mod ? s - s % mod : s;
+        }
+      } else {
+        const uint32_t* src = static_cast<const uint32_t*>(cols[c]);
+        uint32_t mod = mods != nullptr ? mods[c] : 0;
+        for (long long r = lo; r < hi; ++r) {
+          uint32_t s = src[r];
+          out[r * wtotal + off] = mod ? s - s % mod : s;
+        }
+      }
+      off += wc;
+    }
+  });
+  if (stats != nullptr) {
+    stats[FF_STAT_LANES_NS] += ff_now_ns(stats) - t0;
+  }
+  return 0;
+}
+
+// Build [n, p] value planes from `p` SCALAR decoded columns: float32
+// planes with the optional sampling-rate scale (out_f32 != NULL — the
+// sketch families' layout), or exact uint64 planes saturated at
+// U32_MAX (out_u64 != NULL — the wagg/flows_5m layout; scale must be
+// NULL there, matching _wagg_rows). Exactly one output must be set.
+// Returns 0, or -1 on degenerate shapes.
+long long ff_build_planes(const void** cols, const uint8_t* is64,
+                          long long p, long long n, const void* scale,
+                          int scale_is64, float* out_f32,
+                          uint64_t* out_u64, int threads,
+                          int64_t* stats) {
+  if (n < 0 || p < 1) return -1;
+  if ((out_f32 == nullptr) == (out_u64 == nullptr)) return -1;
+  if (out_u64 != nullptr && scale != nullptr) return -1;
+  if (n == 0) return 0;
+  int64_t t0 = ff_now_ns(stats);
+  auto sat = [](const void* col, int c64, long long r) -> uint32_t {
+    if (c64) {
+      uint64_t v = static_cast<const uint64_t*>(col)[r];
+      return v > 0xFFFFFFFFull ? 0xFFFFFFFFu : static_cast<uint32_t>(v);
+    }
+    return static_cast<const uint32_t*>(col)[r];
+  };
+  ff_parallel_rows(n, fold_threads(n, threads),
+                   [&](long long lo, long long hi) {
+    if (out_u64 != nullptr) {
+      for (long long c = 0; c < p; ++c) {
+        for (long long r = lo; r < hi; ++r) {
+          out_u64[r * p + c] =
+              static_cast<uint64_t>(sat(cols[c], is64[c], r));
+        }
+      }
+      return;
+    }
+    for (long long c = 0; c < p; ++c) {
+      for (long long r = lo; r < hi; ++r) {
+        out_f32[r * p + c] =
+            static_cast<float>(sat(cols[c], is64[c], r));
+      }
+    }
+    if (scale != nullptr) {
+      // max(rate, 1) in f32 then one f32 multiply per cell — the same
+      // rounding sequence as _value_planes_np's `planes * r[:, None]`
+      for (long long r = lo; r < hi; ++r) {
+        float f = static_cast<float>(sat(scale, scale_is64, r));
+        if (f < 1.0f) f = 1.0f;
+        float* row = out_f32 + r * p;
+        for (long long c = 0; c < p; ++c) row[c] *= f;
+      }
+    }
+  });
+  if (stats != nullptr) {
+    stats[FF_STAT_LANES_NS] += ff_now_ns(stats) - t0;
+  }
+  return 0;
 }
 
 }  // extern "C"

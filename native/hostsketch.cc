@@ -34,13 +34,12 @@
 // atomics beyond the work-stealing task counter.
 
 #include <algorithm>
-#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <limits>
-#include <thread>
 #include <vector>
 
+#include "ffpar.h"   // shared spawn-and-join task helpers
 #include "ffstat.h"  // flowtrace stats out-struct: slots + ff_now_ns
 
 namespace {
@@ -81,36 +80,37 @@ inline uint64_t addend_u64(float v) {
   return static_cast<uint64_t>(v);
 }
 
-// Work-stealing task loop: spawn-and-join per call keeps the engine
-// state-free (no persistent pool to leak or race); tasks must write
-// disjoint data.
+// Work-stealing task loop (ffpar.h): spawn-and-join per call keeps the
+// engine state-free (no persistent pool to leak or race); tasks must
+// write disjoint data.
 template <typename F>
 void parallel_tasks(long long n_tasks, int threads, F fn) {
-  if (threads <= 1 || n_tasks <= 1) {
-    for (long long t = 0; t < n_tasks; ++t) fn(t);
-    return;
-  }
-  int nt = static_cast<int>(
-      std::min<long long>(threads, n_tasks));
-  std::atomic<long long> next{0};
-  std::vector<std::thread> pool;
-  pool.reserve(nt);
-  for (int i = 0; i < nt; ++i) {
-    pool.emplace_back([&next, n_tasks, &fn] {
-      long long t;
-      while ((t = next.fetch_add(1, std::memory_order_relaxed)) < n_tasks) {
-        fn(t);
-      }
-    });
-  }
-  for (auto& th : pool) th.join();
+  ff_parallel_tasks(n_tasks, threads, fn);
 }
 
 // Row-range task shape for per-row work (bucket hashing, queries).
-constexpr long long kRowBlock = 2048;
+constexpr long long kRowBlock = kFfRowBlock;
 
 inline long long n_blocks(long long n) {
-  return (n + kRowBlock - 1) / kRowBlock;
+  return ff_n_blocks(n);
+}
+
+// Precompute the u64 addends for every (row, plane) once, in one
+// vectorization-friendly pass (r19 flowspeed): the scatter loops
+// previously re-ran the branchy f32->u64 clamp DEPTH times per plane —
+// hoisting it makes the CMS inner loop a pure gather/add/store the
+// compiler can keep in registers, and costs one n*planes u64 buffer.
+// Invalid rows contribute 0 (exactly what addend_u64 returns for the
+// values a masked row would have added — the scatter still skips them
+// via `valid`, this is belt-and-braces for the hoisted layout).
+void fill_addends(const float* vals, long long n, long long planes,
+                  int threads, std::vector<uint64_t>& add) {
+  add.resize(static_cast<size_t>(n * planes));
+  ff_parallel_rows(n, threads, [&](long long lo, long long hi) {
+    for (long long i = lo * planes; i < hi * planes; ++i) {
+      add[static_cast<size_t>(i)] = addend_u64(vals[i]);
+    }
+  });
 }
 
 // Per-depth bucket table [depth, n] — one hash pass, shared by update
@@ -198,13 +198,19 @@ long long hs_cms_update(uint64_t* cms, long long planes, long long depth,
   if (!conservative) {
     // Linear add: each (plane, depth) row owns a disjoint cell range;
     // u64 addition is associative so the task order is irrelevant.
+    // Addends are hoisted out of the scatter (fill_addends): the inner
+    // loop is a pure gather/add/store instead of re-running the branchy
+    // clamp depth times per plane.
+    std::vector<uint64_t> add;
+    fill_addends(vals, n, planes, threads, add);
     parallel_tasks(planes * depth, threads, [&](long long task) {
       long long p = task / depth, d = task % depth;
       uint64_t* row = cms + (p * depth + d) * width;
       const uint32_t* b = buckets.data() + d * n;
+      const uint64_t* a = add.data() + p;
       for (long long r = 0; r < n; ++r) {
         if (valid && !valid[r]) continue;
-        row[b[r]] += addend_u64(vals[r * planes + p]);
+        row[b[r]] += a[r * planes];
       }
     });
     if (stats != nullptr) stats[FF_STAT_CMS_NS] += ff_now_ns(stats) - t0;
@@ -215,6 +221,10 @@ long long hs_cms_update(uint64_t* cms, long long planes, long long depth,
   // target reads the PRE-update sketch (cms_query before any write),
   // then the scatter-max applies — max is order-free, so the result is
   // independent of both key order and thread interleaving.
+  // No fill_addends hoist here: the target pass reads each addend
+  // exactly ONCE (unlike the plain scatter, which reuses them depth
+  // times per plane), so the hoist would only add an n*planes buffer
+  // and an extra memory pass to the gather-dominated loop.
   std::vector<uint64_t> target(static_cast<size_t>(n * planes));
   parallel_tasks(n_blocks(n), threads, [&](long long blk) {
     long long lo = blk * kRowBlock;
@@ -504,36 +514,40 @@ long long hs_inv_update(uint64_t* cms, long long planes, long long depth,
     }
   });
   // count/value planes: each (plane, depth) row owns disjoint cells
+  // (addends hoisted once per (row, plane) — fill_addends)
+  std::vector<uint64_t> add;
+  fill_addends(vals, n, planes, threads, add);
   parallel_tasks(planes * depth, threads, [&](long long task) {
     long long p = task / depth, d = task % depth;
     uint64_t* row = cms + (p * depth + d) * width;
     const uint32_t* b = buckets.data() + d * n;
+    const uint64_t* a = add.data() + p;
     for (long long r = 0; r < n; ++r) {
       if (valid && !valid[r]) continue;
-      row[b[r]] += addend_u64(vals[r * planes + p]);
+      row[b[r]] += a[r * planes];
     }
   });
-  // key-recovery planes: task (d, l) owns keysum column l of depth row
-  // d; task (d, kw) owns that row's checksum — disjoint cells, wrap
-  // adds, order-free
-  parallel_tasks(depth * (kw + 1), threads, [&](long long task) {
-    long long d = task / (kw + 1), l = task % (kw + 1);
+  // key-recovery planes: task d owns the WHOLE depth row — keysum
+  // lanes AND checksum — so each bucket's kw+1 contiguous cells are
+  // touched in one pass per row with a vectorizable per-lane
+  // mul-accumulate over l (r19 flowspeed: the pre-r19 (d, l) column
+  // split walked the row kw+1 times with a stride-kw inner loop, which
+  // is exactly the layout autovectorizers refuse). Wrap adds stay
+  // order-free and rows of different depths stay disjoint, so the
+  // determinism contract is unchanged at any thread count.
+  parallel_tasks(depth, threads, [&](long long d) {
     const uint32_t* b = buckets.data() + d * n;
-    if (l < kw) {
-      uint64_t* row = keysum + d * width * kw;
-      for (long long r = 0; r < n; ++r) {
-        if (valid && !valid[r]) continue;
-        row[static_cast<long long>(b[r]) * kw + l] +=
-            static_cast<uint64_t>(keys[r * kw + l]) *
-            cnt[static_cast<size_t>(r)];
+    uint64_t* ks_row = keysum + d * width * kw;
+    uint64_t* kc_row = keycheck + d * width;
+    for (long long r = 0; r < n; ++r) {
+      if (valid && !valid[r]) continue;
+      uint64_t c = cnt[static_cast<size_t>(r)];
+      uint64_t* cell = ks_row + static_cast<long long>(b[r]) * kw;
+      const uint32_t* k = keys + r * kw;
+      for (long long l = 0; l < kw; ++l) {
+        cell[l] += static_cast<uint64_t>(k[l]) * c;
       }
-    } else {
-      uint64_t* row = keycheck + d * width;
-      for (long long r = 0; r < n; ++r) {
-        if (valid && !valid[r]) continue;
-        row[b[r]] += h64[static_cast<size_t>(r)] *
-                     cnt[static_cast<size_t>(r)];
-      }
+      kc_row[b[r]] += h64[static_cast<size_t>(r)] * c;
     }
   });
   if (stats != nullptr) stats[FF_STAT_INV_NS] += ff_now_ns(stats) - t0;

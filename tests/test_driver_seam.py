@@ -102,6 +102,53 @@ def test_bench_sweep_staging(tiny_bench, capsys):
         assert "inv" in ab["host_fused_phases_invertible"]
 
 
+@pytest.mark.slow  # ~9s of paired e2e legs; gated by `make fused-parity`
+def test_bench_fused_staging(tiny_bench, monkeypatch, capsys):
+    """`python bench.py fused` — the r10/r19 A/B artifact (BENCH_r19's
+    producer) at tiny shapes: paired staged/fused legs, the flowspeed
+    baseline-vs-threaded+C-lanes legs, the thread-scaling curve and the
+    in-process lane-build sub-A/Bs all execute for real; only the
+    subprocess SIMD A/B is stubbed (a novec compile + fresh interpreter
+    spawns — its plumbing is exercised by the real bench run)."""
+    monkeypatch.setattr(bench, "FUSED_PAIRS", 1)
+    monkeypatch.setattr(bench, "FUSED_THREAD_POINTS", (2,))
+    monkeypatch.setattr(bench, "_simd_ab",
+                        lambda pairs=3: {"simd_ab_stubbed": True})
+    real_lanes = bench._lane_build_native_ab
+    monkeypatch.setattr(bench, "_lane_build_native_ab",
+                        lambda: real_lanes(pairs=2, reps=2))
+    real_r16 = bench._lane_build_ab
+    monkeypatch.setattr(bench, "_lane_build_ab",
+                        lambda: real_r16(pairs=2, reps=2))
+    bench.bench_fused()
+    out = _last_json(capsys)
+    assert out["metric"].startswith("e2e fused-dataplane A/B")
+    assert out["fused_flows_per_sec"] > 0
+    assert out["staged_flows_per_sec"] > 0
+    assert len(out["fused_pairs"]) == 1
+    assert out["flowspeed_baseline_flows_per_sec"] > 0
+    assert set(out["thread_scaling_flows_per_sec"]) == {"2"}
+    assert out["lane_build_native_speedup"] > 0
+    # the r19 attribution slot: the flowspeed leg built lanes in C
+    assert "lanes" in out["host_group_phases_flowspeed"]
+    assert out["host_group_phases_baseline"].get("lanes", 0.0) == 0.0
+    assert "nproc" in out
+
+
+def test_bench_kernels_staging(tiny_bench, capsys):
+    """`python bench.py kernels` — the SIMD A/B's per-leg timing body
+    (runs in subprocesses with FLOWDECODE_LIB in production)."""
+    from flow_pipeline_tpu import native as native_lib
+
+    if not native_lib.lanes_available():
+        pytest.skip("libflowdecode lacks the r19 kernels")
+    bench.bench_kernels()
+    out = _last_json(capsys)
+    assert out["metric"] == "r19 fused-kernel microbench"
+    for key in ("inv_ns_per_row", "cms_ns_per_row", "lanes_ns_per_row"):
+        assert out[key] > 0
+
+
 def test_bench_trace_staging(tiny_bench, capsys, tmp_path):
     bench.bench_trace(str(tmp_path / "trace"))
     out = _last_json(capsys)

@@ -1219,12 +1219,13 @@ class TestAbiContract:
 
     def test_repo_abi_covers_all_native_symbols(self):
         # the acceptance criterion: the rule parses and checks every
-        # bound symbol of the real library (12 as of r16 — decode/count/
-        # encode/hash_group + the 4 hs_* sketch kernels + the 2 hs_inv_*
-        # invertible kernels + the 2 ff_* fused-dataplane kernels). The
-        # fused kernels' cross-file calls INTO hs_* are declarations
-        # (semicolon-terminated), which the parser must not double-count
-        # as exports.
+        # bound symbol of the real library (16 as of r19 — decode/count/
+        # encode/hash_group + the threaded hash_group_mt twin + the 4
+        # hs_* sketch kernels + the 2 hs_inv_* invertible kernels + the
+        # 3 ff_* fused-dataplane kernels + the 2 ff_build_* lane
+        # builders). The fused kernels' cross-file calls INTO hs_* are
+        # declarations (semicolon-terminated), which the parser must not
+        # double-count as exports.
         from tools.flowlint import rules_abi
 
         exports = [f.name for f in rules_abi.parse_exports(REPO)]
@@ -1233,9 +1234,11 @@ class TestAbiContract:
         assert set(exports) == {
             "flow_decode_stream", "flow_count_frames",
             "flow_encode_stream", "flow_hash_group",
+            "flow_hash_group_mt",
             "hs_cms_update", "hs_cms_query", "hs_hh_prefilter",
             "hs_topk_merge", "hs_inv_update", "hs_inv_decode",
-            "ff_group_sum", "ff_fused_update",
+            "ff_group_sum", "ff_group_sum_mt", "ff_fused_update",
+            "ff_build_lanes", "ff_build_planes",
         }
         bound = rules_abi.parse_bound_symbols(os.path.join(
             REPO, "flow_pipeline_tpu", "native", "__init__.py"))

@@ -21,6 +21,8 @@ Layers:
 
 from __future__ import annotations
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -385,11 +387,157 @@ class TestFusedUpdateKernel:
         np.testing.assert_array_equal(eng.states[0].cms, before)
 
 
+# ---- thread-count determinism (r19 flowspeed) ------------------------------
+#
+# The threading contract the whole fused dataplane leans on: every
+# kernel's output is BIT-IDENTICAL at any thread count — the threaded
+# hash-group (per-key-range partitioning + per-partition stable sort),
+# the u64 wagg fold, the lane builders, and the full fused tree through
+# ff_fused_update, table AND invertible. `make fused-parity` runs this
+# sweep against a freshly built library.
+
+
+class TestThreadDeterminism:
+    @pytest.fixture
+    def rng(self):
+        return np.random.default_rng(41)
+
+    # n=5000 crosses the serial gate (4096) with 3 row blocks; n=40000
+    # spreads ~20 blocks over every worker; keyspace 50 forces heavy
+    # duplicate rows ACROSS blocks, so the original-row-order tie-break
+    # inside each hash group is actually exercised
+    @pytest.mark.parametrize("threads", [2, 8])
+    @pytest.mark.parametrize("n", [5000, 40000])
+    def test_hash_group_mt_matches_serial(self, rng, threads, n):
+        lanes = rng.integers(0, 50, size=(n, 3), dtype=np.uint32)
+        perm, starts, coll = native.hash_group(lanes)
+        perm_t, starts_t, coll_t = native.hash_group(lanes,
+                                                     threads=threads)
+        np.testing.assert_array_equal(perm_t, perm)
+        np.testing.assert_array_equal(starts_t, starts)
+        assert coll_t == coll
+
+    @pytest.mark.parametrize("threads", [2, 8])
+    def test_hash_group_mt_degenerate_shapes(self, threads):
+        # one group spanning every block, and n unique groups — the two
+        # partition-occupancy extremes
+        same = np.full((8192, 2), 9, np.uint32)
+        perm, starts, _ = native.hash_group(same, threads=threads)
+        np.testing.assert_array_equal(perm, np.arange(8192, dtype=np.int32))
+        np.testing.assert_array_equal(starts, [0])
+        uniq = np.arange(8192, dtype=np.uint32)[:, None]
+        p_ref, s_ref, _ = native.hash_group(uniq)
+        p_t, s_t, _ = native.hash_group(uniq, threads=threads)
+        np.testing.assert_array_equal(p_t, p_ref)
+        np.testing.assert_array_equal(s_t, s_ref)
+
+    @pytest.mark.parametrize("threads", [2, 8])
+    def test_group_sum_mt_matches_serial(self, rng, threads):
+        lanes = rng.integers(0, 64, size=(20000, 4), dtype=np.uint32)
+        vals = rng.integers(0, 1 << 40, size=(20000, 2), dtype=np.uint64)
+        uniq, sums, counts = native.group_sum(lanes, vals)
+        u_t, s_t, c_t = native.group_sum(lanes, vals, threads=threads)
+        np.testing.assert_array_equal(u_t, uniq)
+        np.testing.assert_array_equal(s_t, sums)
+        np.testing.assert_array_equal(c_t, counts)
+
+    def _tree_state(self, rng, threads, invertible):
+        """Drive the cascade+ddos tree at one thread count; return the
+        per-family state arrays + the per-round ddos tables."""
+        kwargs = dict(depth=2, width=64, capacity=8, batch_size=BS)
+        if invertible:
+            kwargs["hh_sketch"] = "invertible"
+        cfgs = [HeavyHitterConfig(
+                    key_cols=("proto", "src_port", "dst_port", "etype"),
+                    **kwargs),
+                HeavyHitterConfig(key_cols=("proto", "src_port"),
+                                  **kwargs),
+                HeavyHitterConfig(key_cols=("src_port",), **kwargs)]
+        plan = native.FusedPlan(
+            parent=np.asarray([-1, 0, 1], np.int64),
+            sel=np.asarray([0, 1, 1], np.int64),
+            sel_off=np.asarray([0, 0, 2, 3], np.int64),
+            depth=np.asarray([2, 2, 2], np.int64),
+            width=np.asarray([64, 64, 64], np.int64),
+            cap=np.asarray([8, 8, 8], np.int64),
+            conservative=np.asarray([0 if invertible else 1] * 3,
+                                    np.uint8),
+            prefilter=np.asarray([1, 1, 1], np.uint8),
+            admission_plain=np.asarray([0, 0, 0], np.uint8),
+            ddos_parent=1, ddos_sel=np.asarray([0], np.int64),
+            ddos_plane=1,
+            invertible=np.asarray([invertible] * 3, np.uint8))
+        engines = [HostSketchEngine([c], use_native="native")
+                   for c in cfgs]
+        for e in engines:
+            e.reset(0)
+        ddos = []
+        for _ in range(3):
+            lanes = rng.integers(0, 16, size=(6000, 4), dtype=np.uint32)
+            vals = rng.integers(0, 1 << 12, size=(6000, 2)) \
+                      .astype(np.float32)
+            states = [e.states[0] for e in engines]
+            ddos.append(native.fused_update(lanes, vals, plan, states,
+                                            do_sketch=True,
+                                            threads=threads))
+        return engines, ddos
+
+    @pytest.mark.parametrize("invertible", [False, True],
+                             ids=["table", "invertible"])
+    @pytest.mark.parametrize("threads", [2, 8])
+    def test_fused_tree_thread_sweep(self, threads, invertible):
+        """The full fused tree — cascade chain + ddos side table —
+        bit-identical between threads=1 and every swept count, for both
+        sketch families (6000 rows crosses the kernel's serial gates)."""
+        if invertible and not native.inv_available():
+            pytest.skip("libflowdecode lacks the invertible kernels")
+        ref_e, ref_d = self._tree_state(np.random.default_rng(43), 1,
+                                        invertible)
+        got_e, got_d = self._tree_state(np.random.default_rng(43),
+                                        threads, invertible)
+        for eng, ref in zip(got_e, ref_e):
+            s, r = eng.states[0], ref.states[0]
+            np.testing.assert_array_equal(s.cms, r.cms)
+            if invertible:
+                np.testing.assert_array_equal(s.keysum, r.keysum)
+                np.testing.assert_array_equal(s.keycheck, r.keycheck)
+            else:
+                np.testing.assert_array_equal(s.table_keys, r.table_keys)
+                np.testing.assert_array_equal(s.table_vals, r.table_vals)
+        for got, ref in zip(got_d, ref_d):
+            np.testing.assert_array_equal(got[0], ref[0])
+            np.testing.assert_array_equal(got[1], ref[1])
+
+    @pytest.mark.slow  # full e2e sweep (~7s); gated by `make fused-parity`
+    @pytest.mark.parametrize("threads", [2, 8])
+    @pytest.mark.parametrize("hh_sketch", ["table", "invertible"])
+    @pytest.mark.parametrize("fused", ["on", "off"])
+    def test_pipeline_thread_sweep(self, fused, hh_sketch, threads):
+        """End-to-end: the full pipeline (window rolls + late rows),
+        through ff_fused_update (fused=on) AND the staged path
+        (fused=off), emits identical windows and engine state at every
+        thread count — -ingest.threads is purely a throughput knob."""
+        if hh_sketch == "invertible" and not native.inv_available():
+            pytest.skip("libflowdecode lacks the invertible kernels")
+        batches = make_stream()
+        ref, rp = drive(cfg_models(hh_sketch=hh_sketch), batches,
+                        fused=fused, threads=1)
+        got, gp = drive(cfg_models(hh_sketch=hh_sketch), batches,
+                        fused=fused, threads=threads)
+        assert gp._engine.threads == threads
+        for (name, w), (_, w2) in zip(rp._hh, gp._hh):
+            np.testing.assert_array_equal(
+                np.asarray(w.model.state.cms),
+                np.asarray(w2.model.state.cms),
+                err_msg=f"{name} cms @ {threads} threads")
+        assert_models_identical(ref, got)
+
+
 # ---- pipeline layer --------------------------------------------------------
 
 
 def cfg_models(prefilter=True, admission="est", capacity=128,
-               families="cascade"):
+               families="cascade", hh_sketch="table"):
     """The test model family with configurable sketch knobs. families=
     "cascade" includes the 5-tuple parent the IP families regroup from;
     "flat" keeps only the (own, own) IP families; "noddos" drops the
@@ -404,6 +552,12 @@ def cfg_models(prefilter=True, admission="est", capacity=128,
             table_admission=admission)
 
     models = {"flows_5m": WindowAggregator(WindowAggConfig(batch_size=BS))}
+    if hh_sketch != "table":
+        base_cfg = hh_cfg
+
+        def hh_cfg(key_cols):  # noqa: F811 -- shadow with the family flip
+            return dataclasses.replace(base_cfg(key_cols),
+                                       hh_sketch=hh_sketch)
     if families != "minimal":
         if families in ("cascade", "nodense"):
             models["top_talkers"] = WindowedHeavyHitter(

@@ -386,6 +386,66 @@ class TestSnapshotFeed:
         assert 0 < s["delta_bytes"] < s["full_bytes"]
 
 
+@pytest.mark.slow  # worker + serve churn; gated by `make gateway-parity`
+class TestConditionalPolls:
+    """r19: ETag-conditional subscription polls (the r18 named
+    follow-on). A subscriber that is already current revalidates with
+    If-None-Match and the "none" answer costs HEADERS, NOT BYTES —
+    while a stale subscriber's etag can never mask a delta/full ship
+    (the etag encodes the CURRENT feed version, so it only matches a
+    poll whose since is already current)."""
+
+    def test_304_costs_headers_not_bytes(self):
+        _, pub = _run_worker()
+        serve = ServeServer(pub.store, port=0).start()
+        try:
+            cur = pub.store.current.version
+            # unconditional "none" poll: a real frame body every time
+            uncond = _get_raw(serve.port, f"/sub/snapshot?since={cur}")
+            assert len(uncond) > 0
+            # conditional: 304 with a ZERO-byte body — that frame's
+            # bytes are exactly what the etag saves per quiet poll
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{serve.port}/sub/snapshot"
+                f"?since={cur}",
+                headers={"If-None-Match": f'"sub-v{cur}"'})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 304
+            assert ei.value.read() == b""
+            assert ei.value.headers["ETag"] == f'"sub-v{cur}"'
+            # a STALE subscriber sending its own (old) etag still gets
+            # the full/delta body — the ship cannot be masked
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{serve.port}/sub/snapshot?since=0",
+                headers={"If-None-Match": '"sub-v0"'})
+            resp = urllib.request.urlopen(req, timeout=10)
+            assert resp.status == 200 and len(resp.read()) > 0
+        finally:
+            serve.stop()
+
+    def test_gateway_quiet_polls_ship_zero_bytes(self):
+        """The subscriber side: _Upstream.fetch sends the conditional
+        header, maps 304 to zero frames, and the mirror loop reads it
+        as a clean "none" — byte ledger checked at the fetch seam."""
+        worker, pub = _run_worker()
+        serve = ServeServer(pub.store, port=0).start()
+        gw = SnapshotGateway([f"127.0.0.1:{serve.port}"], poll=60)
+        try:
+            assert gw.sync_once() == "full"
+            up = gw.upstreams[0]
+            # quiet upstream: the conditional poll costs zero body bytes
+            assert up.fetch(up.version) == b""
+            assert gw.sync_once() == "none"
+            # a publish immediately lands as a delta — never masked
+            with worker.lock:
+                pub.publish(worker)
+            assert gw.sync_once() == "delta"
+            assert gw.store.current.version == pub.store.current.version
+        finally:
+            serve.stop()
+
+
 # ---- the bit-exactness gate ------------------------------------------------
 
 

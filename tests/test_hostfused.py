@@ -229,3 +229,140 @@ def test_worker_host_assist_vs_device_sink_rows():
         rows = [canon_rows(r) for r in sink.rows.get("flows_5m", [])]
         out[assist] = sorted(sum(rows, []))
     assert out["on"] == out["off"]
+
+
+# ---- native lane builders (r19 flowspeed) ----------------------------------
+#
+# ff_build_lanes / ff_build_planes consume the decoded columns directly
+# and must be BIT-EXACT twins of the numpy builders they replace
+# (_key_lanes_into / _value_planes_np / the wagg lane fill) — u64
+# saturation, u32->f32 rounding, the f32 scale multiply and the wagg
+# slot transform included — at every thread count. The numpy bodies
+# stay as the fallback for a pre-r19 library, so the pipeline-level
+# test drives both and compares whole model outputs.
+
+
+from flow_pipeline_tpu import native as _native  # noqa: E402
+
+
+@pytest.mark.skipif(
+    not _native.lanes_available(),
+    reason="libflowdecode lacks the lane builders; run `make native`")
+class TestLaneBuilders:
+    def _cols(self, rng, n=6000):
+        """A decoded-column dict covering every lane shape: scalar u32,
+        [n, 4] address words, and u64 columns with values PAST the u32
+        saturation point (the edge _u32_lane clamps)."""
+        big = rng.integers(0, 1 << 40, size=n, dtype=np.uint64)
+        big[:8] = [0, 1, 0xFFFFFFFF, 0x100000000, (1 << 64) - 1,
+                   0xFFFFFFFE, 0x100000001, 42]
+        return {
+            "proto": rng.integers(0, 256, size=n).astype(np.uint32),
+            "src_port": rng.integers(0, 1 << 16, size=n).astype(np.uint32),
+            "src_addr": rng.integers(0, 1 << 32, size=(n, 4),
+                                     dtype=np.uint64).astype(np.uint32),
+            "bytes": big,
+            "packets": rng.integers(0, 1 << 34, size=n, dtype=np.uint64),
+            "sampling_rate": rng.integers(0, 4, size=n, dtype=np.uint64),
+            "time_received": rng.integers(0, 1 << 33, size=n,
+                                          dtype=np.uint64),
+        }
+
+    @pytest.mark.parametrize("threads", [1, 2, 8])
+    @pytest.mark.parametrize("key_cols", [
+        ("proto",), ("src_addr",), ("proto", "src_port", "src_addr"),
+        ("src_addr", "bytes", "proto")])
+    def test_key_lanes_match_numpy_twin(self, rng, threads, key_cols):
+        from flow_pipeline_tpu import native
+        from flow_pipeline_tpu.engine.hostfused import _key_lanes_into
+
+        cols = self._cols(rng)
+        got = native.build_lanes([cols[c] for c in key_cols],
+                                 threads=threads)
+        np.testing.assert_array_equal(got, _key_lanes_into(cols, key_cols))
+
+    @pytest.mark.parametrize("threads", [1, 2, 8])
+    def test_wagg_lanes_slot_transform(self, rng, threads):
+        """The wagg layout: the slot lane is time_received saturated
+        then snapped to the window boundary (v - v % window), followed
+        by key lanes and the rate lane — one native pass vs the numpy
+        fill."""
+        from flow_pipeline_tpu import native
+
+        cols = self._cols(rng)
+        window = 300
+        got = native.build_lanes(
+            [cols["time_received"], cols["proto"], cols["src_addr"],
+             cols["sampling_rate"]],
+            mods=[window, 0, 0, 0], threads=threads)
+        t = np.minimum(cols["time_received"],
+                       np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        slot = t - t % np.uint32(window)
+        want = np.concatenate(
+            [slot[:, None], cols["proto"][:, None], cols["src_addr"],
+             np.minimum(cols["sampling_rate"],
+                        np.uint64(0xFFFFFFFF)).astype(np.uint32)[:, None]],
+            axis=1)
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("threads", [1, 2, 8])
+    @pytest.mark.parametrize("scale", [None, "sampling_rate"])
+    def test_value_planes_f32_match_numpy_twin(self, rng, threads, scale):
+        from flow_pipeline_tpu import native
+        from flow_pipeline_tpu.engine.hostfused import _value_planes_np
+
+        cols = self._cols(rng)
+        value_cols = ("bytes", "packets")
+        got = native.build_planes_f32(
+            [cols[c] for c in value_cols],
+            scale=cols[scale] if scale else None, threads=threads)
+        want = _value_planes_np(cols, value_cols, scale)
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("threads", [1, 2, 8])
+    def test_value_planes_u64_match_wagg_twin(self, rng, threads):
+        from flow_pipeline_tpu import native
+
+        cols = self._cols(rng)
+        value_cols = ("bytes", "packets")
+        got = native.build_planes_u64([cols[c] for c in value_cols],
+                                      threads=threads)
+        want = np.stack([np.minimum(cols[c], np.uint64(0xFFFFFFFF))
+                         for c in value_cols], axis=1)
+        np.testing.assert_array_equal(got, want)
+
+    def test_empty_batch(self):
+        from flow_pipeline_tpu import native
+
+        out = native.build_lanes([np.zeros(0, np.uint32),
+                                  np.zeros((0, 4), np.uint32)])
+        assert out.shape == (0, 5)
+        assert native.build_planes_u64([np.zeros(0, np.uint64)]).shape \
+            == (0, 1)
+
+    def test_pipeline_native_vs_numpy_lanes(self):
+        """Whole-model parity: the same stream through the host sketch
+        pipeline with native lane building live vs forced onto the
+        numpy fallback — identical windows, tables and alerts (the
+        degradation path IS the bit-exact twin)."""
+        from flow_pipeline_tpu.hostsketch import HostSketchPipeline
+
+        def run(native_lanes: bool):
+            models = make_models(WINDOW, 100)
+            pipe = HostSketchPipeline(models)
+            if not native_lanes:
+                pipe._native_lanes = False
+            else:
+                assert pipe._native_lanes, "lane builders not live"
+            for b in make_stream():
+                pipe.update(b)
+            pipe.sync_states()
+            return models
+
+        # flush-compare: flows_5m rows bit-for-bit, every hh family's
+        # windows
+        a, b = run(True), run(False)
+        assert canon_rows(a["flows_5m"].flush(True)) == \
+            canon_rows(b["flows_5m"].flush(True))
+        for name in ("top_talkers", "top_src_ips", "top_dst_ips"):
+            assert_same_windows(a[name].flush(True), b[name].flush(True))

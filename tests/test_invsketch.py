@@ -502,7 +502,7 @@ class TestEngineAndModel:
 
 
 def _run_worker(hh_sketch, fused, sketch_backend="host", n_flows=30_000,
-                audit="off"):
+                audit="off", extra_flags=()):
     from flow_pipeline_tpu.cli import (_batch_frames, _build_models,
                                        _common_flags, _gen_flags,
                                        _make_generator, _processor_flags)
@@ -513,7 +513,8 @@ def _run_worker(hh_sketch, fused, sketch_backend="host", n_flows=30_000,
     fs = _processor_flags(_gen_flags(_common_flags(FlagSet("t"))))
     vals = fs.parse(["-produce.profile", "zipf", "-hh.sketch", hh_sketch,
                      "-zipf.keys", "400", "-model.ports=false",
-                     "-model.ddos=false", "-sketch.capacity", "512"])
+                     "-model.ddos=false", "-sketch.capacity", "512",
+                     *extra_flags])
     bus = InProcessBus()
     bus.create_topic("flows", 2)
     gen = _make_generator(vals)
@@ -689,3 +690,70 @@ class TestMergeCodec:
         assert out[0, 0, 0] == np.uint64(2**53 + 1)
         out[0, 0, 0] = 0  # fresh copy, never aliases engine state
         assert st.cms[0, 0, 0] == np.uint64(2**53 + 1)
+
+
+# ---------------------------------------------------------------------------
+# -hh.sketch=auto: the r19 cascade flip (cli._build_models)
+# ---------------------------------------------------------------------------
+
+
+class TestAutoSketchResolution:
+    """`auto` (the r19 default) flips CASCADE families — key sets that
+    are strict subsets of another enabled hh family's — to the
+    invertible sketch when the host sketch dataplane serves; root
+    families and every non-host deployment keep the table family, so a
+    default worker never lands on the per-model numpy fallback."""
+
+    def _models(self, *flags):
+        from flow_pipeline_tpu.cli import (_build_models, _common_flags,
+                                           _gen_flags, _processor_flags)
+        from flow_pipeline_tpu.utils.flags import FlagSet
+
+        fs = _processor_flags(_gen_flags(_common_flags(FlagSet("t"))))
+        return _build_models(fs.parse(list(flags)))
+
+    def _sketch(self, models):
+        return {name: m.model.config.hh_sketch
+                for name, m in models.items()
+                if getattr(getattr(m, "model", None), "snapshot_kind",
+                           None) == "windowed_hh"}
+
+    def test_auto_flips_cascade_families_on_host_backend(self):
+        got = self._sketch(self._models("-sketch.backend", "host"))
+        assert got == {"top_talkers": "table",
+                       "top_src_ips": "invertible",
+                       "top_dst_ips": "invertible"}
+
+    def test_auto_keeps_table_off_host_backend(self):
+        # device backend: the invertible family would fall back to the
+        # per-model numpy path — auto must never choose that
+        got = self._sketch(self._models())
+        assert set(got.values()) == {"table"}
+
+    def test_auto_keeps_table_without_cascade_parent(self):
+        # no talkers family -> the IP families are roots, not cascades
+        got = self._sketch(self._models("-sketch.backend", "host",
+                                        "-model.talkers=false"))
+        assert got == {"top_src_ips": "table", "top_dst_ips": "table"}
+
+    def test_explicit_override_beats_auto(self):
+        got = self._sketch(self._models("-sketch.backend", "host",
+                                        "-hh.sketch", "invertible"))
+        assert set(got.values()) == {"invertible"}
+        got = self._sketch(self._models("-sketch.backend", "host",
+                                        "-hh.sketch", "table"))
+        assert set(got.values()) == {"table"}
+
+    @pytest.mark.slow  # two full workers; gated by `make invertible-parity`
+    def test_auto_exact_regime_equals_table_bit_for_bit(self):
+        """The flip's exactness pin: capacity (512) >= distinct keys
+        (400-key zipf), so BOTH families are in their exact regime and
+        the auto worker's sink rows — cascade families invertible,
+        root table — must be bit-identical to the all-table worker's."""
+        if not (native.fused_available() and native.inv_available()):
+            pytest.skip("fused native dataplane not built")
+        auto = _run_worker("auto", "on",
+                           extra_flags=("-sketch.backend", "host"))
+        table = _run_worker("table", "on",
+                            extra_flags=("-sketch.backend", "host"))
+        _assert_tables_equal(auto, table)

@@ -46,7 +46,14 @@ Workload per thread and why:
   on thread-private state at several internal thread counts with a
   byte-identical determinism oracle, truncated/odd-length batches, n=0,
   capacity-1 tables, a linear-mass invariant on the root CMS, and the
-  malformed-plan rejection paths (root with a parent, bad ddos plane).
+  malformed-plan rejection paths (root with a parent, bad ddos plane);
+- r19 flowspeed kernels (``ff_build_lanes`` / ``ff_build_planes`` /
+  ``flow_hash_group_mt`` / ``ff_group_sum_mt``): lane building off
+  mixed u32/u64/[n,4] columns with saturation-edge values and the wagg
+  slot transform, numpy-twin equality AND thread-count determinism
+  oracles, batches crossing the internal serial gates (n > 4096 — the
+  per-key-range partitioned sort actually engages under TSan),
+  inconsistent-layout rejection before any write.
 
 Exit 0 = clean run; prints one JSON summary line.
 """
@@ -230,6 +237,10 @@ def _thread_work(native, tid: int, iters: int, batch, data: bytes,
             # 8) invertible sketch family: per-bucket fold + peel decode
             if native.inv_available():
                 _inv_work(native, rng, it)
+            # 9) r19 flowspeed: native lane builders + the threaded
+            #    groupby kernels (big batches cross their serial gates)
+            if native.lanes_available():
+                _lanes_work(native, rng, it)
     except Exception as e:  # noqa: BLE001 — collected for the exit code
         errors.append(f"thread {tid}: {type(e).__name__}: {e}")
 
@@ -562,6 +573,83 @@ def _inv_work(native, rng, it: int) -> None:
             assert np.array_equal(a.keycheck, b.keycheck)
 
 
+def _lanes_work(native, rng, it: int) -> None:
+    """One r19 flowspeed stress round: lane building + the threaded
+    groupby kernels on thread-private buffers.
+
+    Oracles: numpy-twin equality (the bit-exactness contract the
+    builders ship under) and thread-count determinism. Every fourth
+    round uses n > 4096 so flow_hash_group_mt's partitioned sort and
+    the fold kernels' threaded paths actually engage — smaller batches
+    take the serial gates, which is itself part of the contract."""
+    import numpy as np
+
+    u32max = np.uint64(0xFFFFFFFF)
+    n = int(rng.integers(1, 900))
+    if it % 4 == 0:
+        n = int(rng.integers(4097, 12000))  # cross the serial gates
+    scalar32 = rng.integers(0, 1 << 16, size=n).astype(np.uint32)
+    # u64 column straddling the saturation edge
+    big = rng.integers(0, 1 << 36, size=n, dtype=np.uint64)
+    big[:: max(n // 7, 1)] = (1 << 64) - 1
+    addr = rng.integers(0, 1 << 32, size=(n, 4), dtype=np.uint64) \
+              .astype(np.uint32)
+    rate = rng.integers(0, 5, size=n, dtype=np.uint64)
+    window = int(rng.integers(1, 600))
+    builds = []
+    for threads in (1, 2, 8):
+        lanes = native.build_lanes([big, scalar32, addr, rate],
+                                   mods=[window, 0, 0, 0],
+                                   threads=threads)
+        builds.append(lanes)
+    for b in builds[1:]:
+        assert np.array_equal(b, builds[0]), "build_lanes nondeterminism"
+    lanes = builds[0]
+    sat = np.minimum(big, u32max).astype(np.uint32)
+    want0 = sat - sat % np.uint32(window)
+    assert np.array_equal(lanes[:, 0], want0), "slot transform mismatch"
+    assert np.array_equal(lanes[:, 1], scalar32)
+    assert np.array_equal(lanes[:, 2:6], addr)
+    assert np.array_equal(lanes[:, 6], rate.astype(np.uint32))
+    # f32 planes with the sampling-rate scale vs the numpy rounding
+    f32s = [native.build_planes_f32([big, scalar32], scale=rate,
+                                    threads=t) for t in (1, 8)]
+    assert np.array_equal(f32s[0], f32s[1]), "build_planes nondeterminism"
+    r = np.maximum(rate.astype(np.uint32).astype(np.float32), 1.0)
+    want = np.stack([np.minimum(big, u32max).astype(np.uint32)
+                     .astype(np.float32),
+                     scalar32.astype(np.float32)], axis=1) * r[:, None]
+    assert np.array_equal(f32s[0], want), "f32 planes != numpy twin"
+    u64s = native.build_planes_u64([big, scalar32], threads=8)
+    assert np.array_equal(
+        u64s, np.stack([np.minimum(big, u32max),
+                        scalar32.astype(np.uint64)], axis=1)), \
+        "u64 planes != numpy twin"
+    # threaded groupby twins: bit-identical to the serial kernels
+    key_lanes = np.ascontiguousarray(lanes[:, :2])
+    p1, s1, c1 = native.hash_group(key_lanes)
+    p8, s8, c8 = native.hash_group(key_lanes, threads=8)
+    assert np.array_equal(p1, p8) and np.array_equal(s1, s8) \
+        and c1 == c8, "hash_group_mt nondeterminism"
+    gs1 = native.group_sum(key_lanes, u64s)
+    gs8 = native.group_sum(key_lanes, u64s, threads=8)
+    assert (gs1 is None) == (gs8 is None)
+    if gs1 is not None:
+        for a, b in zip(gs1, gs8):
+            assert np.array_equal(a, b), "group_sum_mt nondeterminism"
+    # inconsistent layouts rejected before any write
+    try:
+        native.build_lanes([big], mods=[window, 0])
+        raise AssertionError("mods/columns length mismatch accepted")
+    except ValueError:
+        pass
+    try:
+        native.build_planes_f32([addr])
+        raise AssertionError("2-D value column accepted")
+    except ValueError:
+        pass
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", choices=("plain", "san", "tsan"),
@@ -611,6 +699,7 @@ def main(argv=None) -> int:
         "adversarial_buffers": len(adversarial),
         "sketch_covered": native.sketch_available(),
         "fused_covered": native.fused_available(),
+        "lanes_covered": native.lanes_available(),
         **abi,
         "seconds": round(dt, 2),
         "errors": errors,
