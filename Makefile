@@ -6,7 +6,7 @@
 .PHONY: all native test bench proto clean services-test lint native-san \
 	hostsketch-parity fused-parity fused-parity-traced mesh-parity \
 	mesh-parity-traced serve-load audit-parity invertible-parity \
-	chaos-parity gateway-parity
+	chaos-parity gateway-parity guard-parity
 
 all: native
 
@@ -126,6 +126,17 @@ chaos-parity:
 # 5xx with monotone versions (docs/ARCHITECTURE.md "flowgate").
 gateway-parity:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_gateway.py -v
+
+# flowguard (guard/): the overload-control gates — level-0 output
+# bit-exact vs the guard-free oracle (worker AND mesh paths; a disarmed
+# or armed-but-idle guard must perturb nothing), the deterministic shed
+# set reproduced across reruns and mesh members, scaled estimates
+# unbiased through sampled admission, and the 2x overload soak (paced
+# producer + injected sink delay) holding memory and lag bounded with
+# zero crashes, zero serve 5xx, and exact shed accounting
+# (consumed = emitted + shed) — docs/FAULT_TOLERANCE.md "flowguard".
+guard-parity:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_guard.py -v
 
 # sketchwatch (obs/audit.py): the accuracy-observability suite — the
 # audit must be purely observational (audit-on vs audit-off sink rows

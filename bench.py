@@ -951,6 +951,91 @@ def _simd_ab(pairs: int = 3) -> dict:
     return out
 
 
+def _degraded_np_ab(pairs: int = 3, n_chunks: int = 40) -> dict:
+    """Degraded no-native sub-A/B (ROADMAP 3c): the numpy twin of the
+    host sketch engine's grouped update step, r19-shaped (one murmur
+    pass per consumer — the admission query rehashed every chunk — and
+    stack+reduce min queries) vs r20 (ONE murmur pass reused across
+    the CMS update and the admission query, prefilter subsetting the
+    precomputed bucket columns, running-min query). Unique-key group
+    tables at the flagship 5-tuple config — the shape the pipeline
+    actually feeds the engine. Both legs are bit-exact twins; the A/B
+    is purely the cost of graceful degradation."""
+    import numpy as np
+
+    from flow_pipeline_tpu.hostsketch import engine as hs_engine
+    from flow_pipeline_tpu.hostsketch.state import host_hh_init
+    from flow_pipeline_tpu.models.heavy_hitter import HeavyHitterConfig
+    from flow_pipeline_tpu.ops.hostgroup import hash_u64
+
+    cfg = HeavyHitterConfig(
+        key_cols=("src_addr", "dst_addr", "src_port", "dst_port",
+                  "proto"),
+        batch_size=4096, width=1 << 13, capacity=512)
+    rng = np.random.default_rng(0)
+    kw = host_hh_init(cfg).table_keys.shape[1]
+    b = 4096
+    chunks = []
+    for _ in range(n_chunks):
+        uniq = np.zeros((b, kw), np.uint32)
+        uniq[:, :5] = rng.integers(0, 2**32, size=(b, 5),
+                                   dtype=np.int64).astype(np.uint32)
+        chunks.append((uniq, rng.random((b, 3)).astype(np.float32) * 1e4))
+
+    def r19_update(st, uniq, sums):
+        depth, width = st.cms.shape[1], st.cms.shape[2]
+        buckets = hs_engine._np_buckets(uniq, depth, width)
+        add = hs_engine._addend_u64(sums)
+        est0 = np.stack([st.cms[:, d, buckets[d]]
+                         for d in range(depth)]).min(axis=0).T
+        target = est0 + add
+        for pi in range(st.cms.shape[0]):
+            for d in range(depth):
+                np.maximum.at(st.cms[pi, d], buckets[d], target[:, pi])
+        th = (hash_u64(np.ascontiguousarray(st.table_keys))
+              >> np.uint64(32)).astype(np.uint32)
+        gh = (hash_u64(uniq) >> np.uint64(32)).astype(np.uint32)
+        ts = np.sort(th)
+        pos = np.clip(np.searchsorted(ts, gh), 0, cfg.capacity - 1)
+        metric = sums[:, 0].copy()
+        metric[ts[pos] == gh] = np.float32(np.inf)
+        sel = np.argsort(-metric, kind="stable")[:2 * cfg.capacity]
+        uniq, sums = uniq[sel], sums[sel]
+        b2 = hs_engine._np_buckets(uniq, depth, width)  # the rehash
+        est = np.stack([st.cms[:, d, b2[d]]
+                        for d in range(depth)]).min(axis=0).T \
+            .astype(np.float32)
+        st.table_keys, st.table_vals = hs_engine.np_topk_merge(
+            st.table_keys, st.table_vals, uniq, sums, est)
+
+    def leg_old():
+        st = host_hh_init(cfg)
+        t0 = time.perf_counter()
+        for uniq, sums in chunks:
+            r19_update(st, uniq, sums)
+        dt = time.perf_counter() - t0
+        return {"value": n_chunks * b / dt}
+
+    def leg_new():
+        eng = hs_engine.HostSketchEngine([cfg], use_native="numpy")
+        eng.reset(0)
+        t0 = time.perf_counter()
+        for uniq, sums in chunks:
+            eng.update(0, uniq, sums, b)
+        dt = time.perf_counter() - t0
+        return {"value": n_chunks * b / dt}
+
+    old_runs, new_runs, ratios = _paired_e2e_ab(leg_old, leg_new,
+                                                pairs=pairs)
+    return {
+        "degraded_np_r19_groups_per_sec": _med(old_runs, "value"),
+        "degraded_np_r20_groups_per_sec": _med(new_runs, "value"),
+        "degraded_np_speedup": round(statistics.median(ratios), 3)
+        if ratios else 0.0,
+        "degraded_np_pairs": [round(r, 3) for r in ratios],
+    }
+
+
 def _paired_e2e_ab(leg_a, leg_b, pairs: int = 3):
     """Paired alternating-order e2e A/B (the r11 methodology, promoted
     to the shared harness): legs run in adjacent pairs so slow host
@@ -1116,6 +1201,9 @@ def bench_fused() -> None:
         **_lane_build_ab(),
         # r19 lane-build sub-A/B: numpy twins vs ff_build_lanes/planes
         **_lane_build_native_ab(),
+        # r20 degraded-mode sub-A/B (ROADMAP 3c): the numpy engine's
+        # grouped update, r19-shaped vs hash-reuse fast path
+        **_degraded_np_ab(),
         # r19 SIMD sub-A/B: vectorized vs -fno-tree-vectorize builds
         **_simd_ab(),
         "stages_staged": staged["stages"],
@@ -1683,6 +1771,203 @@ def bench_chaos() -> None:
             "call at p~0 — the worst case; the true faults-off path is "
             "one attribute read per seam. Median per-pair ratio is the "
             "honest overhead and can dip negative on throttled boxes."),
+    }))
+
+
+GUARD_FLOWS = 300_000
+GUARD_PAIRS = 3
+GUARD_PARTITIONS = 2
+GUARD_OVERLOAD_SECONDS = 6.0
+GUARD_OVERLOAD_MAX_FLOWS = 2_000_000  # backlog cap: the in-process bus
+# shares this process's RSS, so the 2x leg bounds its own offered total
+# the overload leg's chaos plan: a coin-flipped poll stall (the
+# slow-dependency shape) + a sink-write stall at window close — both
+# counted on faults_delayed_total, neither ever failing a call
+GUARD_OVERLOAD_FAULTS = "bus.poll:p=0.2:delay=0.01;sink.write:delay=0.02@seed=11"
+
+
+def bench_guard() -> None:
+    """flowguard acceptance artifact (r20): (1) the armed-but-idle
+    paired A/B — the full host-backend e2e worker with the guard
+    DISARMED (-guard.lag=0, the exact default: every guard seam is one
+    attribute read) vs ARMED with a budget the stream never approaches
+    (the worst case that still stays at level 0: a per-batch lag
+    observe + the optional-work flag writes), adjacent alternating-
+    order pairs (r11 methodology); budget <2% median. (2) the overload
+    leg: a paced producer offers 2x the measured disarmed capacity for
+    a fixed wall interval under injected poll/sink delay faults while
+    the armed worker rides the degradation ladder — records the level
+    reached, the shed fraction, peak RSS, max observed watermark lag,
+    and the exact accounting identity produced == admitted + shed."""
+    global _NATIVE
+    _NATIVE = _ensure_native()
+    import resource
+    import threading as _threading
+
+    from flow_pipeline_tpu.cli import (_build_models, _common_flags,
+                                       _gen_flags, _make_generator,
+                                       _processor_flags, _worker_config)
+    from flow_pipeline_tpu.engine import StreamWorker
+    from flow_pipeline_tpu.guard import GuardConfig
+    from flow_pipeline_tpu.mesh import produce_sharded
+    from flow_pipeline_tpu.sink import MemorySink, ResilientSink
+    from flow_pipeline_tpu.transport import Consumer, InProcessBus
+    from flow_pipeline_tpu.utils.faults import FAULTS
+    from flow_pipeline_tpu.utils.flags import FlagSet
+
+    def vals_for(*extra):
+        fs = _processor_flags(_gen_flags(_common_flags(FlagSet("bench"))))
+        # flows5m + talkers keep the leg wall time in budget while still
+        # exercising the grouped host dataplane the admission wrapper
+        # fronts (the guard seams are per-batch, not per-model)
+        return fs.parse(["-produce.profile", "zipf",
+                         "-zipf.keys", "20000",
+                         "-model.ports=false", "-model.ddos=false",
+                         "-model.ips=false",
+                         "-processor.batch", "4096",
+                         "-sketch.backend", "host", *extra])
+
+    def fill_bus(vals, n_flows):
+        bus = InProcessBus()
+        bus.create_topic("flows", GUARD_PARTITIONS)
+        gen = _make_generator(vals)
+        done = 0
+        while done < n_flows:
+            n = min(16384, n_flows - done)
+            done += produce_sharded(bus, "flows", gen.batch(n),
+                                    GUARD_PARTITIONS)
+        return bus
+
+    def worker_for(vals, bus, sinks=()):
+        return StreamWorker(Consumer(bus, "flows", fixedlen=True),
+                            _build_models(vals), list(sinks),
+                            _worker_config(vals))
+
+    def leg(guard_lag):
+        vals = vals_for("-guard.lag", str(guard_lag))
+        bus = fill_bus(vals, GUARD_FLOWS)
+        w = worker_for(vals, bus)
+        t0 = time.perf_counter()
+        w.run(stop_when_idle=True)
+        elapsed = time.perf_counter() - t0
+        assert w.flows_seen == GUARD_FLOWS  # level 0 throughout: no shed
+        return {"value": GUARD_FLOWS / max(elapsed, 1e-9)}
+
+    leg(0.0)  # untimed warmup: jit compilation must not land in pair 0
+    off_runs, armed_runs, ratios = _paired_e2e_ab(
+        # armed budget 1e6 s: the ladder never engages, so the leg
+        # measures exactly the armed-but-level-0 observe cost
+        lambda: leg(0.0), lambda: leg(1e6), pairs=GUARD_PAIRS)
+    overhead = (100 * (1 - statistics.median(ratios))) if ratios else 0.0
+    capacity = statistics.median(r["value"] for r in off_runs)
+
+    # ---- (2) the 2x-overload leg -------------------------------------------
+    vals = vals_for("-guard.lag", "0.5")
+    bus = InProcessBus()
+    bus.create_topic("flows", GUARD_PARTITIONS)
+    sink = ResilientSink(MemorySink(), retries=2)
+    w = worker_for(vals, bus, [sink])
+    # bench-cadence ladder: the default 5 s dwell is production tuning
+    # (one transition per dwell); a 6 s leg needs the ladder able to
+    # actually climb while the soak runs
+    w.guard.config = GuardConfig(lag_budget=0.5, max_level=6,
+                                 hysteresis=0.5, dwell=0.3)
+    gen = _make_generator(vals)
+    offered_rate = 2.0 * capacity
+    produced = 0
+    max_lag = 0.0
+    done = _threading.Event()
+
+    def producer():
+        nonlocal produced, max_lag
+        t_start = time.perf_counter()
+        while True:
+            t = time.perf_counter() - t_start
+            if t >= GUARD_OVERLOAD_SECONDS:
+                break
+            target = min(int(min(t + 0.05, GUARD_OVERLOAD_SECONDS)
+                             * offered_rate), GUARD_OVERLOAD_MAX_FLOWS)
+            while produced < target:
+                n = min(16384, target - produced)
+                produced += produce_sharded(bus, "flows", gen.batch(n),
+                                            GUARD_PARTITIONS)
+            max_lag = max(max_lag, w.guard.m_lag.value())
+            time.sleep(0.05)
+        done.set()
+
+    FAULTS.configure(GUARD_OVERLOAD_FAULTS)
+    rss_before_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    prod_thread = _threading.Thread(target=producer, daemon=True)
+    t0 = time.perf_counter()
+    prod_thread.start()
+    try:
+        # run_once-driven loop instead of run(stop_when_idle=True): a
+        # transient idle poll while the paced producer sleeps must not
+        # end the leg early — only idle AFTER production finishes does
+        while True:
+            if w.run_once():
+                continue
+            if done.is_set():
+                break
+            time.sleep(0.002)
+        w.finalize()
+    finally:
+        # snapshot BEFORE configure(None): clearing the plan drops the
+        # per-site roll/delay counters the artifact records
+        delay_snapshot = FAULTS.snapshot()
+        FAULTS.configure(None)
+        if w.executor is not None:
+            w.executor.stop()
+        if w.flusher is not None:
+            w.flusher.stop()
+    elapsed = time.perf_counter() - t0
+    prod_thread.join()
+    rss_after_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    meta = w.guard.meta()
+    shed = meta["shed_total"]
+
+    print(json.dumps({
+        "metric": "flowguard armed-idle overhead (paired A/B) + 2x "
+                  "overload leg",
+        "unit": "flows/sec",
+        "flows_per_leg": GUARD_FLOWS,
+        "value": round(capacity, 1),
+        "guard_overhead_pct": round(overhead, 2),
+        "guard_overhead_pairs_pct": [round(100 * (1 - r), 2)
+                                     for r in ratios],
+        "disarmed_flows_per_sec": round(capacity, 1),
+        "armed_idle_flows_per_sec": round(
+            statistics.median(r["value"] for r in armed_runs), 1)
+        if armed_runs else None,
+        "overhead_budget_pct": 2.0,
+        "within_budget": overhead < 2.0,
+        "overload_offered_flows_per_sec": round(offered_rate, 1),
+        "overload_seconds": GUARD_OVERLOAD_SECONDS,
+        "overload_fault_plan": GUARD_OVERLOAD_FAULTS,
+        "overload_produced": produced,
+        "overload_admitted": w.flows_seen,
+        "overload_shed": shed,
+        "overload_accounting_exact": produced == w.flows_seen + shed,
+        "overload_shed_fraction": round(shed / produced, 4)
+        if produced else 0.0,
+        "overload_max_level": meta["max_level_seen"],
+        "overload_final_level": meta["level"],
+        "overload_max_observed_lag_s": round(max_lag, 3),
+        "overload_elapsed_s": round(elapsed, 2),
+        "overload_faults_delayed": delay_snapshot,
+        "peak_rss_before_mb": round(rss_before_kb / 1024, 1),
+        "peak_rss_after_mb": round(rss_after_kb / 1024, 1),
+        "native_decode": _NATIVE,
+        "platform": _PLATFORM,
+        "host_note": (
+            "paired alternating-order disarmed/armed-idle legs (r11 "
+            "methodology; median per-pair ratio, can dip negative on "
+            "throttled boxes). The overload leg paces a producer at 2x "
+            "the measured disarmed capacity under injected poll/sink "
+            "delay faults with a bench-cadence ladder (dwell 0.3 s vs "
+            "the production 5 s); level-0 bit-exactness and the soak "
+            "gates live in `make guard-parity`, this artifact carries "
+            "the throughput/accounting shape."),
     }))
 
 
@@ -2451,6 +2736,8 @@ if __name__ == "__main__":
             bench_serve()
         elif mode == "chaos":
             bench_chaos()
+        elif mode == "guard":
+            bench_guard()
         elif mode == "sweep":
             bench_sweep()
         elif mode == "kernels":

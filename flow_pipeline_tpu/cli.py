@@ -351,8 +351,34 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
     fs.string("faults", "", "flowchaos deterministic fault plan, e.g. "
                             "'sink.write:p=0.05;mesh.submit:p=0.02"
                             "@seed=7' (empty disables; seams cost one "
-                            "attribute read when off)",
+                            "attribute read when off). delay=<s> makes "
+                            "a site inject LATENCY instead of failure — "
+                            "'sink.write:delay=0.02' stalls every "
+                            "write, 'bus.poll:p=0.5:delay=0.1' stalls "
+                            "half — the slow-dependency overload shape "
+                            "flowguard degrades under",
               env="FLOWTPU_FAULTS")
+    # flowguard (guard/): end-to-end overload control — bounded-buffer
+    # backpressure, the watermark-lag degradation ladder, read-side
+    # admission — see docs/FAULT_TOLERANCE.md "flowguard"
+    fs.number("guard.lag", 0.0,
+              "flowguard watermark-lag budget in seconds before the "
+              "degradation ladder engages: level 1 drops optional work "
+              "(audit cohort refresh, trace ring), levels >=2 are "
+              "deterministic hash-sampled admission at keep rate "
+              "1/2^(level-1) with unbiased scaled estimates; recovery "
+              "steps back up with hysteresis (0 = disarmed, the exact "
+              "default)")
+    fs.integer("guard.max_level", 6,
+               "flowguard ladder ceiling (6 = keep rate 1/32 at full "
+               "degradation)")
+    fs.integer("guard.serve_queue", 0,
+               "flowserve read-side admission: max concurrently "
+               "computing queries; past it + the deadline, 503 with "
+               "Retry-After (0 = unbounded, the default)")
+    fs.number("guard.serve_deadline", 0.1,
+              "flowserve admission deadline seconds a query may wait "
+              "for a compute slot before it is shed with 503")
     fs.integer("sink.retries", 4, "Sink write attempts before a batch "
                                   "is dead-lettered (with "
                                   "-sink.deadletter) or the step fails "
@@ -546,6 +572,8 @@ def _worker_config(vals) -> "WorkerConfig":
         ingest_native_group=vals["ingest.native_group"],
         ingest_fused=vals["ingest.fused"],
         obs_audit=vals["obs.audit"],
+        guard_lag=vals["guard.lag"],
+        guard_max_level=vals["guard.max_level"],
     )
 
 
@@ -559,7 +587,11 @@ def _start_serve_worker(vals, worker):
 
     pub = attach_worker(worker, refresh=vals["serve.refresh"])
     host, port = _host_port(vals["serve.addr"], 8083)
-    server = ServeServer(pub.store, port, host).start()
+    server = ServeServer(
+        pub.store, port, host,
+        max_inflight=vals["guard.serve_queue"],
+        deadline=vals["guard.serve_deadline"],
+    ).set_guard(worker.guard).start()
     return server, pub.store
 
 
@@ -573,7 +605,10 @@ def _start_serve_mesh(vals, coordinator):
 
     pub = attach_mesh(coordinator, refresh=vals["serve.refresh"])
     host, port = _host_port(vals["serve.addr"], 8083)
-    server = ServeServer(pub.store, port, host).start()
+    server = ServeServer(
+        pub.store, port, host,
+        max_inflight=vals["guard.serve_queue"],
+        deadline=vals["guard.serve_deadline"]).start()
     return server, pub
 
 
@@ -1111,6 +1146,19 @@ def gateway_main(argv=None) -> int:
     fs.string("faults", "", "flowchaos deterministic fault plan "
                             "(gateway.poll is the flowgate seam)",
               env="FLOWTPU_FAULTS")
+    fs.boolean("gateway.adopt-restart", False,
+               "Adopt an upstream RESTART automatically: when the "
+               "subscribed stream comes back with a lower version and "
+               "kind=full, swap to it (availability) instead of "
+               "holding the pre-restart snapshot until the upstream "
+               "version catches up (monotone reads, the default)")
+    fs.integer("guard.serve_queue", 0,
+               "flowguard read-side admission: max concurrently "
+               "computing queries on this replica; past it + the "
+               "deadline, 503 with Retry-After (0 = unbounded)")
+    fs.number("guard.serve_deadline", 0.1,
+              "flowguard admission deadline seconds a query may wait "
+              "for a compute slot before it is shed with 503")
     vals = fs.parse(argv if argv is not None else sys.argv[2:])
     set_level(vals["loglevel"])
     if not vals["gateway.upstream"]:
@@ -1125,9 +1173,13 @@ def gateway_main(argv=None) -> int:
     gw = SnapshotGateway(
         [u.strip() for u in vals["gateway.upstream"].split(",")
          if u.strip()],
-        poll=vals["gateway.poll"])
+        poll=vals["gateway.poll"],
+        adopt_restart=vals["gateway.adopt-restart"])
     host, port = _host_port(vals["gateway.listen"], 8084)
-    serve = ServeServer(gw.store, port, host).start()
+    serve = ServeServer(
+        gw.store, port, host,
+        max_inflight=vals["guard.serve_queue"],
+        deadline=vals["guard.serve_deadline"]).start()
     gw.serve_on(serve).start()
     log.info("flowgate replica serving %s on http://%s:%d/query",
              vals["gateway.upstream"], host, serve.port)

@@ -27,6 +27,7 @@ import queue
 import threading
 from typing import Optional
 
+from ..guard import register_guard_metrics
 from ..obs import get_logger
 
 log = get_logger("prefetch")
@@ -69,6 +70,17 @@ class PrefetchConsumer:
         self._error: Optional[BaseException] = None  # guarded-by: _cv
         # flowlint: unguarded -- worker-thread lifecycle only (poll()/stop() run on the one owner thread)
         self._thread: Optional[threading.Thread] = None
+        # flowguard occupancy: live bytes resident in the decoded-batch
+        # queue (guard_buffer_bytes{stage="feed"}) — bounded at depth
+        # batches by construction; this makes the occupancy observable
+        self.m_bytes = register_guard_metrics()["buffer_bytes"]
+        self._bytes = 0  # guarded-by: _cv
+
+    def _track_bytes(self, delta: int) -> None:
+        with self._cv:
+            self._bytes += delta
+            b = self._bytes
+        self.m_bytes.set(b, stage="feed")
 
     # ---- consumer surface --------------------------------------------------
 
@@ -96,7 +108,9 @@ class PrefetchConsumer:
             if self._error is not None:
                 raise self._error
             try:
-                return self._batches.get(timeout=self.idle_sleep)
+                batch = self._batches.get(timeout=self.idle_sleep)
+                self._track_bytes(-batch.nbytes())
+                return batch
             except queue.Empty:
                 if not self._thread.is_alive():
                     # the thread may have died DURING our get() — re-check
@@ -201,6 +215,7 @@ class PrefetchConsumer:
             self._idle.clear()
             self._completed_start = round_no
             self._batches.put(batch)
+            self._track_bytes(batch.nbytes())
         self._drain_commits()
 
     def _drain_commits(self) -> None:

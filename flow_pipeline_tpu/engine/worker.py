@@ -15,6 +15,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
 
+from ..guard import GuardConfig, GuardController
 from ..models.ddos import DDoSDetector
 from ..models.heavy_hitter import HHState
 from ..models.window_agg import WindowAggregator
@@ -33,6 +34,18 @@ from .prefetch import PrefetchConsumer
 from .windowed import WindowedHeavyHitter
 
 log = get_logger("worker")
+
+
+class _ShedPrep:
+    """Stand-in prepared object when flowguard admission sheds an ENTIRE
+    batch on the group thread: carries the (now empty) batch through the
+    executor so its offset range still reaches the commit path — shed
+    rows were consumed and accounted, never lost to replay."""
+
+    __slots__ = ("batch",)
+
+    def __init__(self, batch):
+        self.batch = batch
 
 
 @dataclass(frozen=True)
@@ -105,6 +118,13 @@ class WorkerConfig:
     # disables. Needs the host-grouped pipeline (CPU backend or
     # -processor.hostassist on) — elsewhere it quietly stays off.
     obs_audit: str = "sample"
+    # flowguard (-guard.lag, guard/): watermark-lag budget in seconds
+    # before the degradation ladder engages. 0 (the default) disarms
+    # the controller entirely — every exact-parity path runs untouched.
+    guard_lag: float = 0.0
+    # Ladder ceiling: level 1 drops optional work, levels 2..max are
+    # hash-sampled admission at keep rate 1/2^(level-1).
+    guard_max_level: int = 6
     # The role this worker's flow_build_info identity gauge publishes
     # under. A mesh member's INNER worker must identify as "member" —
     # publishing a second role="worker" series next to the member's
@@ -156,6 +176,16 @@ class StreamWorker:
             raise ValueError(
                 f"obs_audit must be off|sample|full, "
                 f"got {config.obs_audit!r}")
+        if config.guard_lag < 0:
+            raise ValueError(
+                f"guard_lag must be >= 0 (0 = disarmed), "
+                f"got {config.guard_lag}")
+        # flowguard: constructed unconditionally (its metric families
+        # must exist — as zeros — on every worker for the honesty
+        # tests), armed only when a lag budget is declared
+        self.guard = GuardController(GuardConfig(
+            lag_budget=config.guard_lag,
+            max_level=config.guard_max_level))
         # invertible hh families (-hh.sketch=invertible) have no jitted
         # table step: they are served by the host sketch pipeline
         # (staged or fused) or, failing that, the per-model numpy path
@@ -243,8 +273,11 @@ class StreamWorker:
                 log.info("ingest pipelined mode needs the prefetch wrap "
                          "(feed.prefetch > 0); using the serial path")
             elif isinstance(self.fused, HostGroupPipeline):
+                # the guard admission runs INSIDE the prepare wrapper on
+                # the group thread: shed rows never reach grouping, so
+                # degradation sheds the pre-aggregation cost too
                 self.executor = PipelinedExecutor(
-                    consumer, self.fused.prepare,
+                    consumer, self._prepare_admitted,
                     poll_max=config.poll_max, depth=config.ingest_depth)
                 self.flusher = AsyncFlusher(
                     max_queue=config.ingest_flush_queue)
@@ -384,19 +417,53 @@ class StreamWorker:
         if self.executor is not None:
             prep = self.executor.next()  # grouped off-thread (ingest)
             if prep is None:
+                if self.guard.armed:
+                    # idle = caught up: feed lag 0 so the ladder can
+                    # step back up without needing fresh traffic
+                    self.guard.observe(0.0)
                 return False
             with self.lock:
                 return self._process(prep.batch, prep)
         batch = self.consumer.poll(self.config.poll_max)
         if batch is None or len(batch) == 0:
+            if self.guard.armed:
+                self.guard.observe(0.0)
             return False
         with self.lock:
             return self._process(batch)
+
+    def _prepare_admitted(self, batch):
+        """Group-thread prepare with flowguard admission in FRONT of the
+        grouping pass, so shed rows never pay pre-aggregation. A stale
+        ``level`` read here sheds one batch at the previous level — the
+        per-row scale factor keeps even that exact."""
+        if self.guard.sample_shift > 0:
+            batch, _ = self.guard.admit(batch)
+            if len(batch) == 0:
+                return _ShedPrep(batch)
+        return self.fused.prepare(batch)
 
     def _process(self, batch, prep=None) -> bool:
         t0 = time.perf_counter()
         t0_wall = time.time()
         self._trace_chunk = getattr(batch, "chunk_id", -1)
+        guard = self.guard
+        if guard.armed:
+            # watermark lag = age of the backlog head (bus produce time
+            # -> this pickup); unstamped transports (Kafka) report 0.0
+            # and the ladder simply never engages for them
+            pa = getattr(batch, "produced_at", 0.0)
+            guard.observe(t0_wall - pa if pa > 0.0 else 0.0)
+            if prep is None and guard.sample_shift > 0:
+                # serial path (no group thread): admit here instead
+                batch, _ = guard.admit(batch)
+            # level >= 1 drops optional work FIRST: the audit cohort
+            # stops refreshing and the trace ring stops recording
+            # before any data does
+            aud = getattr(self.fused, "audit", None)
+            if aud is not None:
+                aud.paused = guard.drop_optional
+            TRACER.paused = guard.drop_optional
         if self.config.archive_raw:
             archived = False
             for sink in self.sinks:
@@ -412,7 +479,9 @@ class StreamWorker:
             # below), not snapshot_every batches' worth of raw rows.
             self._emitted_since_snapshot |= archived
         with self.stages.stage("processing"):
-            if prep is not None:
+            if len(batch) == 0:
+                pass  # fully shed upstream; offsets still commit below
+            elif prep is not None:
                 self.fused.apply(prep)  # prepare ran on the group thread
             elif self.fused is not None:
                 self.fused.update(batch)

@@ -78,7 +78,13 @@ class GatewayClient:
         # flowlint: unguarded -- the lock itself; bound once
         self._lock = threading.Lock()
         self._dead: dict[str, float] = {}  # node -> retry-at  # guarded-by: _lock
+        # flowguard: replicas that answered 503 + Retry-After are
+        # DEGRADED, not dead — deprioritized until the advertised
+        # retry time but still eligible as a last resort (an
+        # overloaded replica can answer; a dead one cannot)
+        self._degraded: dict[str, float] = {}  # node -> retry-at  # guarded-by: _lock
         self.retries = 0  # transport failovers taken  # guarded-by: _lock
+        self.deprioritized = 0  # 503-driven reroutes taken  # guarded-by: _lock
         # session watermark for monotone reads: the highest snapshot
         # version any response carried. A failover target slightly
         # behind it is re-polled briefly (it mirrors the same upstream
@@ -101,6 +107,20 @@ class GatewayClient:
             self._dead[node] = time.monotonic() + self.dead_for
             self.retries += 1
 
+    def _slow(self) -> set:
+        now = time.monotonic()
+        with self._lock:
+            for n, until in list(self._degraded.items()):
+                if until <= now:
+                    del self._degraded[n]
+            return set(self._degraded)
+
+    def _mark_degraded(self, node: str, retry_after: float) -> None:
+        with self._lock:
+            self._degraded[node] = time.monotonic() + max(
+                0.05, min(retry_after, 30.0))
+            self.deprioritized += 1
+
     def _conn_for(self, node: str):
         # one connection per (thread, node): http.client connections are
         # not thread-safe, and the closed-loop client model is
@@ -122,13 +142,18 @@ class GatewayClient:
         failover, never an error surfaced to the caller while any
         replica lives."""
         last_err: Exception | None = None
+        last_503: tuple[int, bytes] | None = None
         tried: set[str] = set()
         for _ in range(max(1, len(self.ring.nodes))):
-            node = self.ring.node_for(key or path,
-                                      skip=self._skip() | tried)
+            # preference order: healthy first, then degraded (they DO
+            # answer, just slowly), then through the dead set rather
+            # than failing a query the survivors could serve
+            node = self.ring.node_for(
+                key or path, skip=self._skip() | self._slow() | tried)
             if node is None:
-                # every replica is masked: retry through the dead set
-                # rather than failing a query the survivors could serve
+                node = self.ring.node_for(key or path,
+                                          skip=self._skip() | tried)
+            if node is None:
                 node = self.ring.node_for(key or path, skip=tried)
             if node is None:
                 break
@@ -136,7 +161,22 @@ class GatewayClient:
                 conn = self._conn_for(node)
                 conn.request("GET", path)
                 resp = conn.getresponse()
-                return resp.status, resp.read()
+                body = resp.read()
+                if resp.status == 503:
+                    ra = resp.getheader("Retry-After")
+                    if ra is not None:
+                        # flowguard overload shed: the replica is
+                        # degraded, not dead — deprioritize it for the
+                        # advertised interval and try another arc
+                        try:
+                            after = float(ra)
+                        except ValueError:
+                            after = 1.0
+                        self._mark_degraded(node, after)
+                        last_503 = (resp.status, body)
+                        tried.add(node)
+                        continue
+                return resp.status, body
             except (OSError, http.client.HTTPException) as e:
                 # HTTPException covers a replica killed MID-RESPONSE
                 # (IncompleteRead/BadStatusLine are NOT OSErrors) —
@@ -148,6 +188,11 @@ class GatewayClient:
                 stale = conns.pop(node, None)
                 if stale is not None:
                     stale.close()
+        if last_503 is not None:
+            # every replica is overloaded: surface the honest 503 (the
+            # caller can retry after the advertised interval) — a shed
+            # is an answer, a ConnectionError is an outage
+            return last_503
         raise ConnectionError(
             f"no gateway replica answered {path!r}") from last_err
 

@@ -167,9 +167,15 @@ class SnapshotGateway:
     streams and serve the primary through a local store."""
 
     def __init__(self, upstreams, poll: float = 0.25,
-                 timeout: float = 10.0, prerender: bool = True):
+                 timeout: float = 10.0, prerender: bool = True,
+                 adopt_restart: bool = False):
         if not upstreams:
             raise ValueError("at least one upstream is required")
+        # -gateway.adopt-restart: swap to an upstream's post-restart
+        # stream automatically (availability) instead of holding the
+        # pre-restart snapshot until an operator restarts this replica
+        # (monotone reads — the default)
+        self.adopt_restart = adopt_restart
         self.upstreams = [
             up if isinstance(up, _Upstream)
             else _Upstream(up, name=(up if isinstance(up, str)
@@ -266,7 +272,31 @@ class SnapshotGateway:
                 # live signal, but the log warns only at the full-frame
                 # restart moment, not every refused delta.
                 self._m["upstream_restarts"].inc(upstream=up.name)
-                if kind == "full":
+                if kind == "full" and self.adopt_restart:
+                    # -gateway.adopt-restart: the operator chose
+                    # availability — adopt the post-restart world now.
+                    # Only on a FULL frame (a self-consistent snapshot;
+                    # a refused delta still means our base is gone) and
+                    # still counted above: adoption is never silent.
+                    snap = up.store.adopt_snapshot(
+                        state_to_snapshot(up.state))
+                    if up is self.upstreams[0] and \
+                            self.server is not None:
+                        # the adopted world's version counter restarts:
+                        # a new-world version can collide with an
+                        # old-world cached response — drop them all
+                        self.server.invalidate_cache()
+                    log.warning(
+                        "gateway upstream %s republished v%d at or "
+                        "behind the served version — upstream restart "
+                        "ADOPTED (-gateway.adopt-restart): serving the "
+                        "new stream from v%d", up.name, up.version,
+                        snap.version)
+                    if up is self.upstreams[0] and \
+                            self.server is not None and self.prerender:
+                        self._m["prerendered"].inc(
+                            self.server.warm(self._hot_targets(snap)))
+                elif kind == "full":
                     log.warning(
                         "gateway upstream %s republished v%d at or "
                         "behind served v%d — upstream restart; replica "

@@ -1,0 +1,18 @@
+"""flowguard: end-to-end backpressure and deterministic overload shedding.
+
+See :mod:`flow_pipeline_tpu.guard.controller` for the design story.
+"""
+
+from .controller import (GUARD_METRICS, GUARD_SAMPLE_SEED, GuardConfig,
+                         GuardController, admission_mask, flow_key_lanes,
+                         register_guard_metrics)
+
+__all__ = [
+    "GUARD_METRICS",
+    "GUARD_SAMPLE_SEED",
+    "GuardConfig",
+    "GuardController",
+    "admission_mask",
+    "flow_key_lanes",
+    "register_guard_metrics",
+]

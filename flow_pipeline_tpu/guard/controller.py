@@ -1,0 +1,270 @@
+"""flowguard: the per-stage overload controller.
+
+Everything before r20 made the system exact when something *dies*;
+nothing handled the other production failure shape — everything alive
+but drowning. A slow sink or offered load past capacity meant unbounded
+queue growth, unbounded watermark lag, and eventually OOM. flowguard
+closes that hole with a deterministic degradation ladder:
+
+- **Level 0** (the normal state): exact. Output is bit-identical to the
+  oracle — the guard machinery costs one attribute read per batch when
+  disarmed and one hash-free observe when armed-but-idle.
+- **Level 1**: drop optional work. The sketchwatch audit cohort refresh
+  pauses and the flowtrace ring stops recording — the instruments go
+  quiet before any data does.
+- **Level >= 2**: deterministic hash-sampled admission at keep rate
+  ``1/2^(level-1)``. The shed set is a PURE FUNCTION of (flow key,
+  level): the same splitmix multiply-shift hash family sketchwatch uses
+  (obs/audit.py), minted from a DIFFERENT protocol seed so the shed set
+  is uncorrelated with the audit cohort — the audit keeps measuring the
+  extra error the sampling introduces, live. Admitted rows carry the
+  scale factor in their ``sampling_rate`` column, which both the CMS
+  (``scale_col``) and the window aggregator (``*_scaled`` outputs, the
+  rate key lane) already honor — scaled estimates stay unbiased.
+
+The ladder is driven by watermark lag (bus produce time -> worker pick
+up, the age of the backlog head): past the ``-guard.lag`` budget the
+controller steps DOWN one level per dwell period; once lag re-enters
+the hysteresis band (``hysteresis * budget``) it steps back UP, again
+one level per dwell — no flapping, no cliff.
+
+Shed is never silent: ``guard_shed_total{stage,reason}`` counts every
+dropped flow/query, ``flow_guard_level`` gauges the active level, and
+snapshot metadata records the sampling level the read side serves
+under. ``-guard.lag=0`` (the default) disarms the ladder entirely.
+"""
+
+from __future__ import annotations
+
+# flowlint: lock-checked
+# (ladder transitions are serialized by _lock; `level` is additionally
+# readable lock-free from the ingest group thread — a racy-but-monotone
+# int read, same discipline as FAULTS.active)
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..obs import REGISTRY, get_logger
+from ..obs.audit import _lane_mults, _sample_hash
+
+log = get_logger("guard")
+
+# The admission-hash protocol seed. DELIBERATELY distinct from
+# obs.audit.AUDIT_SAMPLE_SEED: the shed set must be uncorrelated with
+# the audit cohort, so sketchwatch keeps an unbiased exact shadow of
+# the keys that survive admission and MEASURES the sampling error
+# instead of having its cohort shed first.
+GUARD_SAMPLE_SEED = 0x6A4D_BA1A
+
+_GUARD_MULTS = _lane_mults(16, GUARD_SAMPLE_SEED)
+
+# Metric name/help specs live here once; StreamWorker registers them
+# eagerly so /metrics carries every guard family (as zeros) on every
+# worker — the deploy honesty tests resolve the overload panels and the
+# OverloadShedding alert against this surface.
+GUARD_METRICS = {
+    "level": ("flow_guard_level",
+              "active flowguard degradation-ladder level (0 = exact, "
+              "1 = optional work dropped, >=2 = hash-sampled admission "
+              "at keep rate 1/2^(level-1))"),
+    "lag": ("flow_guard_lag_seconds",
+            "watermark lag the guard controller last observed (bus "
+            "produce time -> worker pickup, age of the backlog head)"),
+    "shed": ("guard_shed_total",
+             "flows/queries shed by flowguard (labels: stage, reason) "
+             "— every admission drop and serve-path rejection counts "
+             "here; nothing is dropped silently"),
+    "transitions": ("guard_transitions_total",
+                    "flowguard ladder level changes (label: "
+                    "direction=down|up; down = degrading)"),
+    "buffer_bytes": ("guard_buffer_bytes",
+                     "bytes resident in a bounded ingest stage buffer "
+                     "(label: stage) — memory is bounded by "
+                     "construction; this is the live occupancy"),
+}
+
+_GUARD_GAUGES = frozenset({"level", "lag", "buffer_bytes"})
+
+
+def register_guard_metrics() -> dict:
+    """Register (or fetch) every flowguard metric family on the global
+    registry. Idempotent; returns {spec key: metric}."""
+    out = {}
+    for key, spec in GUARD_METRICS.items():
+        if key in _GUARD_GAUGES:
+            out[key] = REGISTRY.gauge(*spec)
+        else:
+            out[key] = REGISTRY.counter(*spec)
+    return out
+
+
+def flow_key_lanes(columns) -> np.ndarray:
+    """[N, 11] uint32 admission-key lanes for a batch's columns: the
+    5-tuple (src_addr, dst_addr, src_port, dst_port, proto). The SAME
+    lanes on every worker and every mesh member, so one flow sheds
+    identically network-wide — per-member partials stay a monoid under
+    sampling."""
+    n = len(columns["proto"])
+    lanes = np.empty((n, 11), dtype=np.uint32)
+    lanes[:, 0:4] = columns["src_addr"]
+    lanes[:, 4:8] = columns["dst_addr"]
+    lanes[:, 8] = columns["src_port"]
+    lanes[:, 9] = columns["dst_port"]
+    lanes[:, 10] = columns["proto"]
+    return lanes
+
+
+def admission_mask(columns, shift: int) -> np.ndarray:
+    """[N] bool: which rows survive admission at sampling shift ``s``
+    (keep rate 1/2^s). A pure function of (flow key, s) — reproducible
+    across reruns, processes, and mesh members. shift<=0 keeps all."""
+    if shift <= 0:
+        return np.ones(len(columns["proto"]), dtype=bool)
+    h = _sample_hash(flow_key_lanes(columns), _GUARD_MULTS)
+    return (h & np.uint32((1 << shift) - 1)) == np.uint32(0)
+
+
+@dataclass(frozen=True)
+class GuardConfig:
+    """Ladder tuning. ``lag_budget`` <= 0 disarms the controller — the
+    default, so every exact-parity path runs untouched."""
+
+    lag_budget: float = 0.0   # seconds of watermark lag tolerated
+    max_level: int = 6        # ladder ceiling (keep rate 1/32 at 6)
+    hysteresis: float = 0.5   # step up when lag < hysteresis * budget
+    dwell: float = 5.0        # min seconds between ladder transitions
+
+
+class GuardController:
+    """The degradation-ladder state machine for one worker.
+
+    ``observe(lag)`` runs on the worker thread per batch (and with lag
+    0.0 on idle polls, so recovery does not need traffic); ``level`` is
+    read lock-free from the ingest group thread by the admission
+    wrapper — a stale read sheds one batch at the previous level, which
+    the scale factor still accounts for exactly.
+    """
+
+    def __init__(self, config: GuardConfig = GuardConfig()):
+        self.config = config
+        if config.max_level < 1:
+            raise ValueError(
+                f"guard max_level must be >= 1, got {config.max_level}")
+        m = register_guard_metrics()
+        self.m_level = m["level"]
+        self.m_lag = m["lag"]
+        self.m_shed = m["shed"]
+        self.m_transitions = m["transitions"]
+        # flowlint: unguarded -- transitions serialized by _lock; lock-free readers (group thread) see a racy-but-monotone int whose staleness is absorbed by the per-row scale factor
+        self.level = 0
+        # flowlint: unguarded -- the lock itself; bound once
+        self._lock = threading.Lock()
+        self._last_change = 0.0      # guarded-by: _lock
+        self._shed_rows = 0          # guarded-by: _lock
+        self._max_level_seen = 0     # guarded-by: _lock
+
+    @property
+    def armed(self) -> bool:
+        return self.config.lag_budget > 0.0
+
+    @property
+    def sample_shift(self) -> int:
+        """Admission sampling shift s at the current level (keep rate
+        1/2^s): level 0 and 1 admit everything; level L>=2 is L-1."""
+        return max(0, self.level - 1)
+
+    @property
+    def drop_optional(self) -> bool:
+        """Level >= 1: audit cohort refresh and trace ring pause."""
+        return self.level >= 1
+
+    # ---- ladder ------------------------------------------------------------
+
+    def observe(self, lag: float, now: Optional[float] = None) -> int:
+        """Feed one watermark-lag measurement; returns the (possibly
+        stepped) level. One transition per dwell period in either
+        direction; recovery needs lag back INSIDE the hysteresis band,
+        not merely under budget — no flapping at the boundary."""
+        if not self.armed:
+            return 0
+        now = time.monotonic() if now is None else now
+        self.m_lag.set(lag)
+        cfg = self.config
+        with self._lock:
+            level = self.level
+            if now - self._last_change < cfg.dwell:
+                return level
+            if lag > cfg.lag_budget and level < cfg.max_level:
+                self.level = level + 1
+                self._last_change = now
+                self._max_level_seen = max(self._max_level_seen,
+                                           self.level)
+                new = self.level
+                direction = "down"
+            elif lag < cfg.hysteresis * cfg.lag_budget and level > 0:
+                self.level = level - 1
+                self._last_change = now
+                new = self.level
+                direction = "up"
+            else:
+                return level
+        self.m_level.set(new)
+        self.m_transitions.inc(direction=direction)
+        log.warning("flowguard level %d -> %d (lag %.2fs, budget %.2fs)",
+                    level, new, lag, cfg.lag_budget)
+        return new
+
+    # ---- admission ---------------------------------------------------------
+
+    def admit(self, batch):
+        """Deterministic hash-sampled admission for one FlowBatch at the
+        current level. Returns (admitted batch, rows shed). The admitted
+        batch keeps the FULL offset range (shed rows still commit — they
+        were consumed and accounted, not lost), and its survivors'
+        ``sampling_rate`` is multiplied by 2^shift so every downstream
+        scale-aware estimate stays unbiased."""
+        shift = self.sample_shift
+        if shift <= 0 or len(batch) == 0:
+            return batch, 0
+        mask = admission_mask(batch.columns, shift)
+        dropped = int(len(batch) - mask.sum())
+        if dropped == 0:
+            return batch, 0
+        admitted = batch.take(mask)
+        # absent-rate rows (rate 0) scale as rate 1 — the same
+        # max(rate, 1) convention the HH scale plane applies
+        sr = admitted.columns["sampling_rate"]
+        np.maximum(sr, np.uint64(1), out=sr)
+        sr *= np.uint64(1 << shift)
+        self.m_shed.inc(dropped, stage="ingest", reason="admission")
+        with self._lock:
+            self._shed_rows += dropped
+        return admitted, dropped
+
+    def count_shed(self, n: int, stage: str, reason: str) -> None:
+        """Account ``n`` shed items at a non-admission stage (the serve
+        accept queue, a deadline miss). Never silent."""
+        if n <= 0:
+            return
+        self.m_shed.inc(n, stage=stage, reason=reason)
+        with self._lock:
+            self._shed_rows += n
+
+    # ---- snapshot metadata -------------------------------------------------
+
+    def meta(self) -> dict:
+        """JSON-safe guard state for snapshot/window metadata: readers
+        can tell which sampling level the answer they hold was built
+        under."""
+        with self._lock:
+            return {
+                "level": self.level,
+                "sample_shift": self.sample_shift,
+                "max_level_seen": self._max_level_seen,
+                "shed_total": self._shed_rows,
+                "lag_budget": self.config.lag_budget,
+            }

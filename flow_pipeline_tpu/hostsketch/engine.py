@@ -96,26 +96,74 @@ def _np_buckets(keys: np.ndarray, depth: int, width: int) -> np.ndarray:
     return out
 
 
+def _bucket_groups(b: np.ndarray):
+    """Sort-and-segment one depth row's bucket indices. Returns
+    (order, starts, uniq): rows ``order[starts[i]:starts[i+1]]`` all
+    land in bucket ``uniq[i]``, and ``uniq`` has no repeats — so a
+    reduceat over the permuted addends plus ONE fancy-indexed
+    accumulate replaces ``np.ufunc.at``'s per-element scatter. u64
+    wrap sums and maxes are order-free, so the regrouping is bit-exact
+    by construction."""
+    order = np.argsort(b, kind="stable")
+    sb = b[order]
+    boundary = np.empty(len(sb), bool)
+    boundary[0] = True
+    np.not_equal(sb[1:], sb[:-1], out=boundary[1:])
+    starts = np.flatnonzero(boundary)
+    return order, starts, sb[starts]
+
+
+# numpy >= 1.25 ships indexed-loop fast paths for integer ufunc.at
+# (add/maximum), which beat sort+reduceat at every bucket multiplicity
+# we measured (0.05-0.12x for grouped, 4k-100k rows, width 64-8k). On
+# older numpy the buffered ufunc.at is the 10x of degraded no-native
+# mode, and the grouped scatter wins it back. Both branches are
+# bit-exact twins (u64 wrap sums and maxes are order-free; the parity
+# tests pin them against each other), so this is purely a cost model —
+# module-level so tests and the bench A/B can force either branch.
+_GROUPED_SCATTER = tuple(
+    int(x) for x in np.__version__.split(".")[:2]) < (1, 25)
+
+
 def np_cms_update(cms: np.ndarray, keys: np.ndarray, vals: np.ndarray,
-                  conservative: bool) -> None:
-    """uint64 CMS update in place over valid rows only (callers slice)."""
+                  conservative: bool,
+                  buckets: np.ndarray | None = None) -> None:
+    """uint64 CMS update in place over valid rows only (callers slice).
+    ``buckets`` lets callers reuse one murmur pass across the
+    update/admission-query pair (the hash is half the numpy twin's
+    time) — it must be ``_np_buckets(keys, depth, width)``."""
     p, depth, width = cms.shape
     if keys.shape[0] == 0:
         return
-    buckets = _np_buckets(keys, depth, width)
+    if buckets is None:
+        buckets = _np_buckets(keys, depth, width)
     add = _addend_u64(vals)
+    grouped = _GROUPED_SCATTER
     if not conservative:
-        for pi in range(p):
+        with np.errstate(over="ignore"):
             for d in range(depth):
-                np.add.at(cms[pi, d], buckets[d], add[:, pi])
+                if grouped:
+                    order, starts, ub = _bucket_groups(buckets[d])
+                    g = np.add.reduceat(add[order], starts, axis=0)
+                    cms[:, d, ub] += g.T  # [G, P] per-bucket sums
+                else:
+                    for pi in range(p):
+                        np.add.at(cms[pi, d], buckets[d], add[:, pi])
         return
     # conservative: targets against the PRE-update sketch, then
-    # scatter-max (order-free, exactly the XLA graph's two halves)
+    # scatter-max (order-free, exactly the XLA graph's two halves);
+    # grouped max-per-bucket then one unique-index np.maximum is the
+    # same lattice join np.maximum.at computes one element at a time
     est = np_cms_query_u64(cms, keys, buckets)
     target = est + add
-    for pi in range(p):
-        for d in range(depth):
-            np.maximum.at(cms[pi, d], buckets[d], target[:, pi])
+    for d in range(depth):
+        if grouped:
+            order, starts, ub = _bucket_groups(buckets[d])
+            g = np.maximum.reduceat(target[order], starts, axis=0)
+            cms[:, d, ub] = np.maximum(cms[:, d, ub], g.T)
+        else:
+            for pi in range(p):
+                np.maximum.at(cms[pi, d], buckets[d], target[:, pi])
 
 
 def np_cms_query_u64(cms: np.ndarray, keys: np.ndarray,
@@ -124,13 +172,19 @@ def np_cms_query_u64(cms: np.ndarray, keys: np.ndarray,
     p, depth, width = cms.shape
     if buckets is None:
         buckets = _np_buckets(keys, depth, width)
-    ests = np.stack([cms[:, d, buckets[d]] for d in range(depth)])
-    return ests.min(axis=0).T  # [n, P]
+    # running element-wise min instead of stack+reduce: one [n, P]
+    # buffer, no [depth, P, n] temporary (min is order-free, so the
+    # fold order cannot change a single bit)
+    est = np.ascontiguousarray(cms[:, 0, buckets[0]].T)
+    for d in range(1, depth):
+        np.minimum(est, cms[:, d, buckets[d]].T, out=est)
+    return est  # [n, P]
 
 
-def np_cms_query(cms: np.ndarray, keys: np.ndarray) -> np.ndarray:
+def np_cms_query(cms: np.ndarray, keys: np.ndarray,
+                 buckets: np.ndarray | None = None) -> np.ndarray:
     """[n, P] float32 estimates — ops.cms.cms_query's host twin."""
-    return np_cms_query_u64(cms, keys).astype(np.float32)
+    return np_cms_query_u64(cms, keys, buckets).astype(np.float32)
 
 
 def np_topk_merge(table_keys: np.ndarray, table_vals: np.ndarray,
@@ -236,14 +290,24 @@ def np_inv_update(st: HostInvState, keys: np.ndarray,
     cnt = add[:, -1]
     h64 = np_inv_key_hash(keys)
     with np.errstate(over="ignore"):
-        for pi in range(planes):
-            for d in range(depth):
-                np.add.at(st.cms[pi, d], buckets[d], add[:, pi])
         lanes_u64 = keys.astype(np.uint64) * cnt[:, None]
         check = h64 * cnt
-        for d in range(depth):
-            np.add.at(st.keysum[d], buckets[d], lanes_u64)
-            np.add.at(st.keycheck[d], buckets[d], check)
+        if _GROUPED_SCATTER:
+            for d in range(depth):
+                order, starts, ub = _bucket_groups(buckets[d])
+                st.cms[:, d, ub] += \
+                    np.add.reduceat(add[order], starts, axis=0).T
+                st.keysum[d][ub] += \
+                    np.add.reduceat(lanes_u64[order], starts, axis=0)
+                st.keycheck[d][ub] += \
+                    np.add.reduceat(check[order], starts)
+        else:
+            for pi in range(planes):
+                for d in range(depth):
+                    np.add.at(st.cms[pi, d], buckets[d], add[:, pi])
+            for d in range(depth):
+                np.add.at(st.keysum[d], buckets[d], lanes_u64)
+                np.add.at(st.keycheck[d], buckets[d], check)
 
 
 def np_inv_decode(cms: np.ndarray, keysum: np.ndarray,
@@ -485,16 +549,28 @@ class HostSketchEngine:
                 # stale .so (pre-r16): the numpy twin is bit-identical
             np_inv_update(st, uniq, sums)
             return
+        buckets = None
+        if not self.native:
+            # numpy fallback: ONE murmur pass feeds both the CMS update
+            # and the admission query below (the hash was half the
+            # degraded-mode step; prefilter selection subsets the
+            # columns instead of rehashing)
+            buckets = _np_buckets(uniq, st.cms.shape[1], st.cms.shape[2])
         if self.native:
             from .. import native
 
             native.hs_cms_update(st.cms, uniq, sums, None,
                                  cfg.conservative, threads, stats=stats)
         else:
-            np_cms_update(st.cms, uniq, sums, cfg.conservative)
+            np_cms_update(st.cms, uniq, sums, cfg.conservative,
+                          buckets=buckets)
         if cfg.table_prefilter and padded_b > 2 * cfg.capacity:
-            uniq, sums = self._prefilter(st, uniq, sums, cfg.capacity,
-                                         threads, stats)
+            sel = self._prefilter(st, uniq, sums, cfg.capacity,
+                                  threads, stats)
+            uniq = np.ascontiguousarray(uniq[sel])
+            sums = np.ascontiguousarray(sums[sel])
+            if buckets is not None:
+                buckets = np.ascontiguousarray(buckets[:, sel])
         if cfg.table_admission == "plain":
             est = sums
         else:
@@ -504,7 +580,7 @@ class HostSketchEngine:
                 est = native.hs_cms_query(st.cms, uniq, threads,
                                           stats=stats)
             else:
-                est = np_cms_query(st.cms, uniq)
+                est = np_cms_query(st.cms, uniq, buckets)
         if self.native:
             from .. import native
 
@@ -521,21 +597,19 @@ class HostSketchEngine:
         first mix = the high word of ops.hostgroup.hash_u64), and the
         2C selection reproduces lax.top_k's lowest-index tie-break via a
         stable argsort (numpy) / a (metric desc, index asc) partial sort
-        (native)."""
+        (native). Returns the SELECTION (row indices into ``uniq``) so
+        update() can subset the precomputed bucket columns too."""
         if self.native:
             from .. import native
 
-            sel = native.hs_hh_prefilter(st.table_keys, uniq, sums,
-                                         threads, stats=stats)
-        else:
-            th = (hash_u64(np.ascontiguousarray(st.table_keys))
-                  >> np.uint64(32)).astype(np.uint32)
-            gh = (hash_u64(uniq) >> np.uint64(32)).astype(np.uint32)
-            ts = np.sort(th)
-            pos = np.clip(np.searchsorted(ts, gh), 0, cap - 1)
-            resident = ts[pos] == gh
-            metric = sums[:, 0].copy()
-            metric[resident] = np.float32(np.inf)
-            sel = np.argsort(-metric, kind="stable")[:2 * cap]
-        return (np.ascontiguousarray(uniq[sel]),
-                np.ascontiguousarray(sums[sel]))
+            return native.hs_hh_prefilter(st.table_keys, uniq, sums,
+                                          threads, stats=stats)
+        th = (hash_u64(np.ascontiguousarray(st.table_keys))
+              >> np.uint64(32)).astype(np.uint32)
+        gh = (hash_u64(uniq) >> np.uint64(32)).astype(np.uint32)
+        ts = np.sort(th)
+        pos = np.clip(np.searchsorted(ts, gh), 0, cap - 1)
+        resident = ts[pos] == gh
+        metric = sums[:, 0].copy()
+        metric[resident] = np.float32(np.inf)
+        return np.argsort(-metric, kind="stable")[:2 * cap]

@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..guard import register_guard_metrics
 from ..obs import REGISTRY, get_logger
 from ..obs.trace import TRACER
 
@@ -74,6 +75,13 @@ class PipelinedExecutor:
             "ingest_queue_highwater", "max queue depth seen per ingest stage")
         # flowlint: unguarded -- group thread is the sole writer; readers tolerate staleness (gauge)
         self.high_water = 0
+        # flowguard occupancy: live bytes resident in the prepared queue
+        # (guard_buffer_bytes{stage="group"}) — the bound is depth
+        # batches by construction; this makes the occupancy observable
+        self.m_bytes = register_guard_metrics()["buffer_bytes"]
+        # flowlint: unguarded -- the lock itself; bound once
+        self._bytes_lock = threading.Lock()
+        self._bytes = 0  # guarded-by: _bytes_lock
 
     # ---- worker surface ---------------------------------------------------
 
@@ -89,6 +97,7 @@ class PipelinedExecutor:
             try:
                 item, t_enq, chunk = self._out.get(timeout=self.idle_sleep)
                 self.m_depth.set(self._out.qsize(), stage="group")
+                self._track_bytes(-self._nbytes(item))
                 # queue-wait: prepared-to-picked-up — the interval that
                 # shows whether the device step or the group thread is
                 # the bottleneck for THIS chunk
@@ -127,6 +136,22 @@ class PipelinedExecutor:
         self._idle.clear()
         self._error = None
         self.m_depth.set(0, stage="group")
+        with self._bytes_lock:
+            self._bytes = 0
+        self.m_bytes.set(0, stage="group")
+
+    # ---- occupancy accounting ---------------------------------------------
+
+    @staticmethod
+    def _nbytes(prep) -> int:
+        batch = getattr(prep, "batch", None)
+        return batch.nbytes() if batch is not None else 0
+
+    def _track_bytes(self, delta: int) -> None:
+        with self._bytes_lock:
+            self._bytes += delta
+            b = self._bytes
+        self.m_bytes.set(b, stage="group")
 
     # ---- group thread -----------------------------------------------------
 
@@ -168,6 +193,7 @@ class PipelinedExecutor:
             # space is guaranteed: this thread is the only producer and
             # it checked full() above; next() only ever removes items
             self._out.put((prep, time.time(), chunk))
+            self._track_bytes(self._nbytes(prep))
             depth = self._out.qsize()
             self.m_depth.set(depth, stage="group")
             if depth > self.high_water:

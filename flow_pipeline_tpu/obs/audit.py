@@ -81,12 +81,14 @@ _FMIX1 = np.uint32(0x85EBCA6B)
 _FMIX2 = np.uint32(0xC2B2AE35)
 
 
-def _lane_mults(n: int) -> tuple:
-    """Per-position odd uint32 multipliers, splitmix-minted from the
+def _lane_mults(n: int, seed: int = AUDIT_SAMPLE_SEED) -> tuple:
+    """Per-position odd uint32 multipliers, splitmix-minted from a
     protocol seed (position-dependent, so permuted key tuples hash
-    differently)."""
+    differently). flowguard mints its admission multipliers from its
+    OWN seed here, so the shed set stays uncorrelated with the audit
+    cohort."""
     out = []
-    x = AUDIT_SAMPLE_SEED & 0xFFFFFFFF
+    x = seed & 0xFFFFFFFF
     for _ in range(n):
         x = (x + 0x9E3779B9) & 0xFFFFFFFF
         z = x
@@ -100,12 +102,18 @@ def _lane_mults(n: int) -> tuple:
 _LANE_MULTS = _lane_mults(16)
 
 
-def _sample_hash(lanes: np.ndarray) -> np.ndarray:
+def _sample_hash(lanes: np.ndarray, mults: Optional[tuple] = None
+                 ) -> np.ndarray:
     """[N] uint32 sampling hash over [N, W] uint32 key lanes. Two
     buffers, every op in place: this runs per chunk per family on the
-    hot path, and numpy temporary churn was the measurable cost."""
+    hot path, and numpy temporary churn was the measurable cost.
+    ``mults`` selects the multiplier family (default: the audit
+    cohort's; flowguard passes its own-seed multipliers)."""
     w = lanes.shape[1]
-    mults = _LANE_MULTS if w <= len(_LANE_MULTS) else _lane_mults(w)
+    if mults is None:
+        mults = _LANE_MULTS
+    if w > len(mults):
+        mults = _lane_mults(w)
     tmp = np.empty(lanes.shape[0], np.uint32)
     with np.errstate(over="ignore"):
         h = np.multiply(lanes[:, 0], mults[0])
@@ -433,6 +441,13 @@ class SketchAudit:
         # mesh-member capture hook: (name, slot, partial) -> None.
         # flowlint: unguarded -- bound once at member wiring, before the worker loop starts
         self.capture = None
+        # flowguard: level >= 1 pauses cohort REFRESH (prepare_* return
+        # None) — the shadow audit is the first optional work to go
+        # under overload. The cohort already held still evaluates at
+        # window close, so the audit keeps testifying about the keys it
+        # sampled before the squeeze.
+        # flowlint: unguarded -- racy-but-monotone bool flipped by the worker's guard observe, read on the group thread; a stale read folds/skips one chunk
+        self.paused = False
         # newest JSON-safe close report per family (what the flowserve
         # snapshot's /query/audit serves)
         # flowlint: unguarded -- worker thread only (written at window close under worker.lock; the serve publisher reads under the same lock)
@@ -486,7 +501,7 @@ class SketchAudit:
         ``n_groups`` real) -> (rows, u64 addends) or None. Pure."""
         from ..hostsketch.engine import _addend_u64
 
-        if name not in self._fams or n_groups <= 0:
+        if self.paused or name not in self._fams or n_groups <= 0:
             return None
         lanes = uniq[:n_groups]
         mask = sample_mask(lanes, self.mode)
@@ -502,7 +517,7 @@ class SketchAudit:
         -> (rows, u64 addends) or None. Pure."""
         from ..hostsketch.engine import _addend_u64
 
-        if name not in self._fams or lanes.shape[0] == 0:
+        if self.paused or name not in self._fams or lanes.shape[0] == 0:
             return None
         mask = sample_mask(lanes, self.mode)
         if not mask.any():

@@ -71,6 +71,12 @@ class TraceRecorder:
         self._always: list = []  # guarded-by: _lock
         # flowlint: unguarded -- single-writer latch (configure at startup / test setup); hot-path readers take a GIL-atomic snapshot
         self._mode = "off"
+        # flowguard: level >= 1 pauses recording — the flight recorder
+        # is optional work, dropped before any DATA is. Pausing keeps
+        # the ring's existing spans (a post-mortem still sees the lead-up
+        # to the overload); configure() resets it.
+        # flowlint: unguarded -- racy-but-monotone bool flipped by the guard's observe path; a stale read records/skips one span
+        self.paused = False
         self.configure(mode if mode is not None
                        else os.environ.get("FLOWTPU_TRACE", "ring"))
 
@@ -87,6 +93,7 @@ class TraceRecorder:
             self._next = 0
             self._dropped = 0
             self._always = []
+            self.paused = False
         return self
 
     @property
@@ -100,7 +107,7 @@ class TraceRecorder:
         """One completed span. t0/t1 are time.time() seconds (wall clock
         — the Chrome format's ``ts`` is an absolute microsecond epoch);
         extra kwargs land in the event's ``args``."""
-        if self._mode == "off":
+        if self._mode == "off" or self.paused:
             return
         ev = (name, t0, t1, threading.current_thread().name, chunk,
               args or None)
@@ -116,7 +123,7 @@ class TraceRecorder:
     @contextlib.contextmanager
     def span(self, name: str, chunk: Optional[int] = None, **args):
         """Record the wrapped block as one span. Near-free when off."""
-        if self._mode == "off":
+        if self._mode == "off" or self.paused:
             yield
             return
         t0 = time.time()

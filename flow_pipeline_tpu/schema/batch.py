@@ -102,6 +102,12 @@ class FlowBatch:
     # one chunk's spans together across the feed/group/worker/flusher
     # threads. -1 = not traced (batches built outside the consume path).
     chunk_id: int = -1
+    # flowguard lag signal: wall clock when the batch's OLDEST message
+    # was produced onto the bus (0.0 = transport does not stamp, e.g.
+    # Kafka — the guard then has no lag signal and stays at level 0).
+    # now - produced_at is the age of the backlog head: the watermark
+    # lag the -guard.lag budget is measured against.
+    produced_at: float = 0.0
 
     # ---- construction -----------------------------------------------------
 
@@ -183,12 +189,29 @@ class FlowBatch:
             out[name] = arr.view(np.int32) if arr.dtype == np.uint32 else arr
         return out
 
+    def nbytes(self) -> int:
+        """Resident column bytes — the flowguard per-stage buffer
+        accounting unit (guard_buffer_bytes)."""
+        return sum(v.nbytes for v in self.columns.values())
+
+    def take(self, mask: np.ndarray) -> "FlowBatch":
+        """Rows selected by a boolean mask, as fresh arrays. The offset
+        range is PRESERVED UNCHANGED: flowguard admission uses this, and
+        the rows the mask drops were still consumed from the bus — their
+        offsets must keep committing or a restart would replay (and
+        double-shed-account) them."""
+        cols = {k: v[mask] for k, v in self.columns.items()}
+        return FlowBatch(cols, self.partition, self.first_offset,
+                         self.last_offset, self.chunk_id,
+                         self.produced_at)
+
     def slice(self, start: int, stop: int) -> "FlowBatch":
         stop = min(stop, len(self))  # offsets must cover only real rows
         cols = {k: v[start:stop] for k, v in self.columns.items()}
         first = self.first_offset + start if self.first_offset >= 0 else -1
         last = self.first_offset + stop - 1 if self.first_offset >= 0 else -1
-        return FlowBatch(cols, self.partition, first, last, self.chunk_id)
+        return FlowBatch(cols, self.partition, first, last, self.chunk_id,
+                         self.produced_at)
 
     def pad_to(self, n: int) -> tuple["FlowBatch", np.ndarray]:
         """Pad to length n (static shapes for jit); returns (batch, valid mask).
@@ -209,7 +232,8 @@ class FlowBatch:
             padded[:cur] = v
             cols[k] = padded
         return FlowBatch(cols, self.partition, self.first_offset,
-                         self.last_offset, self.chunk_id), mask
+                         self.last_offset, self.chunk_id,
+                         self.produced_at), mask
 
     @staticmethod
     def concat(batches: list["FlowBatch"]) -> "FlowBatch":
@@ -224,4 +248,5 @@ class FlowBatch:
             batches[0].partition,
             batches[0].first_offset,
             batches[-1].last_offset,
+            produced_at=batches[0].produced_at,
         )

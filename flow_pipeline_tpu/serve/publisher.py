@@ -144,13 +144,22 @@ class WorkerServePublisher:
                 watermark = max(watermark, float(m.watermark))
         self._last_gen = self.ledger.generation
         aud = getattr(worker.fused, "audit", None)
+        audit = dict(aud.last_reports) if aud is not None else None
+        guard = getattr(worker, "guard", None)
+        if guard is not None and guard.armed:
+            # flowguard is never silent: snapshot metadata records the
+            # sampling level the answers were built under, riding the
+            # audit dict (which the gateway delta codec already diffs)
+            # as a reserved pseudo-model key
+            audit = dict(audit or {})
+            audit["flowguard"] = guard.meta()
         snap = self.store.publish(
             watermark=watermark, flows_seen=worker.flows_seen,
             source="worker", families=families,
             ranges=self.ledger.freeze(),
             # sketchwatch: the newest per-family close reports ride the
             # snapshot (read under worker.lock here; served lock-free)
-            audit=dict(aud.last_reports) if aud is not None else None)
+            audit=audit)
         self._last_publish = time.monotonic()
         log.debug("flowserve published v%d (%.1f ms, %d families)",
                   snap.version, (self._last_publish - t0) * 1e3,

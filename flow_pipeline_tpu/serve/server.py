@@ -57,13 +57,16 @@ _REASONS = {200: "OK", 304: "Not Modified", 400: "Bad Request",
 
 def _http_response(code: int, body: bytes = b"",
                    etag: str | None = None,
-                   ctype: str = "application/json") -> bytes:
+                   ctype: str = "application/json",
+                   extra: list | None = None) -> bytes:
     """One fully assembled HTTP/1.1 response (single sendall)."""
     head = [f"HTTP/1.1 {code} {_REASONS.get(code, 'OK')}",
             f"Content-Type: {ctype}",
             f"Content-Length: {len(body)}"]
     if etag:
         head.append(f"ETag: {etag}")
+    if extra:
+        head.extend(extra)
     return ("\r\n".join(head) + "\r\n\r\n").encode() + body
 
 
@@ -128,11 +131,35 @@ class _Server(socketserver.ThreadingTCPServer):
 
 
 class ServeServer:
-    """Background flowserve HTTP server. Port 0 picks a free port."""
+    """Background flowserve HTTP server. Port 0 picks a free port.
+
+    flowguard read-side admission (``max_inflight`` > 0): at most
+    ``max_inflight`` requests compute concurrently; a request that
+    cannot be admitted within ``deadline`` seconds is REJECTED with
+    503 + ``Retry-After: 1`` instead of queueing unboundedly — a
+    drowning replica stays responsive about being overloaded, and the
+    flowgate ring client uses the signal to deprioritize (not bury)
+    it. ``/healthz`` bypasses admission: liveness must stay observable
+    under exactly the overload that saturates the query paths.
+    """
 
     def __init__(self, store: SnapshotStore, port: int = 8083,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", max_inflight: int = 0,
+                 deadline: float = 0.1):
+        from ..guard import register_guard_metrics
+
         self.store = store
+        if deadline < 0:
+            raise ValueError(
+                f"serve admission deadline must be >= 0, got {deadline}")
+        self.deadline = deadline
+        self._sem = (threading.BoundedSemaphore(max_inflight)
+                     if max_inflight > 0 else None)
+        self.m_shed = register_guard_metrics()["shed"]
+        # the worker/coordinator guard controller, when one runs in
+        # this process (set_guard): /healthz reports its ladder level
+        # flowlint: unguarded -- bound once at wiring, before traffic; read-only after
+        self.guard = None
         # flowlint: unguarded -- the lock itself; bound once
         self._cache_lock = threading.Lock()
         self._cache_version = -1  # guarded-by: _cache_lock
@@ -164,6 +191,14 @@ class ServeServer:
             target=self._server.serve_forever, name="serve-http",
             daemon=True)
 
+    def set_guard(self, controller) -> "ServeServer":
+        """Attach the in-process guard controller: /healthz starts
+        reporting ``degraded`` + ``guard_level`` so health checks (and
+        the flowgate ring) can tell a degraded replica from a dead one.
+        Call once at wiring, before traffic."""
+        self.guard = controller
+        return self
+
     # ---- request dispatch --------------------------------------------------
 
     def _respond(self, target: str, inm: str | None) -> bytes:
@@ -171,7 +206,22 @@ class ServeServer:
         by status code (the buffer always opens "HTTP/1.1 NNN", so the
         code is bytes [9:12] — one slice, no re-parse; the 5xx-rate
         alert in deploy/prometheus/alerts.yml reads this family)."""
-        resp = self._respond_inner(target, inm)
+        if self._sem is not None and not target.startswith("/healthz"):
+            if not self._sem.acquire(timeout=self.deadline):
+                # bounded accept queue: past the deadline the request
+                # is shed LOUDLY — counted, attributed, retryable
+                self.m_shed.inc(stage="serve", reason="queue_full")
+                resp = _http_response(
+                    503, b'{"error": "overloaded, retry"}',
+                    extra=["Retry-After: 1"])
+                self.store.m_responses.inc(code="503")
+                return resp
+            try:
+                resp = self._respond_inner(target, inm)
+            finally:
+                self._sem.release()
+        else:
+            resp = self._respond_inner(target, inm)
         self.store.m_responses.inc(code=resp[9:12].decode("ascii"))
         return resp
 
@@ -197,9 +247,15 @@ class ServeServer:
         endpoint = url.path
         try:
             if endpoint == "/healthz":
-                return _http_response(200, json.dumps(
-                    {"ok": True,
-                     "version": snap.version if snap else 0}).encode())
+                health = {"ok": True,
+                          "version": snap.version if snap else 0,
+                          "degraded": False}
+                if self.guard is not None and self.guard.level >= 1:
+                    # degraded, NOT dead: the ring client deprioritizes
+                    # this replica but keeps it as a last resort
+                    health["degraded"] = True
+                    health["guard_level"] = self.guard.level
+                return _http_response(200, json.dumps(health).encode())
             if endpoint == "/sub/snapshot":
                 # flowgate subscription poll: binary frames, never the
                 # JSON cache (since= changes every poll; the feed
@@ -292,6 +348,16 @@ class ServeServer:
                 log.debug("flowserve warm failed for %s", target,
                           exc_info=True)
         return n
+
+    def invalidate_cache(self) -> None:
+        """Drop every cached response. The flowgate adopt-restart path
+        needs this: the adopted world's version counter restarts, so a
+        new-world version can COLLIDE with an old-world cached entry —
+        the version-equality check alone cannot tell them apart."""
+        with self._cache_lock:
+            self._cache = {}
+            self._alias = {}
+            self._cache_version = -1
 
     # ---- response cache ----------------------------------------------------
 

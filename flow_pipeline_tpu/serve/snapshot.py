@@ -294,6 +294,22 @@ class SnapshotStore:
         self.m_age.set(snap.age())
         return snap
 
+    def adopt_snapshot(self, snap: Snapshot) -> Snapshot:
+        """Force-swap to an already-built snapshot EVEN IF its version
+        runs backwards — the flowgate ``-gateway.adopt-restart`` path:
+        after an upstream restart (fresh process republishing from v1)
+        the operator chose availability over session monotonicity, so
+        the replica adopts the new world instead of wedging on its
+        pre-restart snapshot. Never called on the normal mirror path;
+        publish_snapshot stays the monotone default."""
+        with self._pub_lock:
+            self._current = snap  # the RCU publish: one reference swap
+        self.m_published.inc()
+        self.m_version.set(snap.version)
+        self.m_timestamp.set(snap.created)
+        self.m_age.set(snap.age())
+        return snap
+
     def observe_query(self, endpoint: str, seconds: float,
                       snap: Optional[Snapshot]) -> None:
         """Per-request metrics hook (the serve server calls it after the

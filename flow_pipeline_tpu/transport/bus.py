@@ -12,7 +12,9 @@ from __future__ import annotations
 # (every shared attribute below declares its lock; `make lint` verifies
 # each write site holds it — see docs/STATIC_ANALYSIS.md)
 
+import bisect
 import threading
+import time
 from dataclasses import dataclass
 from typing import Iterable, Optional
 
@@ -37,12 +39,20 @@ class InProcessBus:
         # (group, topic, p) -> next offset
         self._commits: dict[tuple[str, str, int], int] = {}  # guarded-by: _lock
         self._rr = 0  # keyless-produce round-robin cursor  # guarded-by: _lock
+        # flowguard lag signal: per (topic, partition), an ascending
+        # list of (first offset of a produce call, wall clock). One
+        # entry per produce CALL, not per message — produced_at() finds
+        # an offset's stamp by bisect, so the backlog head's age costs
+        # O(log produces) and the log costs one tuple per produce.
+        self._stamps: dict[str, list[list]] = {}  # guarded-by: _lock
 
     def create_topic(self, topic: str, partitions: int = 2) -> None:
         """Idempotent; the reference's default is 2 partitions
         (ref: compose/docker-compose-postgres-mock.yml:28)."""
         with self._lock:
             self._topics.setdefault(topic, [[] for _ in range(partitions)])
+            self._stamps.setdefault(
+                topic, [[] for _ in range(partitions)])
 
     def partitions(self, topic: str) -> int:
         with self._lock:
@@ -66,6 +76,7 @@ class InProcessBus:
             log = parts[p]
             off = len(log)
             log.append(value)
+            self._stamps[topic][p].append((off, time.time()))
             return BusMessage(topic, p, off, value)
 
     def produce_many(self, topic: str, values: Iterable[bytes],
@@ -76,17 +87,24 @@ class InProcessBus:
         if FAULTS.active:  # flowchaos seam: collector-side produce
             FAULTS.check("bus.produce")
         values = list(values)
+        now = time.time()
         with self._lock:
             if topic not in self._topics:
                 self.create_topic(topic)
             parts = self._topics[topic]
+            stamps = self._stamps[topic]
             if partition is not None:
+                stamps[partition].append((len(parts[partition]), now))
                 parts[partition].extend(values)
             else:
                 np_ = len(parts)
                 start = self._rr
                 for i in range(np_):
-                    parts[(start + i) % np_].extend(values[i::np_])
+                    chunk = values[i::np_]
+                    if chunk:
+                        p = (start + i) % np_
+                        stamps[p].append((len(parts[p]), now))
+                        parts[p].extend(chunk)
                 self._rr += len(values)
         return len(values)
 
@@ -105,12 +123,15 @@ class InProcessBus:
                    max_messages: int = 1024):
         """Bulk fetch as ONE concatenated byte string.
 
-        Returns (data, first_offset, last_offset) or None when caught up.
-        This is the zero-object-overhead path for length-prefixed streams:
-        the bulk decoder (native.decode_stream / FlowBatch.from_wire)
-        wants exactly the concatenation, so materializing one BusMessage
-        per flow — the dominant consume-side cost at high rates — is pure
-        waste. Per-message consumers keep using fetch()."""
+        Returns (data, first_offset, last_offset, produced_at) or None
+        when caught up; produced_at is the wall clock the FIRST message
+        of the span was produced (the flowguard lag signal: now minus it
+        is the age of the backlog head). This is the zero-object-overhead
+        path for length-prefixed streams: the bulk decoder
+        (native.decode_stream / FlowBatch.from_wire) wants exactly the
+        concatenation, so materializing one BusMessage per flow — the
+        dominant consume-side cost at high rates — is pure waste.
+        Per-message consumers keep using fetch()."""
         if FAULTS.active:  # flowchaos seam: consumer-side poll
             FAULTS.check("bus.poll")
         with self._lock:
@@ -119,7 +140,24 @@ class InProcessBus:
             if end <= offset:
                 return None
             data = b"".join(log[offset:end])
-        return data, offset, end - 1
+            produced = self._stamp_at(topic, partition, offset)
+        return data, offset, end - 1, produced
+
+    def _stamp_at(self, topic: str, partition: int, offset: int) -> float:
+        """Produce wall clock covering ``offset`` (0.0 if unstamped).
+        Caller holds _lock."""
+        stamps = self._stamps.get(topic)
+        if not stamps:
+            return 0.0
+        log = stamps[partition]
+        i = bisect.bisect_right(log, (offset, float("inf"))) - 1
+        return log[i][1] if i >= 0 else 0.0
+
+    def produced_at(self, topic: str, partition: int, offset: int) -> float:
+        """Public stamp lookup for per-message consumers (the span path
+        returns the stamp inline)."""
+        with self._lock:
+            return self._stamp_at(topic, partition, offset)
 
     def end_offset(self, topic: str, partition: int) -> int:
         with self._lock:

@@ -66,13 +66,14 @@ class Consumer:
                         self.topic, p, self.positions[p], max_messages)
                 if span is None:
                     continue
-                data, first, last = span
+                data, first, last, produced = span
                 t0 = time.time()
                 with _STAGES.stage("consume_decode"):
                     batch = FlowBatch.from_wire(data)
                 batch.partition = p
                 batch.first_offset = first
                 batch.last_offset = last
+                batch.produced_at = produced
                 self.positions[p] = last + 1
                 self._trace_decode(batch, t0)
                 return batch
@@ -87,6 +88,10 @@ class Consumer:
             batch.partition = p
             batch.first_offset = msgs[0].offset
             batch.last_offset = msgs[-1].offset
+            # flowguard lag signal (the span path gets this inline; the
+            # per-message path pays one extra stamp lookup)
+            batch.produced_at = self.bus.produced_at(
+                self.topic, p, msgs[0].offset)
             self.positions[p] = msgs[-1].offset + 1
             self._trace_decode(batch, t0)
             return batch

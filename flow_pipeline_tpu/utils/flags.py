@@ -43,12 +43,16 @@ KNOWN_FLAGS = frozenset({
     "listen.feed", "query.addr", "obs.trace", "obs.audit",
     # flowchaos (utils/faults.py, sink/resilient.py, mesh/journal.py)
     "faults", "sink.retries", "sink.deadletter",
+    # flowguard (guard/) — overload control + degradation ladder
+    "guard.lag", "guard.max_level", "guard.serve_queue",
+    "guard.serve_deadline",
     # flowtpu-replay (the dead-letter re-ingestion subcommand)
     "replay.dir", "replay.delete",
     # flowserve (serve/)
     "serve.addr", "serve.refresh",
     # flowgate (gateway/)
     "gateway.listen", "gateway.upstream", "gateway.poll",
+    "gateway.adopt-restart",
     # flowmesh (mesh/)
     "mesh.workers", "mesh.role", "mesh.coordinator", "mesh.id",
     "mesh.listen", "mesh.heartbeat", "mesh.journal",

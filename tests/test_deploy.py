@@ -351,6 +351,28 @@ class TestGrafana:
         assert "gateway_prerendered_total" in exprs
         assert "gateway_poll_failures_total" in exprs
 
+    def test_pipeline_dashboard_flowguard_panels(self):
+        """Round-20 flowguard panels: the degradation-ladder level next
+        to the shed rate by stage/reason (shedding is never silent),
+        and the bounded-buffer occupancy charted against the watermark
+        lag that drives the ladder."""
+        with open(os.path.join(DEPLOY, "grafana", "dashboards",
+                               "pipeline.json")) as f:
+            dash = json.load(f)
+        panels = {p["title"]: p for p in dash["panels"]}
+        level = panels["Flowguard level and shed rate"]
+        exprs = " ".join(t["expr"] for t in level["targets"])
+        assert "flow_guard_level" in exprs
+        assert "guard_shed_total" in exprs
+        assert "guard_transitions_total" in exprs
+        legends = " ".join(t["legendFormat"] for t in level["targets"])
+        assert "{{stage}}" in legends and "{{reason}}" in legends
+        buf = panels["Flowguard buffers vs watermark lag"]
+        exprs = " ".join(t["expr"] for t in buf["targets"])
+        assert "guard_buffer_bytes" in exprs
+        assert "flow_guard_lag_seconds" in exprs
+        assert "faults_delayed_total" in exprs
+
     def test_mesh_topology_gateway_tier(self):
         """Round-18 flowgate compose: two stateless gateway replicas
         front the coordinator's snapshot stream (the '2 gateways over
@@ -592,6 +614,10 @@ class TestDashboardHonesty:
                    for r in rules)
         assert any("mesh_journal_lag_seconds" in r["expr"]
                    for r in rules)
+        # the flowguard rule the r20 satellite names: shedding by
+        # policy pages — sampled answers / bounced readers mean
+        # capacity is short even though nothing crashed
+        assert any("guard_shed_total" in r["expr"] for r in rules)
 
     def test_alerts_wired_into_prometheus_and_compose(self):
         """The rules file must actually be evaluated: prometheus.yml

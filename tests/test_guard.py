@@ -1,0 +1,869 @@
+"""flowguard (guard/): the overload-control gates.
+
+The contracts pinned here, per docs/FAULT_TOLERANCE.md "flowguard":
+
+- **Level-0 exactness**: a disarmed guard — and an armed guard whose lag
+  never leaves budget — perturbs NOTHING. Sink output is bit-exact
+  against the guard-free oracle on the worker path (serial AND the
+  pipelined host-grouped path, where admission runs on the group
+  thread) and on the mesh path.
+- **Deterministic shedding**: the shed set is a pure function of
+  (flow key, level) — the same splitmix hash family as sketchwatch,
+  minted from a different protocol seed. Reruns, row order, and mesh
+  sharding cannot change which flows shed.
+- **Unbiased estimates**: admitted survivors carry 2^shift in their
+  ``sampling_rate`` column, so the scale-aware aggregates stay unbiased
+  through sampled admission.
+- **Exact accounting**: consumed == emitted + shed, always; every drop
+  is counted on ``guard_shed_total{stage,reason}`` — nothing silent.
+- **The ladder**: one transition per dwell in either direction, driven
+  by watermark lag vs the ``-guard.lag`` budget, with a hysteresis band
+  on recovery — no flapping, no cliff.
+- **Read-side admission**: a bounded serve accept queue rejects with
+  503 + Retry-After past the deadline, ``/healthz`` (admission-exempt)
+  reports ``degraded``, and the flowgate ring client DEPRIORITIZES a
+  degraded replica instead of declaring it dead.
+- **The 2x overload soak**: a paced backlog under injected delay faults
+  climbs the ladder, sheds deterministically, keeps lag bounded, serves
+  zero 5xx, and recovers to level 0 when the pressure lifts.
+
+`make guard-parity` runs this file unfiltered (slow soaks included).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.cli import (_build_models, _common_flags,
+                                   _gen_flags, _processor_flags)
+from flow_pipeline_tpu.engine import StreamWorker, WorkerConfig
+from flow_pipeline_tpu.gen import FlowGenerator, MockerProfile, ZipfProfile
+from flow_pipeline_tpu.guard import (GUARD_SAMPLE_SEED, GuardConfig,
+                                     GuardController, admission_mask,
+                                     flow_key_lanes, register_guard_metrics)
+from flow_pipeline_tpu.obs.audit import AUDIT_SAMPLE_SEED
+from flow_pipeline_tpu.obs.trace import TRACER
+from flow_pipeline_tpu.serve import ServeServer, SnapshotStore, attach_worker
+from flow_pipeline_tpu.transport import Consumer, InProcessBus, Producer
+from flow_pipeline_tpu.utils.faults import FAULTS
+from flow_pipeline_tpu.utils.flags import KNOWN_FLAGS, FlagSet
+
+T0 = 1_699_999_800  # window-aligned stream start
+N_FLOWS = 12_000
+BATCH = 2048
+
+# a dwell the ladder can never cross inside a test run: forced-level
+# tests pin the level and must not have observe() walk it back
+FROZEN = GuardConfig(lag_budget=1e6, max_level=6, hysteresis=0.5,
+                     dwell=1e9)
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    yield
+    FAULTS.configure(None)
+    TRACER.paused = False
+
+
+def _vals(*extra):
+    fs = _processor_flags(_gen_flags(_common_flags(FlagSet("test"))))
+    return fs.parse([
+        "-produce.profile", "zipf", "-zipf.keys", "200",
+        "-model.ports=false", "-model.ddos=false", "-model.ips=false",
+        "-processor.batch", str(BATCH), *extra,
+    ])
+
+
+def _fill_bus(n_flows=N_FLOWS, seed=17, profile=None, rate=50.0):
+    bus = InProcessBus()
+    bus.create_topic("flows", 1)
+    gen = FlowGenerator(profile or ZipfProfile(n_keys=200, alpha=1.2),
+                        seed=seed, t0=T0, rate=rate)
+    prod = Producer(bus, fixedlen=True)
+    done = 0
+    while done < n_flows:
+        n = min(4096, n_flows - done)
+        prod.send_many(gen.batch(n).to_messages())
+        done += n
+    return bus
+
+
+class ListSink:
+    def __init__(self):
+        self.tables = {}
+
+    def write(self, table, rows):
+        self.tables.setdefault(table, []).append(rows)
+
+
+def _assert_tables_bit_exact(t1: dict, t2: dict):
+    assert set(t1) == set(t2)
+    for table in t1:
+        assert len(t1[table]) == len(t2[table]), table
+        for r1, r2 in zip(t1[table], t2[table]):
+            assert set(r1) == set(r2), table
+            for col in r1:
+                a, b = np.asarray(r1[col]), np.asarray(r2[col])
+                assert a.dtype == b.dtype and a.shape == b.shape, \
+                    (table, col)
+                assert (a == b).all(), (table, col)
+
+
+def _run_worker(bus, guard_lag=0.0, level=0, sink=None, **cfg):
+    sink = ListSink() if sink is None else sink
+    w = StreamWorker(
+        Consumer(bus, "flows", fixedlen=True), _build_models(_vals()),
+        [sink],
+        WorkerConfig(poll_max=BATCH, snapshot_every=0,
+                     guard_lag=guard_lag, **cfg))
+    if level:
+        w.guard.config = FROZEN  # never transitions inside the run
+        w.guard.level = level
+    w.run(stop_when_idle=True)
+    return w, sink
+
+
+def _get(port, path):
+    return json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10).read())
+
+
+# ---------------------------------------------------------------------------
+# admission hash: deterministic, correctly rated, key-pure
+# ---------------------------------------------------------------------------
+
+
+def _key_columns(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "src_addr": rng.integers(0, 2**32, size=(n, 4),
+                                 dtype=np.int64).astype(np.uint32),
+        "dst_addr": rng.integers(0, 2**32, size=(n, 4),
+                                 dtype=np.int64).astype(np.uint32),
+        "src_port": rng.integers(0, 2**16, size=n,
+                                 dtype=np.int64).astype(np.uint32),
+        "dst_port": rng.integers(0, 2**16, size=n,
+                                 dtype=np.int64).astype(np.uint32),
+        "proto": rng.integers(0, 256, size=n,
+                              dtype=np.int64).astype(np.uint32),
+    }
+
+
+class TestAdmissionHash:
+    def test_mask_deterministic_and_keep_rate(self):
+        cols = _key_columns(100_000)
+        for shift in (1, 2, 3, 5):
+            m1, m2 = admission_mask(cols, shift), admission_mask(cols, shift)
+            assert (m1 == m2).all()
+            keep = m1.mean()
+            want = 1 / (1 << shift)
+            # binomial concentration at n=100k: a generous 25% band
+            assert want * 0.75 <= keep <= want * 1.25, (shift, keep)
+
+    def test_shift_zero_admits_everything(self):
+        cols = _key_columns(64)
+        assert admission_mask(cols, 0).all()
+        assert admission_mask(cols, -1).all()
+
+    def test_mask_is_per_key_not_per_position(self):
+        """The mesh/rerun contract: a flow sheds identically no matter
+        which member, batch, or row position carries it."""
+        cols = _key_columns(8192, seed=1)
+        perm = np.random.default_rng(2).permutation(8192)
+        permuted = {k: v[perm] for k, v in cols.items()}
+        assert (admission_mask(cols, 3)[perm]
+                == admission_mask(permuted, 3)).all()
+
+    def test_levels_nest_monotonically(self):
+        """Stepping the ladder down only ever SHRINKS the admitted set:
+        a survivor at shift s+1 survived at shift s too (the low-bits
+        hash criterion) — degradation is monotone, never a reshuffle."""
+        cols = _key_columns(50_000, seed=3)
+        prev = admission_mask(cols, 1)
+        for shift in (2, 3, 4):
+            cur = admission_mask(cols, shift)
+            assert not (cur & ~prev).any(), shift
+            prev = cur
+
+    def test_uncorrelated_with_audit_cohort(self):
+        """The guard seed is deliberately distinct from sketchwatch's:
+        the audit cohort must keep measuring the keys that SURVIVE
+        admission, not be shed first. Pin the seeds apart and the masks
+        statistically independent (joint rate ~ product of rates)."""
+        assert GUARD_SAMPLE_SEED != AUDIT_SAMPLE_SEED
+        from flow_pipeline_tpu.obs.audit import sample_mask
+
+        cols = _key_columns(200_000, seed=4)
+        guard = admission_mask(cols, 2)  # keep 1/4
+        audit = sample_mask(flow_key_lanes(cols))  # ~1/256 cohort
+        joint = (guard & audit).mean()
+        expect = guard.mean() * audit.mean()
+        assert 0.4 * expect <= joint <= 2.5 * expect
+
+    def test_lanes_carry_the_5_tuple(self):
+        cols = _key_columns(16, seed=5)
+        lanes = flow_key_lanes(cols)
+        assert lanes.shape == (16, 11) and lanes.dtype == np.uint32
+        assert (lanes[:, 0:4] == cols["src_addr"]).all()
+        assert (lanes[:, 4:8] == cols["dst_addr"]).all()
+        assert (lanes[:, 8] == cols["src_port"]).all()
+        assert (lanes[:, 9] == cols["dst_port"]).all()
+        assert (lanes[:, 10] == cols["proto"]).all()
+
+
+# ---------------------------------------------------------------------------
+# the ladder state machine (injected clock: fully deterministic)
+# ---------------------------------------------------------------------------
+
+
+class TestLadder:
+    def _armed(self, budget=1.0, dwell=10.0, max_level=6):
+        return GuardController(GuardConfig(
+            lag_budget=budget, max_level=max_level, hysteresis=0.5,
+            dwell=dwell))
+
+    def test_disarmed_never_moves(self):
+        g = GuardController(GuardConfig())  # lag_budget 0 = disarmed
+        assert not g.armed
+        for lag in (0.0, 1e9):
+            assert g.observe(lag, now=100.0) == 0
+        assert g.level == 0 and g.sample_shift == 0
+        assert not g.drop_optional
+
+    def test_steps_down_one_level_per_dwell(self):
+        g = self._armed(budget=1.0, dwell=10.0)
+        assert g.observe(5.0, now=100.0) == 1
+        # inside the dwell window: pinned no matter how bad the lag
+        assert g.observe(500.0, now=105.0) == 1
+        assert g.observe(5.0, now=110.1) == 2
+        assert g.observe(5.0, now=120.2) == 3
+        assert g.m_transitions.value(direction="down") >= 3
+        assert g.sample_shift == 2  # keep 1/4 at level 3
+        assert g.drop_optional
+
+    def test_ceiling_holds(self):
+        g = self._armed(budget=1.0, dwell=1.0, max_level=3)
+        now = 100.0
+        for _ in range(10):
+            g.observe(9.0, now=now)
+            now += 1.1
+        assert g.level == 3
+        assert g.meta()["max_level_seen"] == 3
+
+    def test_recovery_needs_the_hysteresis_band(self):
+        """Under budget but above hysteresis*budget = HOLD (no
+        flapping at the boundary); inside the band = step up, one
+        level per dwell."""
+        g = self._armed(budget=1.0, dwell=10.0)
+        g.observe(5.0, now=100.0)
+        g.observe(5.0, now=110.1)
+        assert g.level == 2
+        # 0.8 is under budget but outside the 0.5 band: held
+        assert g.observe(0.8, now=130.0) == 2
+        assert g.observe(0.1, now=140.0) == 1
+        assert g.observe(0.1, now=145.0) == 1  # dwell gates the way UP too
+        assert g.observe(0.1, now=150.1) == 0
+        assert g.m_transitions.value(direction="up") >= 2
+
+    def test_lag_gauge_tracks_observations(self):
+        g = self._armed()
+        g.observe(3.25, now=100.0)
+        assert g.m_lag.value() == 3.25
+
+    def test_max_level_validation(self):
+        with pytest.raises(ValueError, match="max_level"):
+            GuardController(GuardConfig(lag_budget=1.0, max_level=0))
+
+    def test_worker_config_rejects_negative_budget(self):
+        with pytest.raises(ValueError, match="guard_lag"):
+            StreamWorker(None, {}, [], WorkerConfig(guard_lag=-0.5))
+
+
+# ---------------------------------------------------------------------------
+# admit(): offsets, scale factors, accounting
+# ---------------------------------------------------------------------------
+
+
+class TestAdmit:
+    def _polled_batch(self, n=4096):
+        bus = _fill_bus(n_flows=n)
+        return Consumer(bus, "flows", fixedlen=True).poll(n)
+
+    def test_level_0_and_1_admit_everything(self):
+        g = GuardController(GuardConfig(lag_budget=1.0))
+        batch = self._polled_batch()
+        for level in (0, 1):
+            g.level = level
+            admitted, dropped = g.admit(batch)
+            assert admitted is batch and dropped == 0
+
+    def test_admit_keeps_offsets_scales_survivors_counts_shed(self):
+        g = GuardController(GuardConfig(lag_budget=1.0))
+        g.level = 3  # shift 2: keep 1/4, scale x4
+        batch = self._polled_batch()
+        shed0 = g.m_shed.value(stage="ingest", reason="admission")
+        admitted, dropped = g.admit(batch)
+        assert dropped == len(batch) - len(admitted) > 0
+        # the FULL offset range survives: shed rows were consumed and
+        # accounted, not lost to replay
+        assert admitted.first_offset == batch.first_offset
+        assert admitted.last_offset == batch.last_offset
+        assert admitted.partition == batch.partition
+        assert admitted.produced_at == batch.produced_at
+        # survivors carry the scale (input rate 1 -> 4), exactly
+        sr = admitted.columns["sampling_rate"]
+        assert sr.dtype == np.uint64 and (sr == 4).all()
+        # the survivor set IS the admission mask's
+        mask = admission_mask(batch.columns, 2)
+        assert len(admitted) == int(mask.sum())
+        assert g.m_shed.value(stage="ingest",
+                              reason="admission") == shed0 + dropped
+        assert g.meta()["shed_total"] == dropped
+
+    def test_absent_rate_scales_as_rate_1(self):
+        g = GuardController(GuardConfig(lag_budget=1.0))
+        g.level = 2  # shift 1: scale x2
+        batch = self._polled_batch()
+        batch.columns["sampling_rate"][:] = 0  # exporter sent none
+        admitted, _ = g.admit(batch)
+        assert (admitted.columns["sampling_rate"] == 2).all()
+
+    def test_count_shed_is_never_silent(self):
+        g = GuardController(GuardConfig(lag_budget=1.0))
+        before = g.m_shed.value(stage="serve", reason="queue_full")
+        g.count_shed(7, "serve", "queue_full")
+        g.count_shed(0, "serve", "queue_full")  # no-op, not negative
+        assert g.m_shed.value(stage="serve",
+                              reason="queue_full") == before + 7
+        assert g.meta()["shed_total"] >= 7
+
+    def test_meta_is_json_safe(self):
+        g = GuardController(GuardConfig(lag_budget=2.0))
+        g.level = 4
+        json.dumps(g.meta())  # must not raise
+        assert g.meta()["sample_shift"] == 3
+        assert g.meta()["lag_budget"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# level-0 bit-exactness: THE acceptance gate
+# ---------------------------------------------------------------------------
+
+
+class TestLevel0Parity:
+    def test_disarmed_vs_armed_idle_worker_bit_exact(self):
+        """An armed guard whose lag never leaves budget must not
+        perturb one bit of sink output — the serial worker path."""
+        _, oracle = _run_worker(_fill_bus())
+        w, armed = _run_worker(_fill_bus(), guard_lag=1e6)
+        assert w.guard.armed and w.guard.level == 0
+        _assert_tables_bit_exact(oracle.tables, armed.tables)
+
+    def test_disarmed_vs_armed_idle_pipelined_host_bit_exact(self):
+        """The pipelined host-grouped path: admission runs inside the
+        group-thread prepare wrapper — level 0 must still be exact."""
+        kw = dict(sketch_backend="host", host_assist="on")
+        _, oracle = _run_worker(_fill_bus(), **kw)
+        w, armed = _run_worker(_fill_bus(), guard_lag=1e6, **kw)
+        assert w.guard.armed and w.guard.level == 0
+        _assert_tables_bit_exact(oracle.tables, armed.tables)
+
+
+@pytest.mark.slow  # 2-member mesh ingest x2; gated by `make guard-parity`
+class TestMeshLevel0Parity:
+    def _mesh_tables(self, guard_lag):
+        from flow_pipeline_tpu.engine import WindowedHeavyHitter
+        from flow_pipeline_tpu.mesh import InProcessMesh, produce_sharded
+        from flow_pipeline_tpu.models import (HeavyHitterConfig,
+                                              WindowAggConfig,
+                                              WindowAggregator)
+        from flow_pipeline_tpu.sink import MemorySink
+
+        def models():
+            return {
+                "flows_5m": WindowAggregator(
+                    WindowAggConfig(batch_size=512)),
+                "top_talkers": WindowedHeavyHitter(
+                    HeavyHitterConfig(
+                        key_cols=("src_addr", "dst_addr", "src_port",
+                                  "dst_port", "proto"),
+                        batch_size=512, width=1 << 12, capacity=128),
+                    k=10),
+            }
+
+        bus = InProcessBus()
+        bus.create_topic("flows", 4)
+        gen = FlowGenerator(ZipfProfile(n_keys=200, alpha=1.3), seed=7,
+                            t0=T0, rate=40.0)
+        done = 0
+        while done < 8000:
+            done += produce_sharded(bus, "flows", gen.batch(2048), 4)
+        sink = MemorySink()
+        mesh = InProcessMesh(
+            bus, "flows", 2, model_factory=models,
+            config=WorkerConfig(poll_max=1024, snapshot_every=0,
+                                guard_lag=guard_lag),
+            sinks=[sink])
+        mesh.start()
+        mesh.wait_idle()
+        mesh.finalize()
+        return sink.tables
+
+    def test_armed_idle_mesh_matches_disarmed_mesh(self):
+        oracle = self._mesh_tables(0.0)
+        armed = self._mesh_tables(1e6)
+        assert set(oracle) == set(armed)
+        for table in oracle:
+            assert sorted(map(repr, oracle[table])) \
+                == sorted(map(repr, armed[table])), table
+
+
+# ---------------------------------------------------------------------------
+# sampled admission: deterministic shed set, unbiased scaled estimates
+# ---------------------------------------------------------------------------
+
+
+class TestSampledAdmission:
+    def test_shed_set_reproduces_across_reruns(self):
+        """Two forced-level runs over identical streams shed the SAME
+        flows: sink output bit-exact, counters equal."""
+        w1, s1 = _run_worker(_fill_bus(), guard_lag=1e6, level=3)
+        w2, s2 = _run_worker(_fill_bus(), guard_lag=1e6, level=3)
+        assert w1.guard.meta()["shed_total"] > 0
+        assert w1.flows_seen == w2.flows_seen
+        assert w1.guard.meta()["shed_total"] == w2.guard.meta()["shed_total"]
+        _assert_tables_bit_exact(s1.tables, s2.tables)
+
+    def test_accounting_identity_and_unbiased_scaling(self):
+        """consumed == emitted + shed, exactly; and the scale-aware
+        aggregate (`bytes_scaled`) stays an unbiased estimate of the
+        guard-free total through keep-rate-1/4 admission."""
+        n = 16_384
+        profile = MockerProfile()  # flat key mass: tight concentration
+        _, oracle = _run_worker(_fill_bus(n_flows=n, profile=profile))
+        # the counter is registry-global: assert the run's delta
+        c0 = register_guard_metrics()["shed"].value(stage="ingest",
+                                                    reason="admission")
+        w, armed = _run_worker(_fill_bus(n_flows=n, profile=profile),
+                               guard_lag=1e6, level=3)
+        shed = w.guard.meta()["shed_total"]
+        assert shed > 0
+        assert w.flows_seen + shed == n  # exact accounting
+        assert w.guard.m_shed.value(stage="ingest",
+                                    reason="admission") == c0 + shed
+        # keep rate ~1/4 at level 3
+        assert 0.15 <= w.flows_seen / n <= 0.40
+
+        def totals(sink, col):
+            return sum(int(np.asarray(rows[col]).sum())
+                       for rows in sink.tables["flows_5m"])
+
+        exact = totals(oracle, "bytes")
+        assert totals(oracle, "bytes_scaled") == exact  # rate-1 input
+        scaled = totals(armed, "bytes_scaled")
+        raw = totals(armed, "bytes")
+        assert raw < exact  # 3/4 of the mass was shed...
+        assert abs(scaled - exact) / exact < 0.15  # ...and scaled back
+
+    def test_level_1_pauses_optional_work_sheds_nothing(self):
+        """Level 1 is loud but lossless: the trace ring pauses, yet
+        every flow still lands — shed_total stays 0 and the accounting
+        shows no loss."""
+        w, _ = _run_worker(_fill_bus(n_flows=4096), guard_lag=1e6,
+                           level=1)
+        assert TRACER.paused  # optional work went quiet
+        assert w.flows_seen == 4096
+        assert w.guard.meta()["shed_total"] == 0
+        # and a level-0 run leaves the instruments running
+        w2, _ = _run_worker(_fill_bus(n_flows=4096), guard_lag=1e6)
+        assert not TRACER.paused
+        assert w2.flows_seen == 4096
+
+
+# ---------------------------------------------------------------------------
+# bounded buffers: the byte gauges exist and drain
+# ---------------------------------------------------------------------------
+
+
+def test_buffer_byte_gauges_register_and_drain():
+    """guard_buffer_bytes{stage} tracks the two bounded ingest
+    handoffs (feed prefetch, prepared-batch queue) and reads 0 once
+    the pipeline drains — bounded by construction, observable live."""
+    w, _ = _run_worker(_fill_bus(), sketch_backend="host",
+                       host_assist="on")
+    assert w.executor is not None  # the pipelined path actually ran
+    g = register_guard_metrics()["buffer_bytes"]
+    assert g.value(stage="group") == 0
+    assert g.value(stage="feed") == 0
+    with g._lock:
+        stages = {dict(k).get("stage") for k in g._values}
+    assert {"feed", "group"} <= stages
+
+
+# ---------------------------------------------------------------------------
+# snapshot metadata: readers can tell what level built their answer
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotMetadata:
+    def test_armed_guard_meta_rides_the_snapshot_and_audit_endpoint(self):
+        bus = _fill_bus(n_flows=4096)
+        w = StreamWorker(
+            Consumer(bus, "flows", fixedlen=True),
+            _build_models(_vals()), [ListSink()],
+            WorkerConfig(poll_max=BATCH, snapshot_every=0,
+                         guard_lag=1e6))
+        pub = attach_worker(w, refresh=0.0)
+        w.run(stop_when_idle=True)
+        with w.lock:
+            snap = pub.publish(w)
+        meta = snap.audit["flowguard"]
+        assert meta["level"] == 0 and meta["lag_budget"] == 1e6
+        serve = ServeServer(pub.store, port=0).start()
+        try:
+            doc = _get(serve.port, "/query/audit")
+            assert doc["models"]["flowguard"]["level"] == 0
+        finally:
+            serve.stop()
+
+    def test_disarmed_guard_stays_out_of_the_snapshot(self):
+        bus = _fill_bus(n_flows=4096)
+        w = StreamWorker(
+            Consumer(bus, "flows", fixedlen=True),
+            _build_models(_vals()), [ListSink()],
+            WorkerConfig(poll_max=BATCH, snapshot_every=0))
+        pub = attach_worker(w, refresh=0.0)
+        w.run(stop_when_idle=True)
+        with w.lock:
+            snap = pub.publish(w)
+        assert "flowguard" not in snap.audit
+
+
+# ---------------------------------------------------------------------------
+# read-side admission: bounded accept queue, honest 503, live /healthz
+# ---------------------------------------------------------------------------
+
+
+def _mk_state(version, bump=0):
+    """Minimal canonical state (one dense family, one range table) so
+    the serve/gateway paths have real bodies to answer with."""
+    return {
+        "version": int(version), "created": 100.0 + version,
+        "watermark": float(T0 + 300 * version), "flows_seen": 10 * version,
+        "source": "worker",
+        "families": {
+            "dense": {"kind": "dense", "window_start": T0, "depth": 4,
+                      "key_lanes": 1, "value_cols": [],
+                      "rows": {"port": np.arange(4, dtype=np.uint32)
+                               + np.uint32(bump)},
+                      "cms": None},
+        },
+        "ranges": {"flows_5m": [
+            [T0, {"timeslot": np.asarray([T0], np.int64),
+                  "bytes": np.asarray([bump + 1], np.uint64)}],
+        ]},
+        "audit": {},
+    }
+
+
+def _store_at(versions, bump=0):
+    from flow_pipeline_tpu.gateway import state_to_snapshot
+
+    store = SnapshotStore()
+    for v in versions:
+        store.publish_snapshot(state_to_snapshot(_mk_state(v, bump=bump + v)))
+    return store
+
+
+class TestServeAdmission:
+    def test_queue_full_rejects_loudly_healthz_exempt(self):
+        store = _store_at([1])
+        serve = ServeServer(store, port=0, max_inflight=1,
+                            deadline=0.01).start()
+        g = register_guard_metrics()["shed"]
+        shed0 = g.value(stage="serve", reason="queue_full")
+        e5xx0 = store.m_responses.value(code="503")
+        try:
+            assert _get(serve.port, "/query/topk")["model"] == "dense"
+            assert serve._sem.acquire(timeout=1)  # saturate the queue
+            try:
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{serve.port}/query/topk",
+                        timeout=10)
+                assert ei.value.code == 503
+                assert ei.value.headers["Retry-After"] == "1"
+                assert b"overloaded" in ei.value.read()
+                # liveness stays observable under exactly the overload
+                # that saturates the query paths
+                assert _get(serve.port, "/healthz")["ok"] is True
+            finally:
+                serve._sem.release()
+            # the shed was counted AND attributed; pressure off -> 200s
+            assert g.value(stage="serve",
+                           reason="queue_full") == shed0 + 1
+            assert store.m_responses.value(code="503") == e5xx0 + 1
+            assert _get(serve.port, "/query/topk")["model"] == "dense"
+        finally:
+            serve.stop()
+
+    def test_negative_deadline_rejected(self):
+        with pytest.raises(ValueError, match="deadline"):
+            ServeServer(SnapshotStore(), port=0, max_inflight=1,
+                        deadline=-0.1)
+
+    def test_healthz_reports_degraded_with_guard_level(self):
+        store = _store_at([1])
+        guard = GuardController(GuardConfig(lag_budget=1.0))
+        serve = ServeServer(store, port=0).set_guard(guard).start()
+        try:
+            h = _get(serve.port, "/healthz")
+            assert h["degraded"] is False and "guard_level" not in h
+            guard.level = 2
+            h = _get(serve.port, "/healthz")
+            assert h["degraded"] is True and h["guard_level"] == 2
+        finally:
+            serve.stop()
+
+
+class TestRingDeprioritizesDegraded:
+    def test_503_reroutes_without_declaring_dead(self):
+        """A replica answering 503 + Retry-After is DEGRADED: the ring
+        client reroutes to another arc (zero surfaced errors) and only
+        when EVERY replica sheds does the honest 503 surface."""
+        from flow_pipeline_tpu.gateway import GatewayClient
+
+        deg = ServeServer(_store_at([1]), port=0, max_inflight=1,
+                          deadline=0.01).start()
+        ok = ServeServer(_store_at([1]), port=0, max_inflight=1,
+                         deadline=0.01).start()
+        try:
+            deg_node = f"127.0.0.1:{deg.port}"
+            client = GatewayClient([deg_node, f"127.0.0.1:{ok.port}"])
+            path = next(p for p in (f"/query/topk?k={i}"
+                                    for i in range(300))
+                        if client.ring.node_for(p) == deg_node)
+            assert deg._sem.acquire(timeout=1)  # saturate the one arc
+            try:
+                code, body = client.get(path)
+                assert code == 200 and b"dense" in body
+                assert client.deprioritized >= 1
+                assert client.retries == 0  # degraded, NOT dead
+                # every arc overloaded: the shed is surfaced honestly,
+                # retryable — never a transport error
+                assert ok._sem.acquire(timeout=1)
+                try:
+                    code, body = client.get(path)
+                    assert code == 503 and b"overloaded" in body
+                finally:
+                    ok._sem.release()
+            finally:
+                deg._sem.release()
+        finally:
+            deg.stop()
+            ok.stop()
+
+
+# ---------------------------------------------------------------------------
+# -gateway.adopt-restart: both restart stances (the r20 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestAdoptRestart:
+    def _wired_gateway(self, **kw):
+        from flow_pipeline_tpu.gateway import SnapshotGateway
+
+        up_store = _store_at([1, 2, 3])
+        gw = SnapshotGateway([up_store], poll=60, **kw)
+        srv = ServeServer(gw.store, port=0).start()
+        gw.serve_on(srv)
+        assert gw.sync_once() == "full"
+        assert gw.store.current.version == 3
+        return gw, srv
+
+    def _restart_upstream(self, gw, versions, bump):
+        from flow_pipeline_tpu.gateway import SnapshotFeed
+
+        fresh = _store_at(versions, bump=bump)
+        gw.upstreams[0]._feed = SnapshotFeed(fresh)
+        return fresh
+
+    def test_default_keeps_pre_restart_snapshot(self):
+        """The monotone default: the restart is counted (the alert's
+        signal) but never adopted — readers keep the old world until an
+        operator restarts the replica."""
+        gw, srv = self._wired_gateway()
+        old = _get(srv.port, "/query/topk")["rows"]
+        up = gw.upstreams[0]
+        r0 = gw._m["upstream_restarts"].value(upstream=up.name)
+        self._restart_upstream(gw, [1], bump=100)
+        try:
+            assert gw.sync_once() == "full"
+            assert gw.store.current.version == 3  # never adopted
+            assert gw._m["upstream_restarts"].value(
+                upstream=up.name) == r0 + 1
+            assert _get(srv.port, "/query/topk")["rows"] == old
+        finally:
+            srv.stop()
+
+    def test_adopt_restart_swaps_worlds_and_flushes_the_cache(self):
+        """-gateway.adopt-restart: availability wins. The full frame is
+        adopted, the restart is STILL counted (never silent), and the
+        response cache is flushed — when the post-restart stream later
+        reaches v3 again, its version number COLLIDES with the old
+        world's cached v3 body, which the version-equality cache check
+        alone cannot tell apart."""
+        from flow_pipeline_tpu.gateway import state_to_snapshot
+
+        gw, srv = self._wired_gateway(adopt_restart=True)
+        old_rows = _get(srv.port, "/query/topk")["rows"]  # cache primed
+        up = gw.upstreams[0]
+        r0 = gw._m["upstream_restarts"].value(upstream=up.name)
+        fresh = self._restart_upstream(gw, [1], bump=100)
+        try:
+            assert gw.sync_once() == "full"
+            # adopted: the replica jumped BACKWARD to the new world
+            assert gw.store.current.version == 1
+            assert gw._m["upstream_restarts"].value(
+                upstream=up.name) == r0 + 1
+            assert _get(srv.port, "/query/topk")["rows"][0]["port"] == 101
+            # the post-restart stream flows normally (deltas) and walks
+            # back up to the colliding version number
+            for v in (2, 3):
+                fresh.publish_snapshot(
+                    state_to_snapshot(_mk_state(v, bump=100 + v)))
+            assert gw.sync_once() == "delta"
+            assert gw.store.current.version == 3
+            new_rows = _get(srv.port, "/query/topk")["rows"]
+            assert new_rows != old_rows  # NOT the stale cached v3 body
+            assert new_rows[0]["port"] == 103
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the 2x overload soak (slow): bounded lag, exact accounting, zero 5xx
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # multi-second backlog soak; gated by `make guard-parity`
+class TestOverloadSoak:
+    def test_backlog_under_injected_delay_sheds_recovers_exactly(self):
+        """A prefilled backlog consumed under injected poll-delay
+        faults drives lag past a tight budget: the ladder climbs to
+        sampling levels, admission sheds deterministically, lag stays
+        bounded, the serve surface answers zero 5xx with /healthz
+        flipping degraded, and the accounting closes exactly —
+        consumed == emitted + shed. When the backlog drains, idle
+        observations walk the ladder back to level 0."""
+        n = 60_000
+        bus = _fill_bus(n_flows=n, rate=200.0)
+        sink = ListSink()
+        w = StreamWorker(
+            Consumer(bus, "flows", fixedlen=True),
+            _build_models(_vals()), [sink],
+            WorkerConfig(poll_max=BATCH, snapshot_every=0, prefetch=0,
+                         guard_lag=0.25))
+        # bench-cadence ladder: the production 5 s dwell cannot climb
+        # inside a seconds-long soak
+        w.guard.config = GuardConfig(lag_budget=0.25, max_level=6,
+                                     hysteresis=0.5, dwell=0.1)
+        pub = attach_worker(w, refresh=0.0)
+        with w.lock:
+            pub.publish(w)
+        serve = ServeServer(pub.store, port=0).set_guard(w.guard).start()
+        c0 = register_guard_metrics()["shed"].value(stage="ingest",
+                                                    reason="admission")
+        # the responses counter is registry-global: snapshot the 5xx
+        # families now and assert the SOAK added none
+        def _5xx_total():
+            with pub.store.m_responses._lock:
+                return sum(v for k, v in
+                           pub.store.m_responses._values.items()
+                           if dict(k).get("code", "").startswith("5"))
+        e0 = _5xx_total()
+        FAULTS.configure("bus.poll:delay=0.02@seed=5")
+        max_lag = 0.0
+        degraded_seen = False
+        try:
+            while w.run_once():
+                max_lag = max(max_lag, w.guard.m_lag.value())
+                if w.batches_seen % 4 == 0:
+                    h = _get(serve.port, "/healthz")
+                    degraded_seen |= h["degraded"]
+                    assert _get(serve.port,
+                                "/query/version")["version"] >= 1
+            w.finalize()
+        finally:
+            FAULTS.configure(None)
+        meta = w.guard.meta()
+        # the ladder engaged past the pause level into sampling
+        assert meta["max_level_seen"] >= 2
+        assert degraded_seen
+        # exact shed accounting: every consumed flow is emitted or
+        # counted shed, nothing silent, nothing double-counted
+        assert meta["shed_total"] > 0
+        assert w.flows_seen + meta["shed_total"] == n
+        assert w.guard.m_shed.value(
+            stage="ingest", reason="admission") == c0 + meta["shed_total"]
+        # lag stayed bounded (the backlog is finite and shedding bites)
+        assert max_lag < 30.0
+        # zero serve 5xx through the whole soak
+        assert _5xx_total() == e0
+        # pressure off: idle observations recover to exact, with the
+        # dwell pacing each step up
+        deadline = time.monotonic() + 30
+        while w.guard.level > 0 and time.monotonic() < deadline:
+            w.guard.observe(0.0)
+            time.sleep(0.02)
+        assert w.guard.level == 0
+        h = _get(serve.port, "/healthz")
+        assert h["degraded"] is False
+        serve.stop()
+
+
+# ---------------------------------------------------------------------------
+# flags / wiring
+# ---------------------------------------------------------------------------
+
+
+def test_guard_flags_registered_and_parsed():
+    assert {"guard.lag", "guard.max_level", "guard.serve_queue",
+            "guard.serve_deadline",
+            "gateway.adopt-restart"} <= KNOWN_FLAGS
+    fs = FlagSet("t")
+    fs.number("guard.lag", 0.0, "h")
+    fs.integer("guard.max_level", 6, "h")
+    fs.integer("guard.serve_queue", 0, "h")
+    fs.number("guard.serve_deadline", 0.1, "h")
+    fs.boolean("gateway.adopt-restart", False, "h")
+    vals = fs.parse(["-guard.lag", "2.5", "-guard.max_level", "4",
+                     "-guard.serve_queue", "64",
+                     "-gateway.adopt-restart"])
+    assert vals["guard.lag"] == 2.5
+    assert vals["guard.max_level"] == 4
+    assert vals["guard.serve_queue"] == 64
+    assert vals["guard.serve_deadline"] == 0.1
+    assert vals["gateway.adopt-restart"] is True
+
+
+def test_faults_delay_clause_sleeps_and_counts():
+    """The r20 `-faults` delay grammar: a delay-only clause hits with
+    p=1, SLEEPS instead of raising, and is counted per site on
+    faults_delayed_total — the overload soak's stall injector."""
+    FAULTS.configure("bus.poll:delay=0.01;sink.write:p=0@seed=3")
+    try:
+        t0 = time.perf_counter()
+        FAULTS.check("bus.poll")  # must not raise
+        assert time.perf_counter() - t0 >= 0.008
+        FAULTS.check("sink.write")  # p=0: never fires
+        snap = FAULTS.snapshot()
+        assert snap["bus.poll"]["delayed"] == 1
+        assert snap["bus.poll"]["p"] == 1.0  # delay-only implies p=1
+        assert snap["sink.write"]["delayed"] == 0
+    finally:
+        FAULTS.configure(None)

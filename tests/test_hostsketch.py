@@ -494,3 +494,89 @@ class TestWorkerIntegration:
                 assert _canon_table(ref.rows[table]) == \
                     _canon_table(got.rows[table]), \
                     f"{first}->{second}: table {table} diverged"
+
+
+class TestScatterBranchParity:
+    """r20 degraded-mode fast path: the numpy twin's two scatter
+    implementations — ufunc.at (numpy >= 1.25 indexed loops) and the
+    grouped sort+reduceat rescue for older numpy — must be bit-exact
+    twins, and the bucket-reuse engine step must not drift from either.
+    u64 wrap sums and maxes are order-free, so any divergence is a bug,
+    not a rounding story."""
+
+    def _chunks(self, cfg, n_chunks=6, b=1024, seed=3):
+        rng = np.random.default_rng(seed)
+        from flow_pipeline_tpu.hostsketch.state import (host_hh_init,
+                                                         host_inv_init)
+        kw = host_hh_init(cfg).table_keys.shape[1] \
+            if cfg.hh_sketch == "table" else \
+            host_inv_init(cfg).keysum.shape[2]
+        out = []
+        for _ in range(n_chunks):
+            uniq = np.zeros((b, kw), np.uint32)
+            uniq[:, :5] = rng.integers(0, 2**32, size=(b, 5),
+                                       dtype=np.int64).astype(np.uint32)
+            planes = 3 if cfg.hh_sketch == "table" else 3
+            sums = rng.random((b, planes)).astype(np.float32) * 1e4
+            if cfg.hh_sketch == "invertible":
+                sums[:, -1] = 1.0  # count plane
+            out.append((uniq, sums))
+        return out
+
+    def _fold(self, cfg, chunks, grouped):
+        old = hs_engine._GROUPED_SCATTER
+        hs_engine._GROUPED_SCATTER = grouped
+        try:
+            eng = hs_engine.HostSketchEngine([cfg], use_native="numpy")
+            eng.reset(0)
+            for uniq, sums in chunks:
+                eng.update(0, uniq, sums, uniq.shape[0])
+        finally:
+            hs_engine._GROUPED_SCATTER = old
+        return eng.states[0]
+
+    @pytest.mark.parametrize("conservative", [True, False])
+    def test_table_family_branches_bit_exact(self, conservative):
+        cfg = HeavyHitterConfig(
+            key_cols=("src_addr", "dst_addr", "src_port", "dst_port",
+                      "proto"),
+            batch_size=1024, width=1 << 10, capacity=128,
+            conservative=conservative)
+        chunks = self._chunks(cfg)
+        a = self._fold(cfg, chunks, grouped=False)
+        b = self._fold(cfg, chunks, grouped=True)
+        np.testing.assert_array_equal(a.cms, b.cms)
+        np.testing.assert_array_equal(a.table_keys, b.table_keys)
+        np.testing.assert_array_equal(a.table_vals, b.table_vals)
+
+    def test_invertible_family_branches_bit_exact(self):
+        cfg = HeavyHitterConfig(
+            key_cols=("src_addr", "dst_addr", "src_port", "dst_port",
+                      "proto"),
+            batch_size=1024, width=1 << 10, hh_sketch="invertible")
+        chunks = self._chunks(cfg)
+        a = self._fold(cfg, chunks, grouped=False)
+        b = self._fold(cfg, chunks, grouped=True)
+        np.testing.assert_array_equal(a.cms, b.cms)
+        np.testing.assert_array_equal(a.keysum, b.keysum)
+        np.testing.assert_array_equal(a.keycheck, b.keycheck)
+
+    def test_bucket_reuse_matches_fresh_hash(self):
+        """np_cms_update/query with caller-precomputed buckets must
+        bit-equal the self-hashing call — the reuse is the r20 degraded
+        fast path's main lever."""
+        rng = np.random.default_rng(5)
+        keys = rng.integers(0, 2**32, size=(512, 11),
+                            dtype=np.int64).astype(np.uint32)
+        vals = rng.random((512, 3)).astype(np.float32) * 100
+        buckets = hs_engine._np_buckets(keys, 4, 1 << 10)
+        for conservative in (True, False):
+            a = np.zeros((3, 4, 1 << 10), np.uint64)
+            b = np.zeros((3, 4, 1 << 10), np.uint64)
+            hs_engine.np_cms_update(a, keys, vals, conservative)
+            hs_engine.np_cms_update(b, keys, vals, conservative,
+                                    buckets=buckets)
+            np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(
+            hs_engine.np_cms_query(a, keys),
+            hs_engine.np_cms_query(a, keys, buckets))

@@ -209,3 +209,175 @@ class TestSpaceSavingAdmission:
 
         drive_admission_rounds(
             [[(k, float(v)) for k, v in pairs] for pairs in rounds])
+
+
+class TestRetryProperty:
+    """utils/retry.py invariants for arbitrary policy parameters: the
+    delay schedule is bounded by [min(cap, base*2^i), that * (1+jitter)],
+    has exactly attempts-1 entries, and is a pure function of the rng
+    seed; retry_call's attempt accounting matches the schedule exactly."""
+
+    @given(attempts=st.integers(1, 8),
+           base=st.floats(1e-4, 1.0, allow_nan=False),
+           cap=st.floats(1e-4, 4.0, allow_nan=False),
+           jitter=st.floats(0.0, 1.0, allow_nan=False),
+           seed=st.integers(0, 2**32 - 1))
+    @settings(max_examples=100, deadline=None)
+    def test_backoff_bounds_count_and_determinism(self, attempts, base,
+                                                  cap, jitter, seed):
+        import random
+
+        from flow_pipeline_tpu.utils.retry import backoff_delays
+
+        delays = list(backoff_delays(attempts, base, cap, jitter,
+                                     random.Random(seed)))
+        assert len(delays) == attempts - 1
+        for i, d in enumerate(delays):
+            lo = min(cap, base * (2 ** i))
+            assert lo * (1.0 - 1e-12) <= d <= lo * (1.0 + jitter) \
+                * (1.0 + 1e-12)
+        assert delays == list(backoff_delays(attempts, base, cap, jitter,
+                                             random.Random(seed)))
+
+    @given(fails=st.integers(0, 10), attempts=st.integers(1, 8),
+           seed=st.integers(0, 2**32 - 1))
+    @settings(max_examples=100, deadline=None)
+    def test_retry_call_attempt_accounting(self, fails, attempts, seed):
+        import random
+
+        from flow_pipeline_tpu.utils.retry import (backoff_delays,
+                                                   retry_call)
+
+        calls = {"n": 0}
+        sleeps = []
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] <= fails:
+                raise OSError("transient")
+            return "ok"
+
+        if fails < attempts:
+            assert retry_call(fn, attempts=attempts, sleep=sleeps.append,
+                              rng=random.Random(seed)) == "ok"
+            assert calls["n"] == fails + 1
+            # the observed sleeps are exactly the schedule's prefix
+            expect = list(backoff_delays(attempts, 0.05, 2.0, 0.25,
+                                         random.Random(seed)))[:fails]
+            assert sleeps == expect
+        else:
+            with pytest.raises(OSError):
+                retry_call(fn, attempts=attempts, sleep=sleeps.append,
+                           rng=random.Random(seed))
+            assert calls["n"] == attempts  # the cap is a hard cap
+            assert len(sleeps) == attempts - 1
+
+    @given(attempts=st.integers(1, 8))
+    @settings(max_examples=20, deadline=None)
+    def test_non_retryable_propagates_first_call(self, attempts):
+        from flow_pipeline_tpu.utils.retry import retry_call
+
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            raise ValueError("deterministic bug")
+
+        with pytest.raises(ValueError):
+            retry_call(fn, attempts=attempts,
+                       sleep=lambda _: pytest.fail("slept on a "
+                                                   "non-retryable"))
+        assert calls["n"] == 1
+
+
+class TestFaultsProperty:
+    """utils/faults.py stream discipline: a site's Bernoulli stream is a
+    pure function of (plan seed, call index AT THAT SITE) — interleaving
+    calls to other sites, or adding sites to the plan, must not shift
+    it; snapshot() accounting is exact; the parse grammar round-trips."""
+
+    @given(p_a=st.floats(0.0, 1.0, allow_nan=False),
+           p_b=st.floats(0.0, 1.0, allow_nan=False),
+           seed=st.integers(0, 10**6),
+           schedule=st.lists(st.booleans(), max_size=60))
+    @settings(max_examples=60, deadline=None)
+    def test_per_site_stream_invariant_under_interleaving(
+            self, p_a, p_b, seed, schedule):
+        from flow_pipeline_tpu.utils.faults import FAULTS
+
+        n_a = sum(schedule)
+        try:
+            FAULTS.configure(f"sink.write:p={p_a!r}@seed={seed}")
+            ref = [FAULTS.should_fail("sink.write") for _ in range(n_a)]
+            FAULTS.configure(f"sink.write:p={p_a!r};"
+                             f"bus.poll:p={p_b!r}@seed={seed}")
+            got = []
+            for roll_a in schedule:
+                if roll_a:
+                    got.append(FAULTS.should_fail("sink.write"))
+                else:
+                    FAULTS.should_fail("bus.poll")
+            assert got == ref
+        finally:
+            FAULTS.configure(None)
+
+    @given(p=st.floats(0.0, 1.0, allow_nan=False),
+           seed=st.integers(0, 10**6), rolls=st.integers(0, 80))
+    @settings(max_examples=60, deadline=None)
+    def test_snapshot_accounting_exact(self, p, seed, rolls):
+        from flow_pipeline_tpu.utils.faults import FAULTS
+
+        try:
+            FAULTS.configure(f"sink.write:p={p!r}@seed={seed}")
+            hits = sum(FAULTS.should_fail("sink.write")
+                       for _ in range(rolls))
+            snap = FAULTS.snapshot()["sink.write"]
+            expected_rolls = rolls if p > 0.0 else 0  # p=0: no stream
+            assert snap["rolls"] == expected_rolls
+            assert snap["injected"] == hits
+            assert snap["delayed"] == 0
+        finally:
+            FAULTS.configure(None)
+
+    @given(p=st.floats(0.0, 1.0, allow_nan=False),
+           seed=st.integers(0, 10**6), rolls=st.integers(0, 60))
+    @settings(max_examples=40, deadline=None)
+    def test_delay_sites_never_fail_and_share_the_stream(self, p, seed,
+                                                         rolls):
+        """A latency site's hits are the SAME Bernoulli stream as a
+        failure site at the same (p, seed) — the delay only changes what
+        a hit does — and should_fail() never reports them as failures."""
+        from flow_pipeline_tpu.utils.faults import FAULTS
+
+        try:
+            FAULTS.configure(f"sink.write:p={p!r}@seed={seed}")
+            fail_hits = [FAULTS.should_fail("sink.write")
+                         for _ in range(rolls)]
+            FAULTS.configure(
+                f"sink.write:p={p!r}:delay=0.001@seed={seed}")
+            delay_fails = [FAULTS.should_fail("sink.write")
+                           for _ in range(rolls)]
+            snap = FAULTS.snapshot().get("sink.write", {"delayed": 0})
+            assert not any(delay_fails)  # latency sites never FAIL
+            assert snap["delayed"] == sum(fail_hits)  # same stream
+        finally:
+            FAULTS.configure(None)
+
+    @given(p=st.floats(0.0, 1.0, allow_nan=False),
+           delay=st.floats(0.001, 60.0, allow_nan=False),
+           seed=st.integers(0, 10**6))
+    @settings(max_examples=60, deadline=None)
+    def test_parse_plan_full_round_trip(self, p, delay, seed):
+        from flow_pipeline_tpu.utils.faults import (parse_plan,
+                                                    parse_plan_full)
+
+        spec = f"sink.write:p={p!r}:delay={delay!r}@seed={seed}"
+        sites, got_seed = parse_plan_full(spec)
+        assert got_seed == seed
+        assert sites == {"sink.write": (p, delay)}
+        # the probability-only view drops the delay, keeps p
+        probs, _ = parse_plan(spec)
+        assert probs == {"sink.write": p}
+        # delay-only form implies p=1
+        sites2, _ = parse_plan_full(f"sink.write:delay={delay!r}")
+        assert sites2 == {"sink.write": (1.0, delay)}
