@@ -6,7 +6,7 @@
 .PHONY: all native test bench proto clean services-test lint native-san \
 	hostsketch-parity fused-parity fused-parity-traced mesh-parity \
 	mesh-parity-traced serve-load audit-parity invertible-parity \
-	chaos-parity gateway-parity guard-parity
+	chaos-parity gateway-parity guard-parity spread-parity
 
 all: native
 
@@ -137,6 +137,22 @@ gateway-parity:
 # (consumed = emitted + shed) — docs/FAULT_TOLERANCE.md "flowguard".
 guard-parity:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_guard.py -v
+
+# flowspread (models/spread.py, ops/spread.py): the distinct-count
+# family's citizenship gates, run against a FRESHLY BUILT library —
+# three bit-exact twins (numpy reference vs jnp kernel vs threaded C at
+# threads {1,2,8}, u8-saturation edges included), mesh merges at
+# N in {1,2,4} bit-identical to a single worker (restart-and-replay
+# churn included), /query/spread byte-parity through the delta-fed
+# gateway, checkpoint round-trip, and the spread audit's observational
+# purity (docs/ARCHITECTURE.md "flowspread" states the contract).
+# The property leg tolerates pytest exit 5: test_property.py skips as a
+# whole module where hypothesis is absent (repo convention).
+spread-parity:
+	$(MAKE) -C native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_spread.py -v
+	JAX_PLATFORMS=cpu python -m pytest tests/test_property.py \
+		-k TestSpreadProperty -q || [ $$? -eq 5 ]
 
 # sketchwatch (obs/audit.py): the accuracy-observability suite — the
 # audit must be purely observational (audit-on vs audit-off sink rows

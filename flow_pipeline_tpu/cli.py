@@ -43,6 +43,9 @@ def _gen_flags(fs: FlagSet) -> FlagSet:
     fs.string("produce.profile", "mocker", "mocker | zipf")
     fs.integer("zipf.keys", 10_000, "Distinct keys in zipf mode")
     fs.number("zipf.alpha", 1.2, "Zipf exponent")
+    fs.number("zipf.spread", 0.0,
+              "Fraction of zipf-mode flows emitted by skewed-fan-out "
+              "spreader/scanner legs (0 disables; exercises -spread.*)")
     fs.boolean("produce.shard", False,
                "Partition produced flows by 5-tuple KEY HASH over "
                "-bus.partitions partitions (the flowmesh shard "
@@ -54,7 +57,8 @@ def _make_generator(vals):
     from .gen import FlowGenerator, MockerProfile, ZipfProfile
 
     profile = (
-        ZipfProfile(n_keys=vals["zipf.keys"], alpha=vals["zipf.alpha"])
+        ZipfProfile(n_keys=vals["zipf.keys"], alpha=vals["zipf.alpha"],
+                    spread_fraction=vals["zipf.spread"])
         if vals["produce.profile"] == "zipf"
         else MockerProfile()
     )
@@ -259,6 +263,31 @@ def _build_models(vals):
             )
         else:
             models["ddos_alerts"] = DDoSDetector(DDoSConfig(batch_size=batch))
+    if vals.get("spread.enabled"):
+        # flowspread distinct-count detectors (models/superspreader.py,
+        # models/scan.py). Spread state is host-resident numpy u8
+        # registers by design — like the invertible hh family it has no
+        # device layout to shard, so refuse -processor.mesh instead of
+        # silently running an unsharded model beside sharded ones.
+        if mesh:
+            raise ValueError(
+                "-spread.enabled does not support -processor.mesh device "
+                "sharding (host-resident u8 register planes); use "
+                "flowmesh workers instead")
+        from .models.scan import SCAN_MODEL, scan_config, scan_model
+        from .models.superspreader import (
+            SUPERSPREADER_MODEL,
+            superspreader_config,
+            superspreader_model,
+        )
+
+        sizing = dict(depth=vals["spread.depth"], width=vals["spread.width"],
+                      registers=vals["spread.regs"],
+                      capacity=vals["spread.capacity"], batch_size=batch)
+        models[SUPERSPREADER_MODEL] = superspreader_model(
+            superspreader_config(**sizing), k=vals["spread.topk"])
+        models[SCAN_MODEL] = scan_model(
+            scan_config(**sizing), k=vals["spread.topk"])
     return models
 
 
@@ -296,6 +325,19 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
               "plain u64 sum; ignores -sketch.prefilter/-sketch."
               "admission and forces the plain CMS update; wants "
               "-sketch.backend=host)")
+    fs.boolean("spread.enabled", False,
+               "flowspread distinct-count detectors: superspreaders "
+               "(src -> distinct dst addrs) + portscan (src -> distinct "
+               "dst ports); host-resident register planes, incompatible "
+               "with -processor.mesh")
+    fs.integer("spread.depth", 2, "Spread sketch rows (min over rows at "
+                                  "decode)")
+    fs.integer("spread.width", 1 << 12, "Spread sketch buckets per row")
+    fs.integer("spread.regs", 64, "u8 registers per spread bucket "
+                                  "(~1.04/sqrt(m) rel err past the "
+                                  "linear-counting regime)")
+    fs.integer("spread.capacity", 512, "Spread candidate-table capacity")
+    fs.integer("spread.topk", 64, "Spread rows emitted per window")
     fs.string("sketch.admission", "est",
               "Top-K table admission: est (space-saving, CMS-seeded) | "
               "plain (batch-sum merge; benchmarking A/B only)")

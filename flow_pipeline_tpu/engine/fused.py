@@ -44,6 +44,7 @@ from ..models import heavy_hitter as hh
 from ..models.ddos import DDoSDetector, _accumulate_grouped
 from ..models.dense_top import DenseTopKModel, dense_update
 from ..models.heavy_hitter import HeavyHitterModel
+from ..models.spread import SpreadModel
 from ..models.window_agg import WindowAggregator
 from ..models.window_agg import _cached_update as _cached_wagg_update
 from ..obs import get_logger
@@ -280,7 +281,7 @@ class FusedPipeline:
             if type(m) is WindowAggregator:
                 batch_sizes.add(m.config.batch_size)
             elif type(m) is WindowedHeavyHitter and type(m.model) in (
-                    HeavyHitterModel, DenseTopKModel):
+                    HeavyHitterModel, DenseTopKModel, SpreadModel):
                 whh_windows.add(m.window_seconds)
                 batch_sizes.add(m.config.batch_size)
             elif type(m) is DDoSDetector:
@@ -299,7 +300,14 @@ class FusedPipeline:
         self._hh: list[tuple[str, WindowedHeavyHitter]] = []
         self._dense: list[tuple[str, WindowedHeavyHitter]] = []
         self._ddos: list[tuple[str, DDoSDetector]] = []
-        self._whh: list[WindowedHeavyHitter] = []  # hh + dense wrappers
+        # spread wrappers ride the SAME window lifecycle (_advance_hh
+        # closes every _whh member in lockstep) but not the jitted step:
+        # their state is host numpy by design and their grouping key
+        # (key + counted element) cannot share the hh pre-agg, so each
+        # chunk updates them host-side — the max monoid makes that
+        # bit-identical to any other chunking/ordering.
+        self._spread: list[tuple[str, WindowedHeavyHitter]] = []
+        self._whh: list[WindowedHeavyHitter] = []  # hh/dense/spread wrappers
         for name, m in models.items():
             if type(m) is WindowAggregator:
                 self._waggs.append((name, m))
@@ -307,6 +315,9 @@ class FusedPipeline:
                 self._ddos.append((name, m))
             elif type(m.model) is HeavyHitterModel:
                 self._hh.append((name, m))
+                self._whh.append(m)
+            elif type(m.model) is SpreadModel:
+                self._spread.append((name, m))
                 self._whh.append(m)
             else:
                 self._dense.append((name, m))
@@ -354,6 +365,8 @@ class FusedPipeline:
         for _, w in self._dense:
             add(w.config.key_col, *w.config.value_cols,
                 *scale_of(w.config))
+        for _, w in self._spread:
+            add(*w.config.key_cols, w.config.elem_col)
         for _, d in self._ddos:
             add("dst_addr", d.config.value_col, *scale_of(d.config))
         return tuple(cols)
@@ -461,7 +474,14 @@ class FusedPipeline:
     def _run_chunks(self, part: FlowBatch, do_hh: bool, do_dd: bool) -> None:
         bs = self._bs
         for start in range(0, len(part), bs):
-            padded, mask = part.slice(start, start + bs).pad_to(bs)
+            chunk = part.slice(start, start + bs)
+            if do_hh:
+                # host-side spread fold per chunk (see __init__): the
+                # chunk is <= one model batch, so model.update makes
+                # exactly one grouped pass over it
+                for _, w in self._spread:
+                    w.model.update(chunk)
+            padded, mask = chunk.pad_to(bs)
             host_cols = padded.device_columns(self._cols)
             cols = {k: jnp.asarray(v) for k, v in host_cols.items()}
             valid = jnp.asarray(mask)

@@ -47,6 +47,7 @@ from ..ingest.shard import ShardPool, group_by_key_sharded, shared_pool
 from ..models import heavy_hitter as hh
 from ..models.ddos import _accumulate_grouped
 from ..models.dense_top import dense_update
+from ..models.spread import SpreadState, spread_key_width
 from ..obs import REGISTRY, get_logger
 from ..obs.tracing import StageTimer
 from ..ops.hostgroup import native_group_available, select_lanes
@@ -110,6 +111,13 @@ class PreparedChunk(NamedTuple):
     # GROUP thread (pure hash+mask work) so the worker thread only pays
     # the uint64 fold. None = audit off, or an unsplit caller.
     audit_in: Optional[list] = None
+    # flowspread (models/spread.py): per spread family
+    # (pairs [G, kw+ew] u32 unique (key, element) rows,
+    #  cand_keys [Gk, kw] u32, cand_counts [Gk] f32 per-key distinct-
+    # pair counts — the table admission metric). Grouping to unique
+    # pairs happens here on the group thread; the apply half only pays
+    # the register scatter-max + table merge. None = no spread models.
+    spread_in: Optional[list] = None
 
 
 class PreparedBatch(NamedTuple):
@@ -267,6 +275,17 @@ class HostGroupPipeline(FusedPipeline):
                 mode=audit)
             for name, w in self._hh:
                 w.audit_hook = self._audit_close_hook(name)
+        # flowspread shadow: exact distinct SETS per sampled key (the
+        # set insert is idempotent, so the shadow shares the registers'
+        # order-freedom). Same mode knob, same ~1/256 protocol sampler.
+        self.spread_audit = None
+        if audit != "off" and self._spread:
+            from ..obs.audit import SpreadAudit
+
+            self.spread_audit = SpreadAudit(
+                {name: w.config for name, w in self._spread}, mode=audit)
+            for name, w in self._spread:
+                w.audit_hook = self._spread_close_hook(name)
         # Grouping backends (ingest runtime knobs): shards=1 disables the
         # sharded path entirely; 0 sizes it to the pool. native_group
         # requests the C hash-group kernel and quietly degrades to numpy
@@ -280,6 +299,13 @@ class HostGroupPipeline(FusedPipeline):
             mark_native_serving("group")
         self._shards = shards
         self._pool = None if shards == 1 else (pool or shared_pool())
+        # flowspread fold knobs: the staged pipeline folds the register
+        # scatter single-threaded on the worker thread with no stats
+        # buffer; HostSketchPipeline._init_spread raises the thread
+        # count to its engine's and attaches a flowtrace buffer (the
+        # native kernel's per-depth ownership keeps ANY count bit-exact).
+        self._spread_threads = 1
+        self._spread_stats = None
         self._widths = {}
         # Sketch-family plan: group the maximal key families from raw
         # rows; regroup every strict-subset family (equal value planes)
@@ -360,11 +386,14 @@ class HostGroupPipeline(FusedPipeline):
         # flows_5m: exact uint64 groupby straight into the window store —
         # no device partials on this path
         wagg = [self._wagg_rows(m, cols, n) for _, m in self._waggs]
+        spread_in = self._prep_spread(cols) if self._spread else None
         if not (self._hh or self._dense or self._ddos):
-            return PreparedChunk(wagg, None, None, None)
+            return PreparedChunk(wagg, None, None, None,
+                                 spread_in=spread_in)
         fams = (self._group_families(cols)
                 if (self._hh or self._ddos) else None)
-        prep = PreparedChunk(wagg, *self._prep_device(cols, fams, n))
+        prep = PreparedChunk(wagg, *self._prep_device(cols, fams, n),
+                             spread_in=spread_in)
         if self.audit is not None and prep.hh_in is not None:
             # audit pre-extraction rides the prepare half (group
             # thread) exactly like the tables it samples from
@@ -381,6 +410,34 @@ class HostGroupPipeline(FusedPipeline):
     def _wagg_rows(self, m, cols: dict, n: int):
         lanes, planes = self._build_wagg_inputs(m.config, cols, n)
         return self._group_exact_planes(lanes, planes)
+
+    def _prep_spread(self, cols: dict) -> list:
+        """Per spread family: group the chunk to unique (key, element)
+        pair rows — the registers' input; the max monoid makes the
+        pre-grouping bit-identical to raw-row updates — then regroup
+        the keys for the per-chunk distinct-pair admission metric.
+        Backend-dependent group ORDER is irrelevant: the register fold
+        is an order-free max and the table merge lex-groups its
+        candidates, so sharded/native/numpy grouping all land the same
+        state (the argument tests/test_spread.py pins down)."""
+        out = []
+        for name, w in self._spread:
+            cfg = w.config
+            kw = spread_key_width(cfg)
+            pair_lanes = self._build_key_lanes(
+                cols, (*cfg.key_cols, cfg.elem_col))
+            pairs, _, _ = self._group(pair_lanes, [], exact=False)
+            pairs = np.ascontiguousarray(pairs, dtype=np.uint32)
+            cand_keys, _, pair_counts = self._group(
+                np.ascontiguousarray(pairs[:, :kw]), [], exact=False)
+            aud = (self.spread_audit.prepare_pairs(name, pairs)
+                   if self.spread_audit is not None
+                   and not self.spread_audit.paused else None)
+            out.append((pairs,
+                        np.ascontiguousarray(cand_keys, np.uint32),
+                        pair_counts.astype(np.float32),
+                        aud))
+        return out
 
     # ---- lane building seams (r19 flowspeed) -------------------------------
     #
@@ -542,11 +599,13 @@ class HostGroupPipeline(FusedPipeline):
             for ch in chunks:
                 for (_, m), rows in zip(self._waggs, ch.wagg):
                     m.add_host_rows(*rows)
+                if not (do_hh or do_dd):
+                    continue  # late part: device models take nothing
+                if do_hh and ch.spread_in is not None:
+                    self._fold_spread(ch)
                 if ch.hh_in is None and ch.dense_in is None \
                         and ch.ddos_in is None and ch.fused_in is None:
                     continue
-                if not (do_hh or do_dd):
-                    continue  # late part: device models take nothing
                 self._timed_apply_chunk(ch, do_hh, do_dd)
                 if do_hh and self.audit is not None:
                     # after the fold, mirroring the sketch's own gating:
@@ -563,6 +622,35 @@ class HostGroupPipeline(FusedPipeline):
     def update(self, batch: FlowBatch) -> None:
         self.apply(self.prepare(batch))
 
+    def _fold_spread(self, ch: PreparedChunk) -> None:
+        """Fold one chunk's prepared pair tables into the spread models
+        (worker thread — mutates model state, like every apply).
+        spread_apply_update routes the register scatter through the
+        native hs_spread_update kernel when the library exports it, the
+        numpy twin otherwise — either way bit-identical to
+        SpreadModel.update over the same chunk, which is the parity
+        anchor tests/test_spread.py pins."""
+        from ..hostsketch.engine import (
+            np_spread_table_merge,
+            spread_apply_update,
+        )
+
+        with self.stages.stage("host_spread"):
+            for (name, w), (pairs, cand_keys, cand_counts, aud) in zip(
+                    self._spread, ch.spread_in):
+                m = w.model
+                kw = spread_key_width(w.config)
+                spread_apply_update(m.state.regs, pairs[:, :kw],
+                                    pairs[:, kw:],
+                                    threads=self._spread_threads,
+                                    stats=self._spread_stats)
+                tk, tm = np_spread_table_merge(
+                    m.state.table_keys, m.state.table_metric,
+                    cand_keys, cand_counts)
+                m.state = SpreadState(m.state.regs, tk, tm)
+                if aud is not None:
+                    self.spread_audit.fold_prepared(name, aud)
+
     # ---- sketchwatch hooks -------------------------------------------------
 
     def _audit_close_hook(self, name: str):
@@ -578,6 +666,15 @@ class HostGroupPipeline(FusedPipeline):
             # that window into
             with self.stages.stage("sketch_audit_close"):
                 self.audit.on_close(name, slot, model)
+        return hook
+
+    def _spread_close_hook(self, name: str):
+        """Window-close seal for a spread family: decode the closing
+        registers against the exact distinct sets accumulated for the
+        sampled cohort and publish the error histogram."""
+        def hook(slot, model):
+            with self.stages.stage("sketch_audit_close"):
+                self.spread_audit.on_close(name, slot, model)
         return hook
 
     def _audit_chunk_timed(self, ch: PreparedChunk) -> None:

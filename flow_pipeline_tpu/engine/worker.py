@@ -463,6 +463,9 @@ class StreamWorker:
             aud = getattr(self.fused, "audit", None)
             if aud is not None:
                 aud.paused = guard.drop_optional
+            saud = getattr(self.fused, "spread_audit", None)
+            if saud is not None:
+                saud.paused = guard.drop_optional
             TRACER.paused = guard.drop_optional
         if self.config.archive_raw:
             archived = False
@@ -777,6 +780,12 @@ class StreamWorker:
                         "hh": model.model.state,
                         "current_slot": model.current_slot,
                     }
+                elif kind == "windowed_spread":  # models.spread
+                    models_state[name] = {
+                        "kind": kind,
+                        "spread": model.model.state,
+                        "current_slot": model.current_slot,
+                    }
                 else:  # "windowed_dense" (models.dense_top)
                     models_state[name] = {
                         "kind": kind,
@@ -839,7 +848,8 @@ class StreamWorker:
                 else:
                     model.windows = windows
                 model.watermark = ms["watermark"]
-            elif ms["kind"] in ("windowed_hh", "windowed_dense"):
+            elif ms["kind"] in ("windowed_hh", "windowed_dense",
+                                "windowed_spread"):
                 want = getattr(model.model, "snapshot_kind", None)
                 if want != ms["kind"]:
                     # e.g. a checkpoint from a build whose port models were
@@ -890,6 +900,22 @@ class StreamWorker:
                             table_keys=jnp.asarray(hh["table_keys"]),
                             table_vals=jnp.asarray(hh["table_vals"]),
                         )
+                elif ms["kind"] == "windowed_spread":
+                    import numpy as np
+
+                    from ..models.spread import SpreadState
+
+                    # numpy, NOT jnp: spread state is host-resident by
+                    # design (u8 registers + u32 table keys — the exact
+                    # max monoid IS the canonical form)
+                    sp = ms["spread"]  # NamedTuple decoded as field dict
+                    model.model.state = SpreadState(
+                        regs=np.asarray(sp["regs"], dtype=np.uint8),
+                        table_keys=np.asarray(sp["table_keys"],
+                                              dtype=np.uint32),
+                        table_metric=np.asarray(sp["table_metric"],
+                                                dtype=np.float32),
+                    )
                 else:
                     model.model.totals = jnp.asarray(ms["totals"])
                 model.current_slot = ms["current_slot"]

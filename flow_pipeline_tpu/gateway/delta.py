@@ -21,6 +21,10 @@ codec makes it O(change):
   updates spread uniformly, so this is the append-mostly coding) or
   **dirty tiles** (``TILE_W``-wide column slabs, the dense-row
   fallback); comparison is uint64 equality — exact, no tolerance.
+  A spread family's u8 register planes ride the SAME dirty-column
+  coding (registers-last ``[D, W, m]`` viewed planes-first ``[m, D,
+  W]`` — a bucket's m registers dirty together the way a CMS bucket's
+  planes do), byte-equality compared.
   Range tables ship the authoritative slot list plus the row sets of
   new or changed slots; everything else is copied forward by reference
   on apply.
@@ -90,6 +94,7 @@ def snapshot_state(snap: Snapshot) -> dict:
             "value_cols": list(f.value_cols),
             "rows": {c: np.asarray(v) for c, v in f.rows.items()},
             "cms": None if f.cms is None else np.asarray(f.cms.get()),
+            "regs": None if f.regs is None else np.asarray(f.regs),
         }
     ranges = {
         table: [[int(slot), {c: np.asarray(v) for c, v in rows.items()}]
@@ -122,7 +127,8 @@ def state_to_snapshot(state: dict) -> Snapshot:
             depth=int(f["depth"]), rows=dict(f["rows"]),
             key_lanes=int(f["key_lanes"]),
             cms=None if cms is None else FrozenCms(value=cms),
-            value_cols=tuple(f["value_cols"]))
+            value_cols=tuple(f["value_cols"]),
+            regs=f.get("regs"))
     ranges = {table: tuple((int(slot), dict(rows))
                            for slot, rows in slots)
               for table, slots in state["ranges"].items()}
@@ -209,6 +215,26 @@ def diff_states(prev: dict, cur: dict) -> dict:
                 if tiles:
                     entry["cms_tiles"] = tiles
                 # neither: apply carries pf["cms"] forward untouched
+        # spread registers: the same dirty-column coding over the
+        # planes-first [m, D, W] view (byte equality on u8)
+        regs = f.get("regs")
+        pregs = None if pf is None else pf.get("regs")
+        if regs is None:
+            if pf is None or pregs is not None:
+                entry["regs"] = None
+        elif pregs is None:
+            entry["regs"] = regs
+        else:
+            diff = _cms_diff(np.moveaxis(pregs, 2, 0),
+                             np.moveaxis(regs, 2, 0))
+            if diff is None:
+                entry["regs"] = regs
+            else:
+                sparse, tiles = diff
+                if sparse:
+                    entry["regs_sparse"] = sparse
+                if tiles:
+                    entry["regs_tiles"] = tiles
         families[name] = entry
     ranges = {}
     for table, slots in cur["ranges"].items():
@@ -265,12 +291,31 @@ def apply_delta(prev: dict, delta: dict) -> dict:
                 cms[:, int(d), np.asarray(cols, np.int64)] = vals
         else:
             cms = None if pf is None else pf["cms"]
+        if "regs" in entry:
+            regs = entry["regs"]
+        elif "regs_tiles" in entry or "regs_sparse" in entry:
+            base = None if pf is None else pf.get("regs")
+            if base is None:
+                raise DeltaError(
+                    f"delta patches spread registers for {name!r} with "
+                    "no base planes")
+            regs = base.copy()
+            # patch through the planes-first view — the same words,
+            # addressed the way _cms_diff coded them
+            view = np.moveaxis(regs, 2, 0)
+            for d, w0, block in entry.get("regs_tiles", ()):
+                d, w0 = int(d), int(w0)
+                view[:, d, w0:w0 + block.shape[-1]] = block
+            for d, cols, vals in entry.get("regs_sparse", ()):
+                view[:, int(d), np.asarray(cols, np.int64)] = vals
+        else:
+            regs = None if pf is None else pf.get("regs")
         families[name] = {
             "kind": entry["kind"], "window_start": entry["window_start"],
             "depth": int(entry["depth"]),
             "key_lanes": int(entry["key_lanes"]),
             "value_cols": list(entry["value_cols"]),
-            "rows": rows, "cms": cms,
+            "rows": rows, "cms": cms, "regs": regs,
         }
     ranges = {}
     for table, spec in delta["ranges"].items():

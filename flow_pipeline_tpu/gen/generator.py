@@ -39,6 +39,16 @@ class ZipfProfile:
 
     n_keys: int = 10_000
     alpha: float = 1.2
+    # flowspread legs: a slice of every batch is emitted by dedicated
+    # spreader sources whose FAN-OUT (distinct dst addrs / dst ports per
+    # source) is itself harmonically skewed — rank r touches ~fanout/(r+1)
+    # distinct targets. Even ranks are superspreaders (many dst addrs, one
+    # port), odd ranks are port scanners (one victim, many dst ports).
+    # The default 0.0 draws nothing and keeps pre-r21 streams
+    # byte-identical for any seed.
+    spread_fraction: float = 0.0
+    spread_sources: int = 32
+    spread_fanout: int = 4096
     max_bytes: int = 1500
     max_packets: int = 100
     as_base: int = 65000
@@ -139,6 +149,9 @@ class FlowGenerator:
             cols["dst_addr"][:] = t["dst_addr"][ranks]
             for name in ("src_port", "dst_port", "proto", "src_as", "dst_as"):
                 cols[name][:] = t[name][ranks].astype(cols[name].dtype)
+            k = int(round(n * p.spread_fraction))
+            if k:
+                self._spread_legs(cols, n - k, k)
         else:
             prefix_words = (
                 np.frombuffer(p.prefix + b"\x00", dtype=">u4").astype(np.uint32).copy()
@@ -157,6 +170,44 @@ class FlowGenerator:
 
         self._emitted += n
         return out
+
+    def _spread_legs(self, cols: dict, off: int, k: int) -> None:
+        """Overwrite the last ``k`` rows with spreader-leg flows (zipf
+        profile only; see ZipfProfile.spread_fraction). Sources sit at
+        fixed suffixes (0xF000 | rank); the random zipf table can collide
+        into that range, which only adds background noise the detectors
+        must tolerate anyway."""
+        p = self.profile
+        rng = self.rng
+        nsrc = p.spread_sources
+        ranks = rng.choice(nsrc, size=k, p=self._spread_probs(nsrc))
+        # harmonic fan-out: rank r touches ~fanout/(r+1) distinct targets
+        fanout = np.maximum(p.spread_fanout // (ranks + 1), 8)
+        elem = rng.integers(0, fanout, k).astype(np.uint32)
+        prefix_words = (
+            np.frombuffer(p.prefix + b"\x00", dtype=">u4").astype(np.uint32).copy()
+        )
+        sl = slice(off, off + k)
+        src = np.tile(prefix_words, (k, 1))
+        src[:, 3] = (src[:, 3] & np.uint32(0xFFFF0000)) | np.uint32(0xF000) | ranks
+        cols["src_addr"][sl] = src
+        scanner = (ranks & 1) == 1
+        dst = np.tile(prefix_words, (k, 1))
+        # superspreaders fan across dst addrs on one port; scanners hold
+        # one victim addr and fan across dst ports
+        dst[:, 3] = (dst[:, 3] & np.uint32(0xFFFF0000)) | np.where(
+            scanner, np.uint32(0xE000) | ranks, elem)
+        cols["dst_addr"][sl] = dst
+        cols["dst_port"][sl] = np.where(scanner, elem % 65536, 443)
+        cols["src_port"][sl] = rng.integers(1024, 2**16, k, dtype=np.uint64)
+        cols["proto"][sl] = 6
+        cols["src_as"][sl] = p.as_base
+        cols["dst_as"][sl] = p.as_base
+
+    @staticmethod
+    def _spread_probs(nsrc: int) -> np.ndarray:
+        w = 1.0 / np.arange(1, nsrc + 1, dtype=np.float64)
+        return w / w.sum()
 
     def batches(self, n_batches: int, batch_size: int):
         for _ in range(n_batches):

@@ -449,6 +449,115 @@ def inv_extract(state, capacity: int):
     return table_keys, table_vals
 
 
+# ---- flowspread: numpy reference twins -------------------------------------
+#
+# The distinct-count family (-spread.enabled; ops/spread.py states the
+# protocol): per-key HLL register planes [depth, width, m] uint8 over
+# the SAME murmur3 bucket rows the CMS uses, registers updated from two
+# independent hashes of the counted dimension (dst addr / dst port).
+# Every update is an integer max — commutative, associative, IDEMPOTENT
+# — so chunk granularity, grouping strategy, thread interleaving and
+# shard assignment can never change a bit of the state, and the mesh
+# merge is an element-wise u8 max. These are the reference twins the
+# native hs_spread_update kernel and the jnp ops.spread kernel are
+# pinned against (tests/test_spread.py).
+
+
+def _np_bit_length_u32(h: np.ndarray) -> np.ndarray:
+    """Vectorized integer bit_length of uint32 (0 -> 0) — the numpy twin
+    of ops.spread._bit_length_u32 (identical binary-search shifts)."""
+    h = np.asarray(h, dtype=np.uint32).copy()
+    n = np.zeros(h.shape, np.uint32)
+    for shift in (16, 8, 4, 2, 1):
+        big = (h >> np.uint32(shift)) != 0
+        n[big] += np.uint32(shift)
+        h[big] >>= np.uint32(shift)
+    return n + (h != 0).astype(np.uint32)
+
+
+def np_spread_reg_rho(elems: np.ndarray, m: int):
+    """Element lanes -> (register index [n] int64, rho [n] uint8).
+    rho = 33 - bit_length(h2) in [1, 33] (h2 == 0 gives 33) — the
+    protocol all three twins share (ops/spread.py constants)."""
+    from ..ops.spread import SPREAD_REG_SEED, SPREAD_RHO_SEED, \
+        SPREAD_RHO_ZERO
+
+    elems = np.ascontiguousarray(elems, dtype=np.uint32)
+    # flowlint: disable=uint64-discipline -- register INDICES in [0, m), not counters (same trade as _np_buckets)
+    r = (hash_words_np(elems, seed=SPREAD_REG_SEED)
+         % np.uint32(m)).astype(np.int64)
+    h2 = hash_words_np(elems, seed=SPREAD_RHO_SEED)
+    rho = (np.uint32(SPREAD_RHO_ZERO)
+           - _np_bit_length_u32(h2)).astype(np.uint8)
+    return r, rho
+
+
+def np_spread_update(regs: np.ndarray, keys: np.ndarray,
+                     elems: np.ndarray) -> None:
+    """Scatter-max register update in place over valid rows only
+    (callers slice). ``regs`` [D, W, m] uint8 C-contiguous; ``keys``
+    [n, kw] uint32 key lanes; ``elems`` [n, ew] uint32 element lanes.
+    maximum.at unconditionally (no _GROUPED_SCATTER split): u8 max is
+    order-free either way and callers pre-group to unique pairs, so the
+    scatter is already near-duplicate-free."""
+    depth, width, m = regs.shape
+    if keys.shape[0] == 0:
+        return
+    keys = np.ascontiguousarray(keys, dtype=np.uint32)
+    buckets = _np_buckets(keys, depth, width)
+    r, rho = np_spread_reg_rho(elems, m)
+    for d in range(depth):
+        # flat view of the contiguous [W, m] row block (no copy)
+        np.maximum.at(regs[d].reshape(-1), buckets[d] * m + r, rho)
+
+
+def np_spread_query(regs: np.ndarray, keys: np.ndarray) -> np.ndarray:
+    """[n] float64 spread estimates — the shared decode-at-read path
+    (ops.spread.spread_decode over this module's bucket twin). EVERY
+    serve surface decodes through this function, so byte-identical
+    registers answer byte-identically."""
+    from ..ops.spread import spread_decode
+
+    regs = np.asarray(regs)
+    if keys.shape[0] == 0:
+        return np.zeros(0, np.float64)
+    keys = np.ascontiguousarray(keys, dtype=np.uint32)
+    buckets = _np_buckets(keys, regs.shape[0], regs.shape[1])
+    return spread_decode(regs, buckets)
+
+
+def np_spread_table_merge(table_keys: np.ndarray, table_metric: np.ndarray,
+                          cand_keys: np.ndarray, cand_pairs: np.ndarray):
+    """Candidate-table admission fold: accumulate per-key distinct-pair
+    counts (a union-bound upper bound on the key's true distinct count)
+    and keep the top ``capacity`` keys by accumulated metric — exactly
+    np_topk_merge's (primary desc, lex asc) ranking with one plane.
+    Returns (new_keys [cap, kw] u32 sentinel-padded, new_metric [cap]
+    f32). The metric only ADMITS candidates; reported spread values are
+    always decoded from the registers at extraction."""
+    tk, tv = np_topk_merge(
+        table_keys, np.asarray(table_metric, np.float32)[:, None],
+        cand_keys, np.asarray(cand_pairs, np.float32)[:, None],
+        np.asarray(cand_pairs, np.float32)[:, None])
+    return tk, tv[:, 0]
+
+
+def spread_apply_update(regs: np.ndarray, keys: np.ndarray,
+                        elems: np.ndarray, threads: int = 1,
+                        stats=None) -> None:
+    """Route one pre-grouped (key, element) table into the registers:
+    the threaded native kernel when the library exports it, the numpy
+    twin otherwise — bit-identical by the parity suite, so the fallback
+    is a pure throughput degradation (callers own the degradation-gauge
+    report; see HostSketchPipeline._init_spread)."""
+    from .. import native
+
+    if native.spread_available():
+        native.hs_spread_update(regs, keys, elems, threads, stats=stats)
+    else:
+        np_spread_update(regs, keys, elems)
+
+
 # ---- the engine -----------------------------------------------------------
 
 

@@ -150,6 +150,11 @@ class HostSketchPipeline(HostGroupPipeline):
         self._apply_stats = None
         # flowlint: unguarded -- group thread only (prepare half)
         self._group_stats = None
+        # flowspread fold knobs, resolved by _init_spread below
+        # flowlint: unguarded -- set during construction, read on the worker thread only (fold half)
+        self._spread_threads = 1
+        # flowlint: unguarded -- built during construction; zeroed/accumulated on the worker thread only
+        self._spread_stats = None
         # r19 flowspeed: lanes built in C off the decoded columns when
         # the library exports the builders; the numpy twins
         # (_key_lanes_into / _value_planes_np / the wagg fill) remain
@@ -169,6 +174,39 @@ class HostSketchPipeline(HostGroupPipeline):
             report_native_degradation(
                 "lanes", _degradation_reason("ff_build_lanes", "r19"))
         self._init_fused(fused, sketch_native)
+        self._init_spread(sketch_native)
+
+    # ---- flowspread fold (r21) ---------------------------------------------
+
+    def _init_spread(self, sketch_native: str) -> None:
+        """Resolve the spread register fold's backend knobs. The fold
+        itself is inherited (HostGroupPipeline._fold_spread →
+        hostsketch.engine.spread_apply_update, which prefers the native
+        hs_spread_update kernel); this pipeline's job is the ladder
+        discipline — a stale .so quietly serving the numpy twin under a
+        native flag must be LOUD, like every other feature."""
+        from .. import native
+
+        self._spread_threads = self._engine.threads
+        if not self._spread:
+            return
+        if native.spread_available():
+            mark_native_serving("spread")
+            # flowtrace buffer for the kernel's FF_STAT_SPREAD_NS slot —
+            # its own buffer (worker thread), not _apply_stats: the
+            # staged engine zeroes that one per hh chunk
+            self._spread_stats = native.new_stats()
+        elif sketch_native != "numpy":
+            report_native_degradation(
+                "spread", _degradation_reason("hs_spread_update", "r21"))
+
+    def _fold_spread(self, ch: PreparedChunk) -> None:
+        stats = self._spread_stats
+        if stats is not None:
+            stats[:] = 0
+        super()._fold_spread(ch)
+        if stats is not None:
+            _publish_stats("host_sketch", stats)
 
     # ---- native lane building (r19 flowspeed) ------------------------------
 
@@ -383,8 +421,13 @@ class HostSketchPipeline(HostGroupPipeline):
                                                        fused_in)
                         for name, fl in self._audit_family_lanes(tree,
                                                                  lanes)]
+        # spread families keep the staged pair grouping even in fused
+        # mode: their (key + counted element) grouping key cannot ride
+        # the hh family trees, and the pair tables are the fold's input
         return PreparedChunk(wagg, None, self._prep_dense(cols, n),
-                             ddos_in, fused_in, audit_in)
+                             ddos_in, fused_in, audit_in,
+                             spread_in=(self._prep_spread(cols)
+                                        if self._spread else None))
 
     def _audit_family_lanes(self, tree, lanes: np.ndarray):
         """Yield (family name, key-lane view) for every member of one

@@ -91,6 +91,19 @@ def is_inv_state(state) -> bool:
     return hasattr(state, "keysum")
 
 
+def is_spread_state(state) -> bool:
+    """Whether any sketch-state form (the model-facing SpreadState or a
+    checkpoint/mesh field dict) is a flowspread distinct-count state —
+    the dispatch rule checkpoint restore and the mesh codec share. The
+    spread state is host-resident numpy BY DESIGN (u8 registers + u32
+    candidate keys; the exact max monoid IS the canonical form, like
+    the invertible family's u64 planes), so unlike the hh table family
+    there is no device-layout conversion to make."""
+    if isinstance(state, dict):
+        return "regs" in state
+    return hasattr(state, "regs")
+
+
 def _cms_to_u64(cms) -> np.ndarray:
     a = np.asarray(cms, dtype=np.float32)
     # fast path: healthy sketches (finite, in [0, 2^64) — every cell the

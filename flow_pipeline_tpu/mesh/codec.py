@@ -122,6 +122,28 @@ def dense_payload(totals) -> dict:
             "totals": np.asarray(totals).astype(np.int64)}
 
 
+def spread_payload(state) -> dict:
+    """SpreadState (or checkpoint field dict) -> canonical spread
+    payload. The u8 register planes are already the exact max-monoid
+    canonical form (models/spread.py), so the payload ships them
+    verbatim; the candidate table rides as u32 keys + f32 admission
+    metric, exactly like the hh table legs."""
+    if isinstance(state, dict):
+        regs, tk, tm = (state["regs"], state["table_keys"],
+                        state["table_metric"])
+    else:
+        regs, tk, tm = state.regs, state.table_keys, state.table_metric
+    return {
+        "kind": "spread",
+        "regs": np.ascontiguousarray(np.asarray(regs),
+                                     dtype=np.uint8).copy(),
+        "table_keys": np.ascontiguousarray(np.asarray(tk),
+                                           dtype=np.uint32).copy(),
+        "table_metric": np.ascontiguousarray(np.asarray(tm),
+                                             dtype=np.float32).copy(),
+    }
+
+
 def capture_model(model) -> dict:
     """State payload for one windowed model (the object WindowedHeavyHitter
     wraps): dispatches on the model's snapshot_kind tag."""
@@ -130,4 +152,6 @@ def capture_model(model) -> dict:
         return hh_payload(model.state)
     if kind == "windowed_dense":
         return dense_payload(model.totals)
+    if kind == "windowed_spread":
+        return spread_payload(model.state)
     raise TypeError(f"no mesh payload for model kind {kind!r}")

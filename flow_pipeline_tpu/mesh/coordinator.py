@@ -173,7 +173,7 @@ class ModelSpec:
     merges exactly what the members compute."""
 
     name: str
-    kind: str  # "wagg" | "hh" | "dense"
+    kind: str  # "wagg" | "hh" | "dense" | "spread"
     config: Any
     k: int
     window_seconds: int
@@ -196,8 +196,9 @@ def spec_from_models(models: dict) -> tuple[ModelSpec, ...]:
                 name, "wagg", m.config, 0, m.config.window_seconds,
                 m.config.allowed_lateness))
         elif isinstance(m, WindowedHeavyHitter):
-            kind = ("hh" if m.model.snapshot_kind == "windowed_hh"
-                    else "dense")
+            snap = m.model.snapshot_kind
+            kind = {"windowed_hh": "hh",
+                    "windowed_spread": "spread"}.get(snap, "dense")
             out.append(ModelSpec(name, kind, m.config, m.k,
                                  m.window_seconds))
     return tuple(out)
@@ -1198,6 +1199,10 @@ class MeshCoordinator:
             if audit is not None:
                 self._audit_merged_window(spec, slot, merged, audit)
             return merge_ops.hh_top_rows(merged, spec.config, spec.k, slot)
+        if spec.kind == "spread":
+            merged = merge_ops.merge_spread(payloads, spec.config)
+            return merge_ops.spread_top_rows(merged, spec.config, spec.k,
+                                             slot)
         totals = merge_ops.merge_dense(payloads)
         return merge_ops.dense_top_rows(totals, spec.config, spec.k, slot)
 
@@ -1322,6 +1327,9 @@ class MeshCoordinator:
         if spec.kind == "hh":
             merged = merge_ops.merge_hh(payloads, spec.config)
             rows = merge_ops.hh_top_rows(merged, spec.config, kk, slot)
+        elif spec.kind == "spread":
+            merged = merge_ops.merge_spread(payloads, spec.config)
+            rows = merge_ops.spread_top_rows(merged, spec.config, kk, slot)
         else:
             rows = merge_ops.dense_top_rows(
                 merge_ops.merge_dense(payloads), spec.config, kk, slot)

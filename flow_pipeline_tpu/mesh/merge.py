@@ -203,6 +203,75 @@ def hh_top_rows(merged: dict, config: HeavyHitterConfig, k: int,
     return out
 
 
+# ---- spread (distinct-count) sketch state ---------------------------------
+
+
+def merge_spread(payloads: list[dict], config) -> dict:
+    """Fold spread payloads into one merged {regs, table_keys,
+    table_metric}.
+
+    Registers: element-wise u8 MAX — the HLL register plane is an exact
+    max monoid over the element stream (ops/spread.py), so the max of
+    per-shard planes IS the plane of the union stream, bit-exactly,
+    for any member count and any stream split. Candidate tables:
+    concat -> group-by-key SUM of the admission metric (each member's
+    metric is its accumulated per-chunk distinct-pair count — a valid
+    union-bound upper bound on the key's true distinct count; the sum
+    preserves that bound but is NOT chunking-invariant, since members
+    chunk their own sub-streams), ranked metric-descending with the
+    stable lex tie-break, truncated to capacity. The metric only
+    decides which keys stay tracked — reported spread values are
+    decoded from the merged registers at extraction (spread_top_rows),
+    never from the metric, so merged answers are exact wherever the
+    register planes are."""
+    from ..models.spread import spread_key_width
+
+    if any(p.get("kind") != "spread" for p in payloads):
+        # one family must fold ONE payload shape mesh-wide: a spread
+        # max fold has no meaning over hh/dense sum payloads
+        raise ValueError(
+            "cannot merge mixed spread/non-spread payloads for one "
+            "family — every member must run the same model kind")
+    regs = np.zeros((config.depth, config.width, config.registers),
+                    np.uint8)
+    rows_k, rows_m = [], []
+    for p in payloads:
+        np.maximum(regs, np.asarray(p["regs"], dtype=np.uint8), out=regs)
+        tk = p["table_keys"].astype(np.uint32)
+        tm = p["table_metric"].astype(np.float32)
+        real = (tk != _SENTINEL).any(axis=1)
+        rows_k.append(tk[real])
+        rows_m.append(tm[real])
+    kw = spread_key_width(config)
+    new_keys = np.full((config.capacity, kw), _SENTINEL, np.uint32)
+    new_metric = np.zeros(config.capacity, np.float32)
+    keys = np.concatenate(rows_k) if rows_k else new_keys[:0]
+    metric = np.concatenate(rows_m) if rows_m else new_metric[:0]
+    if len(keys):
+        order, starts = _lex_regroup(keys)
+        uniq = keys[order][starts]
+        sums = np.add.reduceat(metric[order], starts).astype(np.float32)
+        top = np.argsort(-sums, kind="stable")[:config.capacity]
+        new_keys[:len(top)] = uniq[top]
+        new_metric[:len(top)] = sums[top]
+    return {"kind": "spread", "regs": regs, "table_keys": new_keys,
+            "table_metric": new_metric}
+
+
+def spread_top_rows(merged: dict, config, k: int,
+                    slot: int) -> dict[str, np.ndarray]:
+    """Columnar top-k rows from one merged spread payload — the shared
+    decode-at-read extraction (models.spread.spread_top_from: rank by
+    register-decoded spread, stable lex tie-break) plus the timeslot
+    column WindowedHeavyHitter stamps at window close, so merged output
+    rows are shape- and dtype-identical to a single worker's."""
+    from ..models.spread import spread_top_from
+
+    top = spread_top_from(merged, config, k)
+    top["timeslot"] = np.full(len(top["valid"]), slot, dtype=np.uint64)
+    return top
+
+
 # ---- dense accumulators ---------------------------------------------------
 
 

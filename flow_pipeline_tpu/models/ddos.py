@@ -31,9 +31,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.metrics import REGISTRY
 from ..ops import ewma as ewma_ops
 from ..ops.quantile import QuantileSketchSpec
 from ..schema.batch import FlowBatch
+
+# flowspread entropy companion (r21): normalized Shannon entropy of the
+# positive bucket-rate distribution, published with its EW baseline at
+# every sub-window close. A volumetric flood concentrates rate mass into
+# few buckets, crushing the series toward 0 well before any single
+# bucket's z-score trips — the EntropyCollapse rule
+# (deploy/prometheus/alerts.yml) fires on live-vs-baseline divergence.
+ENTROPY_GAUGE = ("flow_entropy",
+                 "normalized Shannon entropy of per-bucket rates at the "
+                 "last sub-window close (1 = uniform, -> 0 as one bucket "
+                 "dominates)")
+ENTROPY_BASELINE_GAUGE = ("flow_entropy_baseline",
+                          "EW baseline of flow_entropy (fold weight "
+                          "-ddos.entropy_alpha)")
 
 
 @dataclass(frozen=True)
@@ -49,6 +64,10 @@ class DDoSConfig:
     batch_size: int = 8192
     value_col: str = "packets"
     rel_err: float = 0.01
+    # EW fold weight for the flow_entropy baseline (slower than the
+    # rate baseline's alpha: entropy is a distribution-shape signal and
+    # its baseline should ride out single-window wobble).
+    entropy_alpha: float = 0.1
     # Serving-side sampling correction (see HeavyHitterConfig.scale_col):
     # rates reflect the TRUE per-dst traffic the samples represent, so a
     # 1:1000-sampled flood trips the same z-score gate an unsampled one
@@ -62,6 +81,26 @@ def ddos_input_cols(config: "DDoSConfig") -> list[str]:
     if config.scale_col:
         out.append(config.scale_col)
     return out
+
+
+def rate_entropy(rates: np.ndarray) -> tuple[float, int]:
+    """(normalized Shannon entropy, active buckets) of one sub-window's
+    [M] bucket rates: H = -sum(p ln p) / ln(M) over the positive
+    buckets, so 1.0 is rate mass uniform across ALL buckets and the
+    series collapses toward 0 as mass concentrates into few. The
+    denominator is the FULL bucket count, not the active count — a
+    flood aimed at two dsts spreads evenly across two buckets, which
+    ln(active) normalization would score as a perfect 1.0 instead of
+    the collapse it is. Fewer than two positive buckets reports 0.
+    Pure float64 numpy — the host-side close path owns this."""
+    rates = np.asarray(rates, np.float64)
+    m = rates.size
+    pos = rates[rates > 0]
+    active = int(pos.size)
+    if active <= 1 or m < 2:
+        return 0.0, active
+    p = pos / pos.sum()
+    return float(-(p * np.log(p)).sum() / np.log(m)), active
 
 
 class DDoSState(NamedTuple):
@@ -167,6 +206,16 @@ class DDoSDetector:
         # CURRENT sub-window would inflate its rates and can fire spurious
         # z-score alerts after a burst of late arrivals.
         self.late_flows_dropped = 0
+        # entropy anomaly signal (rate_entropy): live value and EW
+        # baseline for the last closed sub-window; None until the first
+        # close with >=2 active buckets folds the baseline
+        self.entropy: float | None = None
+        self.entropy_baseline: float | None = None
+        # eager family registration: the gauges must exist on /metrics
+        # from the first scrape (and for the dashboard honesty tests),
+        # not only after the first sub-window closes
+        REGISTRY.gauge(*ENTROPY_GAUGE)
+        REGISTRY.gauge(*ENTROPY_BASELINE_GAUGE)
 
     def update(self, batch: FlowBatch) -> None:
         if len(batch) == 0:
@@ -213,8 +262,26 @@ class DDoSDetector:
         )
         return self._emit_alerts(z, rates, self.state.hist, self.state.addrs)
 
+    def _fold_entropy(self, rates) -> None:
+        """Publish the sub-window's rate entropy and fold its EW
+        baseline. Runs on EVERY close (before the alert warmup gate) —
+        the entropy series carries its own baseline and the collapse
+        comparison happens rule-side, not here."""
+        h, active = rate_entropy(np.asarray(rates))
+        self.entropy = h
+        if active > 1:
+            a = self.config.entropy_alpha
+            self.entropy_baseline = (
+                h if self.entropy_baseline is None
+                else (1.0 - a) * self.entropy_baseline + a * h)
+        REGISTRY.gauge(*ENTROPY_GAUGE).set(h)
+        if self.entropy_baseline is not None:
+            REGISTRY.gauge(*ENTROPY_BASELINE_GAUGE).set(
+                self.entropy_baseline)
+
     def _emit_alerts(self, z, rates, hist, addrs) -> list[dict]:
         """Shared gating + alert construction (single-chip and sharded)."""
+        self._fold_entropy(rates)
         self.folds += 1
         if self.folds <= self.config.warmup_windows:
             return []

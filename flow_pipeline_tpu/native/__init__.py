@@ -187,6 +187,19 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_void_p,  # [D*W, P] uint64 decoded sums out
             ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
         ]
+    if hasattr(lib, "hs_spread_update"):  # pre-r21 .so lacks flowspread
+        lib.hs_spread_update.restype = ctypes.c_longlong
+        lib.hs_spread_update.argtypes = [
+            ctypes.c_void_p,  # [D, W, m] uint8 register planes (in place)
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, kw] uint32 key lanes
+            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_void_p,  # [n, ew] uint32 element lanes
+            ctypes.c_longlong,
+            ctypes.c_void_p,  # [n] uint8 valid (NULL = all)
+            ctypes.c_int,     # threads
+            ctypes.c_void_p,  # [FF_STATS_LEN] int64 stats (NULL = off)
+        ]
     if hasattr(lib, "ff_group_sum"):  # pre-r10 .so lacks the fused plane
         lib.ff_group_sum.restype = ctypes.c_longlong
         lib.ff_group_sum.argtypes = [
@@ -298,6 +311,8 @@ FF_STAT_SLOTS = {
                      # family's whole sketch fold — no admission phases)
     "lanes": 11,     # ff_build_lanes / ff_build_planes: native lane
                      # building off the decoded columns (r19 flowspeed)
+    "spread": 12,    # hs_spread_update (the flowspread distinct-count
+                     # family's register fold — r21)
 }
 FF_STAT_PHASES = tuple(FF_STAT_SLOTS)  # ns-valued phase slots, in order
 FF_STAT_ROWS = 7
@@ -334,6 +349,8 @@ _FEATURE_SYMBOLS = {
     # r19 flowspeed: native lane building off the decoded columns +
     # the threaded groupby (one .so generation — witness either)
     "lanes": "ff_build_lanes",
+    # r21 flowspread: the distinct-count register fold
+    "spread": "hs_spread_update",
 }
 
 
@@ -629,6 +646,47 @@ def hs_inv_decode(cms: np.ndarray, keysum: np.ndarray,
         raise ValueError(f"hs_inv_decode failed (rc={n})")
     n = int(n)
     return keys_out[:n], vals_out[:n]
+
+
+def spread_available() -> bool:
+    """Whether the loaded library exports the flowspread register fold
+    (an .so built before r21 serves every other family fine but cannot
+    run -spread.* natively — the numpy twin serves, bit-identically)."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "hs_spread_update")
+
+
+def hs_spread_update(regs: np.ndarray, keys: np.ndarray,
+                     elems: np.ndarray, threads: int = 1,
+                     stats: Optional[np.ndarray] = None,
+                     valid=None) -> None:
+    """Native distinct-count register update in place — the threaded
+    twin of hostsketch.engine.np_spread_update (u8 scatter-max over
+    per-depth-owned register blocks; deterministic at any thread count
+    since max is order-free — see native/hostsketch.cc). regs [D, W, m]
+    u8 C-contiguous; keys [n, kw] u32; elems [n, ew] u32. Raises on
+    degenerate shapes."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "hs_spread_update"):
+        raise RuntimeError("libflowdecode.so missing the flowspread "
+                           "kernel; run `make native`")
+    assert regs.dtype == np.uint8 and regs.flags["C_CONTIGUOUS"]
+    d, w, m = regs.shape
+    keys = np.ascontiguousarray(keys, dtype=np.uint32)
+    elems = np.ascontiguousarray(elems, dtype=np.uint32)
+    n, kw = keys.shape
+    ew = elems.shape[1]
+    assert elems.shape[0] == n
+    vptr = None
+    if valid is not None:
+        valid = np.ascontiguousarray(valid, dtype=np.uint8)
+        vptr = _c_arr(valid)
+    rc = lib.hs_spread_update(_c_arr(regs), d, w, m, _c_arr(keys), n, kw,
+                              _c_arr(elems), ew, vptr, int(threads),
+                              _stats_ptr(stats))
+    if rc != 0:
+        raise ValueError(f"hs_spread_update failed (rc={rc}): degenerate "
+                         f"shape depth={d} width={w} m={m} kw={kw} ew={ew}")
 
 
 def fused_available() -> bool:

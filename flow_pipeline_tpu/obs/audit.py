@@ -197,6 +197,41 @@ _AUDIT_GAUGES = frozenset({"recall", "precision", "cohort", "fill",
                            "row_min", "row_max", "occupancy",
                            "est_frac"})
 
+# flowspread shadow (SpreadAudit): the distinct-count analogue of the
+# cohort above. The sampled keys' exact element SETS are the truth; the
+# register-decoded estimate is the system under test. Error can run
+# BOTH ways (HLL is unbiased, not an upper bound like CMS), so the
+# shared ERROR_RATIO_BUCKETS' negative tail is load-bearing here.
+SPREAD_AUDIT_METRICS = {
+    "error": ("sketch_spread_error_ratio",
+              "sampled-cohort spread relative error (decoded - exact "
+              "distinct) / exact distinct at window close "
+              "(label: family)"),
+    "cohort": ("sketch_spread_audit_sampled_keys",
+               "sampled exact-distinct shadow cohort size at the last "
+               "window close (label: family)"),
+    "windows": ("sketch_spread_audit_windows_total",
+                "spread windows audited (label: family)"),
+    "overflow": ("sketch_spread_audit_cohort_overflow_total",
+                 "sampled spread keys dropped past AUDIT_MAX_COHORT "
+                 "(label: family)"),
+}
+
+
+def register_spread_audit_metrics() -> dict:
+    """Register (or fetch) the flowspread sketchwatch families.
+    Idempotent; returns {spec key: metric}."""
+    out = {}
+    for key, spec in SPREAD_AUDIT_METRICS.items():
+        if key == "error":
+            out[key] = REGISTRY.histogram(*spec,
+                                          buckets=ERROR_RATIO_BUCKETS)
+        elif key == "cohort":
+            out[key] = REGISTRY.gauge(*spec)
+        else:
+            out[key] = REGISTRY.counter(*spec)
+    return out
+
 _SENTINEL = np.uint32(0xFFFFFFFF)
 
 
@@ -624,5 +659,161 @@ class SketchAudit:
             self._m["evictions"].inc(evictions, family=name)
         report["evictions"] = evictions
         report = publish_report(name, report, metrics=self._m)
+        self.last_reports[name] = report
+        return report
+
+
+# ---- the flowspread shadow auditor ----------------------------------------
+
+
+class _SpreadFamilyAudit:
+    __slots__ = ("config", "kw", "elems")
+
+    def __init__(self, config):
+        self.config = config
+        from ..models.spread import spread_key_width
+
+        self.kw = spread_key_width(config)
+        # key-lane bytes -> set of element-lane bytes (the exact
+        # distinct shadow; sets dedupe exactly the way the registers'
+        # idempotent max does)
+        self.elems: dict[bytes, set] = {}
+
+
+class SpreadAudit:
+    """Sampled exact-DISTINCT shadow audit for one pipeline's spread
+    families (models/spread.py).
+
+    Same discipline as :class:`SketchAudit`, adapted to cardinality:
+    keys are hash-sampled with the SAME protocol seed/fold over the
+    same uint32 key lanes (~1/256; every worker samples the same
+    cohort), and for each sampled key the auditor keeps the exact SET
+    of element rows seen this window — set insertion is idempotent, so
+    the shadow is exact under any chunking/threading/sharding, the same
+    order-freedom argument as the registers themselves. At window close
+    the register-decoded estimate (hostsketch.engine.np_spread_query,
+    the one decode every serve path shares) is compared against each
+    sampled key's true distinct count and the relative errors land in
+    ``sketch_spread_error_ratio{family}``.
+
+    The hot path is split like SketchAudit's: :meth:`prepare_pairs` is
+    PURE (mask over already-unique pair rows the spread prepare half
+    materializes anyway) and runs on the group thread;
+    :meth:`fold_prepared` mutates the cohort dict on the worker thread
+    only. flowguard level >= 1 pauses cohort refresh via ``paused``."""
+
+    def __init__(self, families: dict, mode: str = "sample"):
+        if mode not in ("sample", "full"):
+            raise ValueError(
+                f"spread audit mode must be sample|full, got {mode!r} "
+                "(off = don't construct an auditor)")
+        self.mode = mode
+        # flowlint: unguarded -- built once; per-family state mutates on the worker thread only (see module header)
+        self._fams = {name: _SpreadFamilyAudit(cfg)
+                      for name, cfg in families.items()}
+        # flowlint: unguarded -- racy-but-monotone bool flipped by the worker's guard observe, read on the group thread; a stale read folds/skips one chunk
+        self.paused = False
+        # newest JSON-safe close report per family (merged into the
+        # flowserve snapshot's /query/audit view)
+        # flowlint: unguarded -- worker thread only (written at window close under worker.lock; the serve publisher reads under the same lock)
+        self.last_reports: dict[str, dict] = {}
+        self._m = register_spread_audit_metrics()
+
+    # ---- accumulation (prepare pure / fold on the worker thread) ----------
+
+    def prepare_pairs(self, name: str, pairs: np.ndarray):
+        """Pure extraction from one chunk's unique (key, element) pair
+        rows (``pairs`` [G, kw+ew] u32 — the spread prepare half's own
+        grouping output): the sampled rows, or None."""
+        fam = self._fams.get(name)
+        if self.paused or fam is None or pairs.shape[0] == 0:
+            return None
+        mask = sample_mask(
+            np.ascontiguousarray(pairs[:, :fam.kw]), self.mode)
+        if not mask.any():
+            return None
+        return np.ascontiguousarray(pairs[mask])
+
+    def fold_prepared(self, name: str, prepared) -> None:
+        """Fold sampled pair rows into the element-set shadow (worker
+        thread, under worker.lock)."""
+        if prepared is None:
+            return
+        fam = self._fams[name]
+        kw = fam.kw
+        elems = fam.elems
+        cap = AUDIT_MAX_COHORT
+        overflow = 0
+        for row in prepared:
+            key = row[:kw].tobytes()
+            s = elems.get(key)
+            if s is None:
+                if len(elems) >= cap:
+                    overflow += 1
+                    continue
+                elems[key] = {row[kw:].tobytes()}
+            else:
+                s.add(row[kw:].tobytes())
+        if overflow:
+            self._m["overflow"].inc(overflow, family=name)
+
+    def observe_pairs(self, name: str, pairs: np.ndarray) -> None:
+        """Unsplit hook (serial mode / tests)."""
+        self.fold_prepared(name, self.prepare_pairs(name, pairs))
+
+    # ---- window close ------------------------------------------------------
+
+    def take_partial(self, name: str) -> dict:
+        """Detach the closed window's cohort (keys lex-sorted — equal
+        cohorts serialize identically everywhere) and reset it."""
+        fam = self._fams[name]
+        if not fam.elems:
+            part = {"keys": np.zeros((0, fam.kw), np.uint32),
+                    "distinct": np.zeros(0, np.uint64)}
+        else:
+            keys = np.frombuffer(
+                b"".join(fam.elems.keys()),
+                dtype=np.uint32).reshape(len(fam.elems), fam.kw)
+            distinct = np.fromiter(
+                (len(s) for s in fam.elems.values()), dtype=np.uint64,
+                count=len(fam.elems))
+            order = np.lexsort(keys.T[::-1])
+            part = {"keys": np.ascontiguousarray(keys[order]),
+                    "distinct": np.ascontiguousarray(distinct[order])}
+        fam.elems = {}
+        return part
+
+    def on_close(self, name: str, slot, model) -> None:
+        """Window-close hook (WindowedHeavyHitter.audit_hook)."""
+        self.evaluate(name, slot, self.take_partial(name), model.state)
+
+    def evaluate(self, name: str, slot, part: dict, state) -> dict:
+        """Compare one detached cohort against one register state,
+        publish the error histogram, retain the JSON-safe report."""
+        from ..hostsketch.engine import np_spread_query
+
+        regs = (np.asarray(state["regs"], np.uint8)
+                if isinstance(state, dict) else state.regs)
+        keys = part["keys"]
+        n = keys.shape[0]
+        report: dict = {"slot": None if slot is None else int(slot),
+                        "sampled_keys": int(n)}
+        if n:
+            exact = part["distinct"].astype(np.float64)  # always >= 1
+            decoded = np_spread_query(regs, keys)
+            ratios = (decoded - exact) / exact
+            for r in ratios:
+                self._m["error"].observe(float(r), family=name)
+            q = _quantiles(np.abs(ratios))
+            report["spread_err"] = {
+                kq: round(v, 6)
+                for kq, v in _quantiles(ratios).items()}
+            report["spread_abs_err"] = {kq: round(v, 6)
+                                        for kq, v in q.items()}
+        else:
+            report["spread_err"] = _quantiles(np.empty(0, np.float64))
+            report["spread_abs_err"] = dict(report["spread_err"])
+        self._m["cohort"].set(n, family=name)
+        self._m["windows"].inc(family=name)
         self.last_reports[name] = report
         return report

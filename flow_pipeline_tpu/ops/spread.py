@@ -1,0 +1,172 @@
+"""Distinct-count (spread) sketch ops — the jnp twin of the flowspread
+family (-spread.enabled).
+
+flowspread answers "how many DISTINCT elements did this key touch?" —
+the cardinality companion to the volume sketches: superspreaders
+(src -> distinct dst addrs) and port scans (src -> distinct dst ports).
+The reference points are the streaming spread top-K surface of
+PAPERS.md 2511.16797 and the compact register layouts of 2504.16896;
+the layout here is a CMS-of-HLLs over the estate's murmur3 bucket
+discipline:
+
+    regs: [depth, width, m] uint8      (m registers per bucket)
+    bucket_d(key) = hash_words(key_lanes, seed=d) % width   (ops.cms twin)
+    r             = hash_words(elem_lanes, SPREAD_REG_SEED) % m
+    rho           = clz32(hash_words(elem_lanes, SPREAD_RHO_SEED)) + 1
+    update:  regs[d, bucket_d, r] = max(regs[d, bucket_d, r], rho)
+
+Every update is an integer element-wise max, which makes the state a
+commutative, associative, IDEMPOTENT monoid:
+
+  - merge across shards/workers is element-wise u8 max — exact by
+    construction (max(max(A,B),C) = max over the union), the spread
+    mirror of the CMS u64 sum monoid;
+  - update order cannot change the state, and duplicate elements are
+    free (idempotence), so pre-grouping the batch to unique
+    (key, element) pairs is bit-identical to raw row-at-a-time updates;
+  - all arithmetic is uint32 hashing + uint8 max — no floats in the
+    state, so the three twins (this module, hostsketch/engine.py
+    np_spread_*, native hs_spread_update) are trivially bit-exact and,
+    unlike ops.invsketch, NO x64 mode is needed.
+
+Estimation (``spread_estimate``) is decode-at-read, host-side float64:
+standard HLL harmonic mean with linear-counting small-range correction,
+then min over depth rows (each row is an independent estimate; min
+bounds bucket-collision inflation, the cardinality analogue of the
+count-min min). Only the u8 register state needs three-way parity —
+every serve path (worker, mesh coordinator, delta-fed gateway) decodes
+through this ONE numpy function, so byte-identical registers give
+byte-identical /query/spread answers.
+"""
+
+from __future__ import annotations
+
+# flowlint: uint64-exact
+# (register updates are pure uint32 hash -> uint8 max arithmetic; a
+# signed cast or float promotion breaks three-way twin parity)
+# flowlint: lock-checked
+# (pure functions over immutable jnp arrays — no shared state, no
+# locks; the marker pins that discipline machine-checked)
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..schema.keys import hash_words
+from .cms import cms_buckets
+
+# Element-hash protocol constants — mirrored bit-for-bit by
+# hostsketch/engine.py np_spread_update and native hs_spread_update.
+# Both are far outside the per-depth bucket seed range 0..depth-1, so
+# the register-index and rho streams are independent of the bucket rows.
+SPREAD_REG_SEED = 0x9E3779B9
+SPREAD_RHO_SEED = 0x85EBCA6B
+
+# rho for a zero hash: all 32 bits "leading zeros" + 1. With uint8
+# registers saturation is unreachable (rho <= 33 << 255) but merge/max
+# stays well-defined at 255 anyway (tests pin the edge).
+SPREAD_RHO_ZERO = 33
+
+
+def spread_init(depth: int, width: int, m: int) -> jnp.ndarray:
+    """Fresh register planes: [depth, width, m] uint8 zeros."""
+    return jnp.zeros((depth, width, m), dtype=jnp.uint8)
+
+
+def _bit_length_u32(h):
+    """Vectorized integer bit_length of uint32 (0 -> 0), by binary
+    search over shifts — identical integer steps in all three twins."""
+    h = h.astype(jnp.uint32)
+    n = jnp.zeros(h.shape, dtype=jnp.uint32)
+    for shift in (16, 8, 4, 2, 1):
+        big = (h >> jnp.uint32(shift)) != 0
+        n = jnp.where(big, n + jnp.uint32(shift), n)
+        h = jnp.where(big, h >> jnp.uint32(shift), h)
+    return n + jnp.where(h != 0, jnp.uint32(1), jnp.uint32(0))
+
+
+def spread_update(regs, keys, elems, valid=None):
+    """Scatter-max update with (key, element) rows.
+
+    regs:  [D, W, m] uint8 register planes.
+    keys:  [N, W_k] uint32 key lanes.
+    elems: [N, W_e] uint32 element lanes (counted dimension).
+    valid: [N] bool mask (padded rows contribute rho=0, a no-op under
+           max since registers are >= 0).
+    """
+    d, w, m = regs.shape
+    buckets = cms_buckets(keys, d, w)  # [D, N] int32
+    # flowlint: disable=uint64-discipline -- register INDICES in [0, m < 2^31); scatter wants int32
+    r = (hash_words(elems, seed=SPREAD_REG_SEED)
+         % jnp.uint32(m)).astype(jnp.int32)
+    h2 = hash_words(elems, seed=SPREAD_RHO_SEED)
+    rho = (jnp.uint32(SPREAD_RHO_ZERO) - _bit_length_u32(h2)).astype(jnp.uint8)
+    if valid is not None:
+        rho = jnp.where(valid, rho, jnp.uint8(0))
+    for di in range(d):
+        regs = regs.at[di, buckets[di], r].max(rho)
+    return regs
+
+
+def spread_merge(*states):
+    """Element-wise max fold — the exact merge monoid (commutative,
+    associative, idempotent)."""
+    out = states[0]
+    for s in states[1:]:
+        out = jnp.maximum(out, s)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Decode — host-side float64, shared by EVERY serve path. Pure function
+# of the u8 registers; numpy on purpose (deterministic float64 ops, no
+# XLA fusion reordering), so identical registers decode to identical
+# bytes on worker, mesh coordinator and gateway replicas alike.
+
+def _hll_alpha(m: int) -> float:
+    if m == 16:
+        return 0.673
+    if m == 32:
+        return 0.697
+    if m == 64:
+        return 0.709
+    return 0.7213 / (1.0 + 1.079 / m)
+
+
+_TWO32 = float(1 << 32)
+
+
+def spread_estimate(rows: np.ndarray) -> np.ndarray:
+    """HLL estimate per register row.
+
+    rows: [..., m] uint8 registers. Returns [...] float64: harmonic-mean
+    raw estimate with linear-counting small-range correction (E <= 2.5m
+    with empty registers present) and the 32-bit large-range correction.
+    """
+    rows = np.asarray(rows, dtype=np.uint8)
+    m = rows.shape[-1]
+    alpha = _hll_alpha(m)
+    # flowlint: disable=uint64-discipline -- u8 register VALUES in [0, 255] widened for negation; ldexp exponents, not counters
+    inv = np.ldexp(1.0, -rows.astype(np.int64))  # exact 2^-reg in f64
+    est = alpha * m * m / np.sum(inv, axis=-1)
+    zeros = np.count_nonzero(rows == 0, axis=-1)
+    small = (est <= 2.5 * m) & (zeros > 0)
+    with np.errstate(divide="ignore"):
+        lc = m * np.log(np.where(zeros > 0, m / np.maximum(zeros, 1), 1.0))
+    est = np.where(small, lc, est)
+    large = est > _TWO32 / 30.0
+    est = np.where(large, -_TWO32 * np.log1p(-np.minimum(est, _TWO32 * 0.99999)
+                                             / _TWO32), est)
+    return est
+
+
+def spread_decode(regs: np.ndarray, buckets: np.ndarray) -> np.ndarray:
+    """Point estimates for pre-hashed buckets: min over depth rows.
+
+    regs: [D, W, m] uint8. buckets: [D, N] integer bucket indices.
+    Returns [N] float64 spread estimates.
+    """
+    regs = np.asarray(regs)
+    d = regs.shape[0]
+    ests = [spread_estimate(regs[di, np.asarray(buckets[di])])
+            for di in range(d)]
+    return np.minimum.reduce(ests)

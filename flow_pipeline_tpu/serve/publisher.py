@@ -54,6 +54,7 @@ def _family_from_model(name: str, m: WindowedHeavyHitter) -> FamilyView:
     locked answer's exact prefix."""
     depth = m.k
     rows = m.model.top(depth)
+    regs = None
     if m.model.snapshot_kind == "windowed_hh":
         import numpy as np
 
@@ -72,6 +73,14 @@ def _family_from_model(name: str, m: WindowedHeavyHitter) -> FamilyView:
             planes = np.asarray(planes)
         cms = FrozenCms(lambda a=planes: frozen_cms(a))
         lanes = key_width(m.config)
+    elif m.model.snapshot_kind == "windowed_spread":
+        from ..models.spread import spread_key_width
+
+        kind, cms = "spread", None
+        # the update path mutates registers in place — the snapshot
+        # must freeze its own copy (the immutability contract)
+        regs = m.model.state.regs.copy()
+        lanes = spread_key_width(m.config)
     else:
         kind, cms, lanes = "dense", None, 1
     return FamilyView(
@@ -79,7 +88,8 @@ def _family_from_model(name: str, m: WindowedHeavyHitter) -> FamilyView:
         window_start=(int(m.current_slot)
                       if m.current_slot is not None else None),
         depth=int(len(rows["valid"])), rows=rows, key_lanes=lanes,
-        cms=cms, value_cols=tuple(m.config.value_cols))
+        cms=cms, value_cols=tuple(getattr(m.config, "value_cols", ())),
+        regs=regs)
 
 
 class WorkerServePublisher:
@@ -145,6 +155,11 @@ class WorkerServePublisher:
         self._last_gen = self.ledger.generation
         aud = getattr(worker.fused, "audit", None)
         audit = dict(aud.last_reports) if aud is not None else None
+        saud = getattr(worker.fused, "spread_audit", None)
+        if saud is not None and saud.last_reports:
+            # spread audit reports share the /query/audit namespace —
+            # family names are distinct model names, so a plain merge
+            audit = {**(audit or {}), **saud.last_reports}
         guard = getattr(worker, "guard", None)
         if guard is not None and guard.armed:
             # flowguard is never silent: snapshot metadata records the
@@ -289,6 +304,7 @@ class MeshServePublisher:
                 continue
             slot, payloads = coord.open_window_payloads(spec.name)
             depth = spec.k or spec.config.capacity
+            regs = None
             if spec.kind == "hh":
                 from .snapshot import FrozenCms
 
@@ -298,6 +314,16 @@ class MeshServePublisher:
                 # the merge already materialized the u64 planes
                 cms = FrozenCms(value=merged["cms"])
                 lanes = key_width(spec.config)
+            elif spec.kind == "spread":
+                from ..models.spread import spread_key_width
+
+                merged = (merge_ops.merge_spread(payloads, spec.config)
+                          if payloads else None)
+                rows = merge_ops.spread_top_rows(
+                    merged, spec.config, depth, slot or 0) \
+                    if merged is not None else None
+                regs = merged["regs"] if merged is not None else None
+                cms, lanes = None, spread_key_width(spec.config)
             else:
                 totals = (merge_ops.merge_dense(payloads) if payloads
                           else None)
@@ -311,7 +337,8 @@ class MeshServePublisher:
                 name=spec.name, kind=spec.kind, window_start=slot,
                 depth=int(len(rows["valid"])), rows=rows,
                 key_lanes=lanes, cms=cms,
-                value_cols=tuple(spec.config.value_cols))
+                value_cols=tuple(getattr(spec.config, "value_cols", ())),
+                regs=regs)
         return self.store.publish(
             watermark=float(coord.commit_watermark()), flows_seen=None,
             source="mesh", families=families, ranges=self.ledger.freeze(),

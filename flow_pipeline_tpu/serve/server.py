@@ -3,6 +3,9 @@
     GET /query/version              snapshot identity + freshness
     GET /query/topk?model=&k=       ranked top-K rows (O(K) column slice)
     GET /query/estimate?model=&key= per-key uint64 CMS estimate
+    GET /query/spread?model=&key=   per-key register-decoded distinct
+                                    count (spread families; without
+                                    key=, the ranked-by-spread rows)
     GET /query/range?model=&from=&to=  closed exact-window rows by slot
     GET /healthz                    liveness
 
@@ -296,6 +299,7 @@ class ServeServer:
             "/query/version": self._version,
             "/query/topk": self._topk,
             "/query/estimate": self._estimate,
+            "/query/spread": self._spread,
             "/query/range": self._range,
             "/query/audit": self._audit,
         }.get(endpoint)
@@ -442,6 +446,10 @@ class ServeServer:
         from ..hostsketch.engine import np_cms_query_u64
 
         fam = self._pick_family(snap, q)
+        if fam.kind == "spread":
+            raise ValueError(
+                f"model {fam.name!r} is spread-backed (distinct counts, "
+                "not volumes): use /query/spread")
         if fam.cms is None:
             raise ValueError(
                 f"model {fam.name!r} is {fam.kind}-backed (exact): it has "
@@ -469,6 +477,63 @@ class ServeServer:
             "window_start": fam.window_start,
             "key": lanes,
             "estimates": {n: int(est[j]) for j, n in enumerate(names)},
+        }
+
+    def _spread(self, snap: Snapshot, q) -> dict:
+        """flowspread read surface. With ``key=``: the per-key
+        register-decoded distinct-count estimate (the one shared decode
+        — hostsketch.engine.np_spread_query — over the snapshot's
+        frozen u8 planes, so identical registers give identical answers
+        on the worker, the mesh coordinator and every gateway replica).
+        Without: the ranked-by-spread top rows, exactly like /query/topk
+        but scoped to spread families."""
+        import numpy as np
+
+        from ..hostsketch.engine import np_spread_query
+
+        name = q.get("model")
+        if name:
+            fam = snap.families.get(name)
+            if fam is None:
+                raise KeyError(f"no served model named {name!r}")
+        else:
+            fam = next((f for f in snap.families.values()
+                        if f.kind == "spread"), None)
+            if fam is None:
+                raise KeyError("no spread family in the served snapshot")
+        if fam.kind != "spread" or fam.regs is None:
+            raise ValueError(
+                f"model {fam.name!r} is {fam.kind}-backed: it has no "
+                "spread registers — use /query/topk or /query/estimate")
+        if "key" in q:
+            lanes = [int(x) for x in q["key"].split(",")]
+            if len(lanes) != fam.key_lanes:
+                raise ValueError(
+                    f"key must carry {fam.key_lanes} uint32 lanes for "
+                    f"model {fam.name!r}, got {len(lanes)}")
+            if not all(0 <= x < 2**32 for x in lanes):
+                raise ValueError("key lanes must be uint32 (0 <= lane < "
+                                 "2^32)")
+            keys = np.asarray([lanes], dtype=np.uint32)
+            return {
+                "model": fam.name,
+                "version": snap.version,
+                "window_start": fam.window_start,
+                "key": lanes,
+                "spread": float(np_spread_query(fam.regs, keys)[0]),
+            }
+        k = int(q.get("k", 10))
+        if k < 0:
+            raise ValueError(f"k must be >= 0, got {k}")
+        k = min(k, fam.depth)
+        rows = {name: col[:k] for name, col in fam.rows.items()}
+        return {
+            "model": fam.name,
+            "version": snap.version,
+            "watermark": snap.watermark,
+            "window_start": fam.window_start,
+            "k": k,
+            "rows": rows_to_records(rows),
         }
 
     @staticmethod

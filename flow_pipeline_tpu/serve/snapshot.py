@@ -119,16 +119,20 @@ class FamilyView:
     answer is each column sliced ``[:k]`` (the table is already ranked;
     truncation is exact). ``cms`` is the family's count-min in the
     exact uint64 monoid, lazily frozen (None for dense families, which
-    have no sketch — every value is exact already)."""
+    have no sketch — every value is exact already). ``regs`` are a
+    spread family's frozen u8 register planes (the exact max-monoid
+    canonical form) — what ``/query/spread`` decodes per key; None for
+    every other kind."""
 
     name: str
-    kind: str  # "hh" | "dense"
+    kind: str  # "hh" | "dense" | "spread"
     window_start: Optional[int]
     depth: int
     rows: Mapping[str, np.ndarray]
     key_lanes: int  # uint32 key lanes a /query/estimate key must carry
     cms: Optional[FrozenCms]  # -> [P+1, depth, width] uint64
     value_cols: tuple = ()
+    regs: Optional[np.ndarray] = None  # spread: [depth, width, m] uint8
 
 
 @dataclass(frozen=True)

@@ -28,7 +28,8 @@ KNOWN_FLAGS = frozenset({
     "loglevel", "kafka.topic", "kafka.brokers", "proto.fixedlen",
     # generator / mocker
     "produce.count", "produce.rate", "produce.seed", "produce.profile",
-    "produce.batch", "produce.shard", "zipf.keys", "zipf.alpha", "out",
+    "produce.batch", "produce.shard", "zipf.keys", "zipf.alpha",
+    "zipf.spread", "out",
     # processor
     "processor.backend", "processor.batch", "processor.mesh",
     "processor.fused", "processor.hostassist",
@@ -36,6 +37,9 @@ KNOWN_FLAGS = frozenset({
     "model.ddos",
     "sketch.width", "sketch.cms", "sketch.prefilter", "sketch.admission",
     "sketch.capacity", "sketch.topk", "sketch.backend", "hh.sketch",
+    # flowspread (models/spread.py) — distinct-count detectors
+    "spread.enabled", "spread.depth", "spread.width", "spread.regs",
+    "spread.capacity", "spread.topk",
     "window.lateness", "archive.raw", "feed.prefetch",
     "ingest.mode", "ingest.shards", "ingest.depth", "ingest.flush_queue",
     "ingest.native_group", "ingest.fused", "ingest.threads",

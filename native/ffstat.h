@@ -37,6 +37,8 @@ enum FfStat {
   FF_STAT_LANES_NS = 11,     // ff_build_lanes / ff_build_planes: native
                              // lane building off the decoded columns
                              // (the r19 flowspeed attribution slot)
+  FF_STAT_SPREAD_NS = 12,    // hs_spread_update (the flowspread
+                             // distinct-count family's register fold)
 };
 
 constexpr int kFfStatsLen = 16;

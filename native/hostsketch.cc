@@ -666,4 +666,73 @@ long long hs_inv_decode(const uint64_t* cms, long long planes,
   return n_out;
 }
 
+// Distinct-count (flowspread) register update — the native twin of
+// hostsketch/engine.py np_spread_update and ops/spread.py
+// spread_update. Per pre-grouped (key, element) pair row r and depth
+// row d (bucket b = the SAME murmur3 word-lane hash the CMS rows use):
+//
+//   reg = hash_words(elem, SPREAD_REG_SEED) % m
+//   rho = clz32(hash_words(elem, SPREAD_RHO_SEED)) + 1   (h == 0 -> 33)
+//   regs[d, b, reg] = max(regs[d, b, reg], rho)
+//
+// Every cell is a u8 max — commutative, associative, IDEMPOTENT — so
+// (a) merging shards is an element-wise u8 max, (b) neither update
+// order nor duplicate pairs can change a bit (callers pre-group for
+// throughput, not correctness), and (c) per-depth task ownership makes
+// the threaded update deterministic at any thread count with no
+// atomics (rows of different depths write disjoint register blocks).
+//
+//   regs:   [depth, width, m] uint8, in place
+//   keys:   [n, kw] uint32 key lanes (pre-grouped unique pairs)
+//   elems:  [n, ew] uint32 element lanes (the counted dimension)
+//   valid:  [n] uint8 mask (NULL = all valid)
+//
+// Returns 0, or -1 on degenerate shapes. n == 0 is a clean no-op.
+long long hs_spread_update(uint8_t* regs, long long depth, long long width,
+                           long long m, const uint32_t* keys, long long n,
+                           long long kw, const uint32_t* elems,
+                           long long ew, const uint8_t* valid, int threads,
+                           int64_t* stats) {
+  if (depth < 1 || width < 1 || m < 1 || n < 0 || kw < 1 || ew < 1) {
+    return -1;
+  }
+  if (n == 0) return 0;
+  int64_t t0 = ff_now_ns(stats);
+  std::vector<uint32_t> buckets(static_cast<size_t>(depth * n));
+  fill_buckets(keys, n, kw, depth, width, threads, buckets.data());
+  // per-row (register index, rho) once, shared by every depth task —
+  // protocol constants mirrored bit-for-bit by ops/spread.py
+  std::vector<uint32_t> reg(static_cast<size_t>(n));
+  std::vector<uint8_t> rho(static_cast<size_t>(n));
+  parallel_tasks(n_blocks(n), threads, [&](long long blk) {
+    long long lo = blk * kRowBlock;
+    long long hi = std::min(n, lo + kRowBlock);
+    uint32_t mm = static_cast<uint32_t>(m);
+    for (long long r = lo; r < hi; ++r) {
+      const uint32_t* e = elems + r * ew;
+      reg[static_cast<size_t>(r)] = hash_words(e, ew, 0x9E3779B9u) % mm;
+      uint32_t h2 = hash_words(e, ew, 0x85EBCA6Bu);
+      // rho = clz32(h2) + 1 in [1, 33]; __builtin_clz(0) is UB, so the
+      // zero hash takes the explicit 33 branch (ops.spread's twin rule)
+      rho[static_cast<size_t>(r)] =
+          h2 == 0 ? 33 : static_cast<uint8_t>(__builtin_clz(h2) + 1);
+    }
+  });
+  // scatter-max: task d owns the whole [width, m] register block of
+  // depth row d — disjoint writes, and max is order-free anyway
+  parallel_tasks(depth, threads, [&](long long d) {
+    const uint32_t* b = buckets.data() + d * n;
+    uint8_t* block = regs + d * width * m;
+    for (long long r = 0; r < n; ++r) {
+      if (valid && !valid[r]) continue;
+      uint8_t* cell = block + static_cast<long long>(b[r]) * m +
+                      reg[static_cast<size_t>(r)];
+      uint8_t v = rho[static_cast<size_t>(r)];
+      if (v > *cell) *cell = v;
+    }
+  });
+  if (stats != nullptr) stats[FF_STAT_SPREAD_NS] += ff_now_ns(stats) - t0;
+  return 0;
+}
+
 }  // extern "C"

@@ -373,6 +373,32 @@ class TestGrafana:
         assert "flow_guard_lag_seconds" in exprs
         assert "faults_delayed_total" in exprs
 
+    def test_pipeline_dashboard_flowspread_panels(self):
+        """Round-21 flowspread panels: the per-detector max-distinct
+        gauge (the alerting surface), the entropy anomaly signal
+        charted against its EW baseline, and the sampled
+        exact-distinct shadow audit's error/cohort health."""
+        with open(os.path.join(DEPLOY, "grafana", "dashboards",
+                               "pipeline.json")) as f:
+            dash = json.load(f)
+        panels = {p["title"]: p for p in dash["panels"]}
+        top = panels["Spread detectors (max distinct per window)"]
+        exprs = " ".join(t["expr"] for t in top["targets"])
+        assert "spread_top_max" in exprs
+        assert "sketch_spread_audit_windows_total" in exprs
+        assert top["targets"][0]["legendFormat"].startswith("{{model}}")
+        ent = panels["Flow entropy vs baseline (DDoS collapse signal)"]
+        exprs = " ".join(t["expr"] for t in ent["targets"])
+        assert "flow_entropy" in exprs
+        assert "flow_entropy_baseline" in exprs
+        err = panels["Spread audit error (sampled exact-distinct "
+                     "shadow)"]
+        exprs = " ".join(t["expr"] for t in err["targets"])
+        assert "sketch_spread_error_ratio_bucket" in exprs
+        assert "histogram_quantile(0.99" in exprs and "by (le)" in exprs
+        assert "sketch_spread_audit_sampled_keys" in exprs
+        assert "sketch_spread_audit_cohort_overflow_total" in exprs
+
     def test_mesh_topology_gateway_tier(self):
         """Round-18 flowgate compose: two stateless gateway replicas
         front the coordinator's snapshot stream (the '2 gateways over
@@ -484,6 +510,8 @@ class TestDashboardHonesty:
                   "by", "histogram_quantile", "time", "le",
                   # scrape-level label (vector-match key in alert exprs)
                   "instance",
+                  # sketch-audit family label (by-clause key)
+                  "family",
                   # binary-op/matching keywords (alert exprs)
                   "and", "or", "unless", "on", "ignoring"}
     SQL_KEYWORDS = {"select", "from", "where", "group", "by", "order",
@@ -533,6 +561,9 @@ class TestDashboardHonesty:
 
         from flow_pipeline_tpu.gateway import SnapshotGateway
         from flow_pipeline_tpu.mesh import MeshCoordinator, MeshMember
+        from flow_pipeline_tpu.models.ddos import DDoSDetector
+        from flow_pipeline_tpu.models.spread import SpreadModel
+        from flow_pipeline_tpu.obs.audit import SpreadAudit
         from flow_pipeline_tpu.serve import SnapshotStore
         from flow_pipeline_tpu.sink import MemorySink, ResilientSink
         from flow_pipeline_tpu.utils import faults as _faults
@@ -547,6 +578,9 @@ class TestDashboardHonesty:
         SnapshotStore()  # serve_* families (eager registration)
         SnapshotGateway([SnapshotStore()])  # gateway_* families
         ResilientSink(MemorySink())  # sink retry/dead-letter families
+        DDoSDetector()  # flow_entropy gauges (eager registration)
+        SpreadModel()  # spread_top_max (eager registration)
+        SpreadAudit({})  # sketch_spread_* audit families
         assert _faults.FAULTS.m_injected is not None  # faults_injected
         names = set(reg._metrics) | set(REGISTRY._metrics)
         for text in (reg.render(), REGISTRY.render()):
@@ -618,6 +652,15 @@ class TestDashboardHonesty:
         # policy pages — sampled answers / bounced readers mean
         # capacity is short even though nothing crashed
         assert any("guard_shed_total" in r["expr"] for r in rules)
+        # the flowspread rules the r21 satellite names: the two
+        # detector pagers on the per-model max-distinct gauge, and the
+        # entropy-collapse companion gated on a warm baseline
+        by_name = {r["alert"]: r for r in rules}
+        assert 'model="superspreaders"' in \
+            by_name["SuperspreaderDetected"]["expr"]
+        assert 'model="portscan"' in by_name["PortScanDetected"]["expr"]
+        ent = by_name["EntropyCollapse"]["expr"]
+        assert "flow_entropy" in ent and "flow_entropy_baseline" in ent
 
     def test_alerts_wired_into_prometheus_and_compose(self):
         """The rules file must actually be evaluated: prometheus.yml

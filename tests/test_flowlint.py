@@ -1219,13 +1219,13 @@ class TestAbiContract:
 
     def test_repo_abi_covers_all_native_symbols(self):
         # the acceptance criterion: the rule parses and checks every
-        # bound symbol of the real library (16 as of r19 — decode/count/
+        # bound symbol of the real library (17 as of r21 — decode/count/
         # encode/hash_group + the threaded hash_group_mt twin + the 4
-        # hs_* sketch kernels + the 2 hs_inv_* invertible kernels + the
-        # 3 ff_* fused-dataplane kernels + the 2 ff_build_* lane
-        # builders). The fused kernels' cross-file calls INTO hs_* are
-        # declarations (semicolon-terminated), which the parser must not
-        # double-count as exports.
+        # hs_* sketch kernels + the hs_spread_update register scatter-max
+        # + the 2 hs_inv_* invertible kernels + the 3 ff_* fused-dataplane
+        # kernels + the 2 ff_build_* lane builders). The fused kernels'
+        # cross-file calls INTO hs_* are declarations (semicolon-
+        # terminated), which the parser must not double-count as exports.
         from tools.flowlint import rules_abi
 
         exports = [f.name for f in rules_abi.parse_exports(REPO)]
@@ -1236,7 +1236,8 @@ class TestAbiContract:
             "flow_encode_stream", "flow_hash_group",
             "flow_hash_group_mt",
             "hs_cms_update", "hs_cms_query", "hs_hh_prefilter",
-            "hs_topk_merge", "hs_inv_update", "hs_inv_decode",
+            "hs_topk_merge", "hs_spread_update",
+            "hs_inv_update", "hs_inv_decode",
             "ff_group_sum", "ff_group_sum_mt", "ff_fused_update",
             "ff_build_lanes", "ff_build_planes",
         }

@@ -211,6 +211,77 @@ class TestSpaceSavingAdmission:
             [[(k, float(v)) for k, v in pairs] for pairs in rounds])
 
 
+class TestSpreadProperty:
+    """flowspread register monoid (ops/spread.py, hostsketch
+    np_spread_*): merge is a commutative/associative/idempotent max,
+    update order cannot change state, and the decoded estimate is
+    monotone as the true distinct set grows — the three facts the
+    mesh-exactness argument rests on."""
+
+    regs_arrays = st.integers(0, 2**32 - 1).map(
+        lambda seed: np.random.default_rng(seed).integers(
+            0, 34, (2, 8, 16), dtype=np.uint8))
+
+    @given(a=regs_arrays, b=regs_arrays, c=regs_arrays)
+    @settings(max_examples=60, deadline=None)
+    def test_merge_is_a_bounded_semilattice(self, a, b, c):
+        m = np.maximum
+        assert np.array_equal(m(a, b), m(b, a))
+        assert np.array_equal(m(m(a, b), c), m(a, m(b, c)))
+        assert np.array_equal(m(a, a), a)
+        # saturated planes are absorbing (u8 edge)
+        full = np.full_like(a, 255)
+        assert np.array_equal(m(a, full), full)
+
+    @given(
+        pairs=st.lists(st.tuples(st.integers(0, 40), st.integers(0, 5000)),
+                       min_size=1, max_size=200),
+        perm_seed=st.integers(0, 2**32 - 1),
+        split=st.integers(1, 7),
+    )
+    @settings(max_examples=40, deadline=None)
+    def test_update_order_and_chunking_cannot_change_state(
+            self, pairs, perm_seed, split):
+        from flow_pipeline_tpu.hostsketch.engine import np_spread_update
+
+        keys = np.array([[k] for k, _ in pairs], np.uint32)
+        elems = np.array([[e] for _, e in pairs], np.uint32)
+        ref = np.zeros((2, 16, 16), np.uint8)
+        np_spread_update(ref, keys, elems)
+        order = np.random.default_rng(perm_seed).permutation(len(pairs))
+        got = np.zeros((2, 16, 16), np.uint8)
+        step = max(1, len(pairs) // split)
+        for s in range(0, len(pairs), step):
+            sel = order[s:s + step]
+            np_spread_update(got, keys[sel], elems[sel])
+        assert np.array_equal(ref, got)
+
+    @given(
+        n_elems=st.integers(1, 400),
+        seed=st.integers(0, 2**32 - 1),
+        key=st.integers(0, 2**32 - 1),
+    )
+    @settings(max_examples=30, deadline=None)
+    def test_decoded_spread_monotone_in_true_distinct_count(
+            self, n_elems, seed, key):
+        from flow_pipeline_tpu.hostsketch.engine import (np_spread_query,
+                                                         np_spread_update)
+
+        rng = np.random.default_rng(seed)
+        elems = rng.choice(2**32, size=n_elems, replace=False).astype(
+            np.uint32).reshape(-1, 1)
+        keys = np.full((n_elems, 1), key, np.uint32)
+        regs = np.zeros((2, 32, 32), np.uint8)
+        qkey = keys[:1]
+        prev = np_spread_query(regs, qkey)[0]
+        assert prev == 0.0
+        for s in range(0, n_elems, 50):
+            np_spread_update(regs, keys[s:s + 50], elems[s:s + 50])
+            cur = np_spread_query(regs, qkey)[0]
+            assert cur >= prev - 1e-12  # registers only grow
+            prev = cur
+
+
 class TestRetryProperty:
     """utils/retry.py invariants for arbitrary policy parameters: the
     delay schedule is bounded by [min(cap, base*2^i), that * (1+jitter)],

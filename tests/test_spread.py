@@ -1,0 +1,461 @@
+"""flowspread tests: the distinct-count sketch family (models/spread.py,
+ops/spread.py, hostsketch np_spread_*, native hs_spread_update).
+
+The contracts pinned here, per docs/ARCHITECTURE.md "flowspread":
+
+- three bit-exact twins: numpy reference, jnp ops kernel, threaded C —
+  identical registers for any stream, any chunking, threads {1,2,8},
+  u8-saturated planes included;
+- mesh-exact merge: N-worker merged registers bit-identical to a single
+  worker over the same stream at N in {1,2,4}, including a member
+  restart-and-replay; decoded top rows identical; mixed-kind folds
+  rejected;
+- one decode: /query/spread through worker snapshot, delta-fed gateway
+  state, and checkpoint restore answers from byte-identical registers;
+- the sketchwatch spread audit (exact sampled SETS) reports relative
+  error without perturbing the dataplane.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.engine import (StreamWorker, WindowedHeavyHitter,
+                                      WorkerConfig)
+from flow_pipeline_tpu.engine.hostfused import HostGroupPipeline
+from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+from flow_pipeline_tpu.hostsketch.engine import (np_spread_query,
+                                                 np_spread_update,
+                                                 spread_apply_update)
+from flow_pipeline_tpu.hostsketch.pipeline import HostSketchPipeline
+from flow_pipeline_tpu.mesh import codec
+from flow_pipeline_tpu.mesh import merge as merge_ops
+from flow_pipeline_tpu.mesh.runtime import shard_ids
+from flow_pipeline_tpu.models.scan import SCAN_MODEL, scan_model
+from flow_pipeline_tpu.models.spread import (SpreadConfig, SpreadModel,
+                                             spread_key_width,
+                                             spread_top_from)
+from flow_pipeline_tpu.models.superspreader import (SUPERSPREADER_MODEL,
+                                                    superspreader_config,
+                                                    superspreader_model)
+from flow_pipeline_tpu.schema.batch import FlowBatch
+from flow_pipeline_tpu.serve import ServeServer, attach_worker
+from flow_pipeline_tpu.sink import MemorySink
+from flow_pipeline_tpu.transport import Consumer, InProcessBus, Producer
+
+T0 = 1_699_999_800  # window-aligned stream start
+
+
+def _zipf_batch(n=20_000, seed=7, t0=T0, rate=1e9):
+    """One batch with spreader/scanner legs (all rows land in one
+    5-minute window at the default rate)."""
+    gen = FlowGenerator(ZipfProfile(n_keys=2000, spread_fraction=0.25),
+                        seed=seed, t0=t0, rate=rate)
+    return gen.batch(n)
+
+
+def _pairs(n=4000, seed=0, kw=1, ew=1, key_space=50, elem_space=5000):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, key_space, (n, kw), dtype=np.uint32)
+    elems = rng.integers(0, elem_space, (n, ew), dtype=np.uint32)
+    return keys, elems
+
+
+def _sub_batch(batch, mask):
+    return FlowBatch({k: np.ascontiguousarray(v[mask])
+                      for k, v in batch.columns.items()}, partition=0)
+
+
+def _state_tuple(m):
+    s = m.model.state
+    return s.regs, s.table_keys, s.table_metric
+
+
+def _assert_states_equal(a, b, tag=""):
+    for x, y, name in zip(a, b, ("regs", "table_keys", "table_metric")):
+        assert np.array_equal(x, y), f"{tag}{name}"
+
+
+# ---- twins -----------------------------------------------------------------
+
+
+class TestTwins:
+    def test_numpy_vs_jnp_registers(self):
+        from flow_pipeline_tpu.ops.spread import spread_update
+
+        keys, elems = _pairs(seed=1)
+        regs_np = np.zeros((2, 256, 64), np.uint8)
+        np_spread_update(regs_np, keys, elems)
+        import jax.numpy as jnp
+
+        regs_j = np.asarray(
+            spread_update(jnp.zeros((2, 256, 64), jnp.uint8), keys, elems),
+            dtype=np.uint8)
+        assert np.array_equal(regs_np, regs_j)
+
+    def test_native_twin_thread_sweep(self):
+        from flow_pipeline_tpu import native
+
+        if not native.spread_available():
+            pytest.skip("native library lacks hs_spread_update")
+        keys, elems = _pairs(n=20_000, seed=2)
+        ref = np.zeros((2, 512, 64), np.uint8)
+        np_spread_update(ref, keys, elems)
+        for threads in (1, 2, 8):
+            regs = np.zeros((2, 512, 64), np.uint8)
+            native.hs_spread_update(regs, keys, elems, threads)
+            assert np.array_equal(ref, regs), f"threads={threads}"
+
+    def test_saturated_planes_stay_saturated(self):
+        """u8 edge: pre-saturated registers (e.g. merged-in extremes)
+        must survive any further scatter-max and any merge untouched —
+        max can never decrease, in any twin."""
+        from flow_pipeline_tpu import native
+        from flow_pipeline_tpu.ops.spread import spread_merge, spread_update
+
+        keys, elems = _pairs(n=2000, seed=3)
+        full = np.full((2, 64, 64), 255, np.uint8)
+        for twin in ("numpy", "jnp", "native"):
+            regs = full.copy()
+            if twin == "numpy":
+                np_spread_update(regs, keys, elems)
+            elif twin == "jnp":
+                import jax.numpy as jnp
+
+                regs = np.asarray(
+                    spread_update(jnp.asarray(regs), keys, elems),
+                    dtype=np.uint8)
+            elif native.spread_available():
+                native.hs_spread_update(regs, keys, elems, 2)
+            assert (regs == 255).all(), twin
+        import jax.numpy as jnp
+        merged = np.asarray(spread_merge(jnp.asarray(full), jnp.zeros_like(full)))
+        assert (merged == 255).all()
+
+    def test_chunking_invariance(self):
+        """The max monoid: any split of the pair stream lands identical
+        registers (the property the pipelines' pre-grouping leans on)."""
+        keys, elems = _pairs(n=5000, seed=4)
+        ref = np.zeros((2, 128, 64), np.uint8)
+        np_spread_update(ref, keys, elems)
+        for step in (1, 7, 999, 5000):
+            regs = np.zeros((2, 128, 64), np.uint8)
+            for s in range(0, len(keys), step):
+                spread_apply_update(regs, keys[s:s + step],
+                                    elems[s:s + step], threads=2)
+            assert np.array_equal(ref, regs), f"step={step}"
+
+    def test_rejects_elem_col_in_keys(self):
+        with pytest.raises(ValueError, match="elem_col"):
+            SpreadModel(SpreadConfig(key_cols=("src_addr",),
+                                     elem_col="src_addr"))
+
+
+# ---- pipelines -------------------------------------------------------------
+
+
+class TestPipelineParity:
+    """Every host pipeline folds spread bit-identically to the direct
+    model update over the same batch (the citizenship gate)."""
+
+    def _models(self):
+        return {SUPERSPREADER_MODEL: superspreader_model(),
+                SCAN_MODEL: scan_model()}
+
+    def test_hostgroup_and_hostsketch_match_direct(self):
+        batch = _zipf_batch()
+        ref = self._models()
+        for m in ref.values():
+            m.update(batch)
+        for cls, kw in ((HostGroupPipeline, {}),
+                        (HostSketchPipeline,
+                         dict(sketch_native="auto", fused="auto")),
+                        (HostSketchPipeline,
+                         dict(sketch_native="numpy", fused="off"))):
+            models = self._models()
+            p = cls(models, **kw)
+            p.update(batch)
+            if hasattr(p, "sync_states"):
+                p.sync_states()
+            for name in models:
+                _assert_states_equal(_state_tuple(ref[name]),
+                                     _state_tuple(models[name]),
+                                     f"{cls.__name__}:{name}:")
+
+    def test_top_rows_rank_by_decoded_spread(self):
+        batch = _zipf_batch()
+        m = superspreader_model()
+        m.update(batch)
+        top = m.model.top(32)
+        assert top["valid"].all()
+        spread = top["spread"]
+        assert (np.diff(spread[top["valid"]]) <= 0).all()  # descending
+        # the admission metric is an upper bound on the decoded estimate
+        # only in expectation; but every reported spread must be the
+        # register decode of that row's key, exactly
+        keys = np.ascontiguousarray(top["src_addr"], np.uint32)
+        again = np_spread_query(m.model.state.regs, keys)
+        assert np.allclose(spread, again.astype(np.float32), rtol=1e-6)
+
+    def test_spread_legs_rank_first(self):
+        """The generator's harmonic fan-out legs are exactly what the
+        detector must surface: leg sources (suffix 0xF000|rank) own the
+        top of both detectors' tables."""
+        batch = _zipf_batch(n=40_000)
+        ss, sc = superspreader_model(), scan_model()
+        ss.update(batch)
+        sc.update(batch)
+        for model, want_even in ((ss, True), (sc, False)):
+            top = model.model.top(4)
+            suf = np.asarray(top[model.config.key_cols[0]])[:, 3]
+            assert ((suf & 0xF000) == 0xF000).all(), model
+            ranks = suf & 0xFFF
+            assert ((ranks % 2 == 0) == want_even).all(), model
+
+
+# ---- mesh ------------------------------------------------------------------
+
+
+class TestMeshExact:
+    @pytest.mark.parametrize("n_members", [1, 2, 4])
+    def test_merged_registers_bit_exact(self, n_members):
+        batch = _zipf_batch()
+        oracle = superspreader_model()
+        oracle.update(batch)
+        cfg = oracle.config
+
+        ids = shard_ids(batch, n_members)
+        payloads = []
+        for i in range(n_members):
+            member = superspreader_model()
+            member.update(_sub_batch(batch, ids == i))
+            blob = codec.encode(codec.capture_model(member.model))
+            payloads.append(codec.decode(blob))
+        merged = merge_ops.merge_spread(payloads, cfg)
+        assert np.array_equal(merged["regs"], oracle.model.state.regs)
+        # decoded rows identical too (the admission metric itself is
+        # chunking-dependent and deliberately NOT compared)
+        slot = 0
+        rows = merge_ops.spread_top_rows(merged, cfg, 16, slot)
+        want = spread_top_from(oracle.model.state, cfg, 16)
+        for col in ("src_addr", "spread", "valid"):
+            assert np.array_equal(rows[col], want[col]), col
+
+    def test_member_restart_and_replay(self):
+        """Churn leg: one member dies, restarts empty, replays its
+        shard — the merged registers still equal the single worker's
+        (idempotent max absorbs the replay)."""
+        batch = _zipf_batch()
+        oracle = superspreader_model()
+        oracle.update(batch)
+        ids = shard_ids(batch, 4)
+        payloads = []
+        for i in range(4):
+            member = superspreader_model()
+            member.update(_sub_batch(batch, ids == i))
+            if i == 2:  # dies; a fresh member replays the same shard
+                member = superspreader_model()
+                member.update(_sub_batch(batch, ids == i))
+                member.update(_sub_batch(batch, ids == i))  # partial re-read
+            payloads.append(codec.capture_model(member.model))
+        merged = merge_ops.merge_spread(payloads, oracle.config)
+        assert np.array_equal(merged["regs"], oracle.model.state.regs)
+
+    def test_mixed_family_fold_rejected(self):
+        m = superspreader_model()
+        m.update(_zipf_batch(n=2000))
+        good = codec.capture_model(m.model)
+        with pytest.raises(ValueError, match="mixed"):
+            merge_ops.merge_spread([good, {"kind": "hh"}], m.config)
+
+
+# ---- serve / gateway / checkpoint -----------------------------------------
+
+
+def _fill_bus(batches=6, per=800, seed=91):
+    bus = InProcessBus()
+    bus.create_topic("flows", 1)
+    gen = FlowGenerator(ZipfProfile(n_keys=500, spread_fraction=0.25),
+                        seed=seed, t0=T0, rate=5.0)
+    prod = Producer(bus, fixedlen=True)
+    for _ in range(batches):
+        prod.send_many(gen.batch(per).to_messages())
+    return bus
+
+
+def _spread_models():
+    return {SUPERSPREADER_MODEL: superspreader_model(
+        superspreader_config(capacity=128), k=16)}
+
+
+def _get(port, path):
+    return json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}").read())
+
+
+class TestServeSpread:
+    @pytest.fixture(scope="class")
+    def served(self):
+        worker = StreamWorker(
+            Consumer(_fill_bus(), fixedlen=True), _spread_models(),
+            [MemorySink()], WorkerConfig(snapshot_every=0, poll_max=512))
+        pub = attach_worker(worker, refresh=0.0)
+        while worker.run_once():
+            pass
+        with worker.lock:
+            pub.publish(worker)
+        serve = ServeServer(pub.store, port=0).start()
+        yield worker, pub, serve
+        serve.stop()
+
+    def test_query_spread_key_decodes_live_registers(self, served):
+        worker, pub, serve = served
+        fam = pub.store.current.families[SUPERSPREADER_MODEL]
+        assert fam.kind == "spread" and fam.regs is not None
+        k = fam.rows["src_addr"][0]
+        key = ",".join(str(int(x)) for x in np.atleast_1d(k))
+        ans = _get(serve.port, f"/query/spread?model={SUPERSPREADER_MODEL}"
+                               f"&key={key}")
+        want = np_spread_query(fam.regs,
+                               np.atleast_2d(np.asarray(k, np.uint32)))[0]
+        assert np.isclose(ans["spread"], want, rtol=1e-9)
+        assert np.isclose(ans["spread"], float(fam.rows["spread"][0]),
+                          rtol=1e-6)
+
+    def test_query_spread_topk_matches_rows(self, served):
+        worker, pub, serve = served
+        fam = pub.store.current.families[SUPERSPREADER_MODEL]
+        ans = _get(serve.port, f"/query/spread?model={SUPERSPREADER_MODEL}"
+                               f"&k=5")
+        assert len(ans["rows"]) == 5
+        assert [r["spread"] for r in ans["rows"]] == \
+            [float(x) for x in fam.rows["spread"][:5]]
+
+    def test_estimate_refuses_spread_family(self, served):
+        worker, pub, serve = served
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(serve.port,
+                 f"/query/estimate?model={SUPERSPREADER_MODEL}&key=1")
+        assert ei.value.code == 400
+
+    def test_gateway_delta_chain_reconstructs_spread(self, served):
+        """regs ride the delta codec as dirty-column patches: full
+        frame + delta == directly-encoded target, and /query/spread
+        from the reconstructed state is BYTE-identical."""
+        from flow_pipeline_tpu.gateway import (apply_delta, diff_states,
+                                               snapshot_state,
+                                               state_to_snapshot)
+        from flow_pipeline_tpu.serve import SnapshotStore
+
+        worker, pub, serve = served
+        snap = pub.store.current
+        st = snapshot_state(snap)
+        # an older synthetic base: zeroed registers, same layout
+        base = snapshot_state(snap)
+        fname = SUPERSPREADER_MODEL
+        base["families"][fname]["regs"] = np.zeros_like(
+            base["families"][fname]["regs"])
+        base["version"] = snap.version - 1
+        delta = diff_states(base, st)
+        fams = delta["families"][fname]
+        assert ("regs" in fams or "regs_sparse" in fams
+                or "regs_tiles" in fams)
+        rebuilt = apply_delta(base, delta)
+        assert np.array_equal(rebuilt["families"][fname]["regs"],
+                              st["families"][fname]["regs"])
+        mirror = SnapshotStore()
+        mirror.publish_snapshot(state_to_snapshot(rebuilt))
+        gw = ServeServer(mirror, port=0).start()
+        try:
+            path = (f"/query/spread?model={fname}&k=8")
+            direct = urllib.request.urlopen(
+                f"http://127.0.0.1:{serve.port}{path}").read()
+            mirrored = urllib.request.urlopen(
+                f"http://127.0.0.1:{gw.port}{path}").read()
+            assert direct == mirrored
+        finally:
+            gw.stop()
+
+    def test_checkpoint_round_trip(self, served, tmp_path):
+        worker, pub, serve = served
+        from flow_pipeline_tpu.engine.checkpoint import save_checkpoint
+
+        path = str(tmp_path / "ckpt")
+        with worker.lock:
+            save_checkpoint(path, worker._state())
+        fresh = StreamWorker(
+            Consumer(_fill_bus(), fixedlen=True), _spread_models(),
+            [MemorySink()], WorkerConfig(snapshot_every=0))
+        assert fresh.restore(path)
+        a = worker.models[SUPERSPREADER_MODEL].model.state
+        b = fresh.models[SUPERSPREADER_MODEL].model.state
+        assert np.array_equal(a.regs, b.regs)
+        assert np.array_equal(a.table_keys, b.table_keys)
+        assert np.array_equal(a.table_metric, b.table_metric)
+        assert b.regs.dtype == np.uint8
+
+
+# ---- sketchwatch spread audit ---------------------------------------------
+
+
+class TestSpreadAudit:
+    def test_full_mode_reports_small_median_error(self):
+        models = {SUPERSPREADER_MODEL: superspreader_model()}
+        p = HostGroupPipeline(models, audit="full")
+        assert p.spread_audit is not None
+        p.update(_zipf_batch(t0=T0))
+        assert p.spread_audit._fams[SUPERSPREADER_MODEL].elems
+        p.update(_zipf_batch(seed=8, t0=T0 + 600))  # closes the window
+        rep = p.spread_audit.last_reports[SUPERSPREADER_MODEL]
+        assert rep["sampled_keys"] > 0
+        assert abs(rep["spread_abs_err"]["p50"]) < 0.25
+        from flow_pipeline_tpu.obs.metrics import REGISTRY
+        assert "sketch_spread_error_ratio" in REGISTRY.render()
+
+    def test_audit_is_purely_observational(self):
+        batch = _zipf_batch()
+        on = {SUPERSPREADER_MODEL: superspreader_model()}
+        off = {SUPERSPREADER_MODEL: superspreader_model()}
+        HostGroupPipeline(on, audit="full").update(batch)
+        HostGroupPipeline(off).update(batch)
+        _assert_states_equal(_state_tuple(on[SUPERSPREADER_MODEL]),
+                             _state_tuple(off[SUPERSPREADER_MODEL]))
+
+    def test_paused_stops_cohort_refresh(self):
+        models = {SUPERSPREADER_MODEL: superspreader_model()}
+        p = HostGroupPipeline(models, audit="full")
+        p.spread_audit.paused = True
+        p.update(_zipf_batch())
+        assert not p.spread_audit._fams[SUPERSPREADER_MODEL].elems
+
+
+# ---- entropy companion -----------------------------------------------------
+
+
+class TestEntropy:
+    def test_uniform_is_one_collapse_is_zero(self):
+        from flow_pipeline_tpu.models.ddos import rate_entropy
+
+        h, active = rate_entropy(np.full(64, 10.0))
+        assert active == 64 and np.isclose(h, 1.0)
+        one = np.zeros(64)
+        one[3] = 100.0
+        h1, a1 = rate_entropy(one)
+        assert a1 == 1 and h1 == 0.0
+        h0, a0 = rate_entropy(np.zeros(64))
+        assert a0 == 0 and h0 == 0.0
+
+    def test_normalizes_by_full_bucket_count(self):
+        """ln(M), not ln(active): a flood aimed at two dsts spreads
+        evenly across two buckets — ln(active) would score that a
+        perfect 1.0 instead of the collapse it is."""
+        from flow_pipeline_tpu.models.ddos import rate_entropy
+
+        two = np.zeros(64)
+        two[1] = two[9] = 5.0
+        h, active = rate_entropy(two)
+        assert active == 2
+        assert np.isclose(h, np.log(2) / np.log(64))

@@ -241,6 +241,9 @@ def _thread_work(native, tid: int, iters: int, batch, data: bytes,
             #    groupby kernels (big batches cross their serial gates)
             if native.lanes_available():
                 _lanes_work(native, rng, it)
+            # 10) r21 flowspread: the distinct-count register scatter-max
+            if native.spread_available():
+                _spread_work(native, rng, it)
     except Exception as e:  # noqa: BLE001 — collected for the exit code
         errors.append(f"thread {tid}: {type(e).__name__}: {e}")
 
@@ -646,6 +649,51 @@ def _lanes_work(native, rng, it: int) -> None:
     try:
         native.build_planes_f32([addr])
         raise AssertionError("2-D value column accepted")
+    except ValueError:
+        pass
+
+
+def _spread_work(native, rng, it: int) -> None:
+    """One r21 flowspread stress round on thread-private registers.
+
+    Oracles: numpy-twin equality (np_spread_update is the reference the
+    kernel ships against) and thread-count determinism — u8 max is
+    order-free, so any divergence across {1,2,8} internal threads is a
+    race. Saturated planes, valid masks and degenerate shapes ride
+    every round; nested threading under the sanitizer is the point."""
+    import numpy as np
+
+    from flow_pipeline_tpu.hostsketch.engine import np_spread_update
+
+    n = int(rng.integers(1, 3000))
+    kw = int(rng.choice([1, 4]))
+    keys = rng.integers(0, 1 << 12, size=(n, kw), dtype=np.uint32)
+    elems = rng.integers(0, 1 << 20, size=(n, 1), dtype=np.uint32)
+    d, w, m = 2, 128, int(rng.choice([16, 64]))
+    ref = np.zeros((d, w, m), np.uint8)
+    np_spread_update(ref, keys, elems)
+    outs = []
+    for threads in (1, 2, 8):
+        regs = np.zeros((d, w, m), np.uint8)
+        stats = native.new_stats()
+        native.hs_spread_update(regs, keys, elems, threads, stats=stats)
+        assert (stats >= 0).all(), "negative spread stats slot"
+        outs.append(regs)
+    for got in outs:
+        assert np.array_equal(ref, got), "hs_spread_update twin drift"
+    # saturation: pre-full planes absorb any further scatter
+    full = np.full((d, w, m), 255, np.uint8)
+    native.hs_spread_update(full, keys, elems, 8)
+    assert (full == 255).all(), "u8 saturation violated"
+    # valid mask: masked-off rows must not touch the registers
+    valid = np.zeros(n, np.uint8)
+    regs = np.zeros((d, w, m), np.uint8)
+    native.hs_spread_update(regs, keys, elems, 2, valid=valid)
+    assert not regs.any(), "masked rows wrote registers"
+    # degenerate shapes rejected before any write
+    try:
+        native.hs_spread_update(np.zeros((d, 0, m), np.uint8), keys, elems, 1)
+        raise AssertionError("zero-width register plane accepted")
     except ValueError:
         pass
 
