@@ -3,7 +3,8 @@
 # The reference drives protoc through make (ref: Makefile:1-4); here make
 # additionally builds the native host-path library and runs the suite.
 
-.PHONY: all native test bench proto clean services-test lint native-san \
+.PHONY: all native test bench proto clean services-test lint \
+	lint-mutation native-san \
 	hostsketch-parity fused-parity fused-parity-traced mesh-parity \
 	mesh-parity-traced serve-load audit-parity invertible-parity \
 	chaos-parity gateway-parity guard-parity spread-parity
@@ -24,11 +25,19 @@ bench:
 
 # Static analysis (tools/flowlint): jit-purity, uint64 dtype-flow, lock
 # annotations, lock-order cycles, flag registry, ctypes<->C ABI
-# contract. Dependency-free (stdlib ast + a tiny C declaration parser);
-# exits nonzero on any finding. docs/STATIC_ANALYSIS.md has the rules;
-# `python -m tools.flowlint --json` for machine-readable output.
+# contract, sketch-family citizenship. Dependency-free (stdlib ast + a
+# tiny C declaration parser); exits nonzero on any finding.
+# docs/STATIC_ANALYSIS.md has the rules; `python -m tools.flowlint
+# --json` for machine-readable output.
 lint:
 	python -m tools.flowlint
+
+# Seeded-mutation smoke for the lint gate itself: delete one family
+# registration surface from a scratch copy of the tree and require the
+# family-citizenship rule to fail naming exactly that surface — a lint
+# that cannot fail is indistinguishable from no lint.
+lint-mutation:
+	python -m tools.flowlint.mutation_smoke
 
 # Sanitizer builds + the 8-thread adversarial stress driver, both
 # ASan+UBSan and TSan (the correctness backstop for the native kernel

@@ -301,7 +301,7 @@ class HostGroupPipeline(FusedPipeline):
         self._pool = None if shards == 1 else (pool or shared_pool())
         # flowspread fold knobs: the staged pipeline folds the register
         # scatter single-threaded on the worker thread with no stats
-        # buffer; HostSketchPipeline._init_spread raises the thread
+        # buffer; HostSketchPipeline._init_family_folds raises the thread
         # count to its engine's and attaches a flowtrace buffer (the
         # native kernel's per-depth ownership keeps ANY count bit-exact).
         self._spread_threads = 1

@@ -15,6 +15,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
 
+from ..families import registry
 from ..guard import GuardConfig, GuardController
 from ..models.ddos import DDoSDetector
 from ..models.heavy_hitter import HHState
@@ -457,15 +458,13 @@ class StreamWorker:
             if prep is None and guard.sample_shift > 0:
                 # serial path (no group thread): admit here instead
                 batch, _ = guard.admit(batch)
-            # level >= 1 drops optional work FIRST: the audit cohort
-            # stops refreshing and the trace ring stops recording
-            # before any data does
-            aud = getattr(self.fused, "audit", None)
-            if aud is not None:
-                aud.paused = guard.drop_optional
-            saud = getattr(self.fused, "spread_audit", None)
-            if saud is not None:
-                saud.paused = guard.drop_optional
+            # level >= 1 drops optional work FIRST: every registered
+            # family's audit cohort stops refreshing and the trace ring
+            # stops recording before any data does
+            for _kind, attr in registry.audit_attrs():
+                shadow = getattr(self.fused, attr, None)
+                if shadow is not None:
+                    shadow.paused = guard.drop_optional
             TRACER.paused = guard.drop_optional
         if self.config.archive_raw:
             archived = False
@@ -761,38 +760,16 @@ class StreamWorker:
         self.sync_sketch_states()
         models_state: dict[str, Any] = {}
         for name, model in self.models.items():
-            if isinstance(model, WindowAggregator):
-                model._drain()  # fold pending device partials first: the
-                # snapshot must cover everything the committed offsets cover
-                models_state[name] = {
-                    "kind": "window_agg",
-                    "windows": model.windows,
-                    "watermark": model.watermark,
-                }
-            elif isinstance(model, WindowedHeavyHitter):
+            fam = _model_family(model)
+            if fam is not None:
                 # backing models declare their checkpoint tag explicitly
                 # (duck-typing on attribute names mis-dispatches the day
-                # a model grows an attribute another kind uses)
-                kind = model.model.snapshot_kind
-                if kind == "windowed_hh":
-                    models_state[name] = {
-                        "kind": kind,
-                        "hh": model.model.state,
-                        "current_slot": model.current_slot,
-                    }
-                elif kind == "windowed_spread":  # models.spread
-                    models_state[name] = {
-                        "kind": kind,
-                        "spread": model.model.state,
-                        "current_slot": model.current_slot,
-                    }
-                else:  # "windowed_dense" (models.dense_top)
-                    models_state[name] = {
-                        "kind": kind,
-                        "totals": model.model.totals,
-                        "current_slot": model.current_slot,
-                    }
+                # a model grows an attribute another kind uses); the
+                # family registry owns the per-kind save hook
+                models_state[name] = registry.hook(
+                    fam, "checkpoint_save")(model)
             elif isinstance(model, DDoSDetector):
+                # detector, not a mergeable family (NON_FAMILY_KINDS)
                 models_state[name] = {
                     "kind": "ddos",
                     "state": model.state,
@@ -807,7 +784,13 @@ class StreamWorker:
         }
 
     def restore(self, path: Optional[str] = None) -> bool:
-        """Rehydrate from the checkpoint; returns False if none exists."""
+        """Rehydrate from the checkpoint; returns False if none exists.
+
+        Per-kind state rehydration is the family registry's
+        checkpoint_restore hook, dispatched on the checkpoint's own kind
+        tag; unknown tags are skipped silently (exactly the pre-registry
+        fall-through), kind/model mismatches skip loudly inside the
+        hooks."""
         import jax.numpy as jnp
 
         from .checkpoint import checkpoint_exists
@@ -828,97 +811,9 @@ class StreamWorker:
                 log.warning("checkpoint has state for unconfigured model "
                             "%r; skipping", name)
                 continue
-            if ms["kind"] == "window_agg":
-                windows = {
-                    int(slot): {k: v for k, v in store.items()}
-                    for slot, store in ms["windows"].items()
-                }
-                want = model.store_key_lanes
-                bad = next((k for store in windows.values()
-                            for k in store if len(k) != want), None)
-                if bad is not None:
-                    # a checkpoint from a different grouping layout (e.g.
-                    # pre-sampling builds without the rate lane): restoring
-                    # it would mis-split key tuples at flush and emit
-                    # garbage keys — skip loudly; open windows start over
-                    log.warning(
-                        "checkpoint window keys have %d lanes, model "
-                        "%r expects %d; skipping its window state",
-                        len(bad), name, want)
-                else:
-                    model.windows = windows
-                model.watermark = ms["watermark"]
-            elif ms["kind"] in ("windowed_hh", "windowed_dense",
-                                "windowed_spread"):
-                want = getattr(model.model, "snapshot_kind", None)
-                if want != ms["kind"]:
-                    # e.g. a checkpoint from a build whose port models were
-                    # sketch-backed restored into a dense-backed one:
-                    # restoring the wrong state shape would silently lose
-                    # the open window (and corrupt future snapshots); skip
-                    # loudly instead — that window's sketch starts over
-                    log.warning(
-                        "checkpoint kind %r does not match model %r "
-                        "backing (%r); skipping its state",
-                        ms["kind"], name, want,
-                    )
-                    continue
-                if ms["kind"] == "windowed_hh":
-                    hh = ms["hh"]  # NamedTuple decoded as field dict
-                    inv_cfg = getattr(model.model.config, "hh_sketch",
-                                      "table") == "invertible"
-                    if ("keysum" in hh) != inv_cfg:
-                        # a table-family checkpoint restored into an
-                        # invertible-config model (or vice versa): the
-                        # state layouts do not convert — skip loudly,
-                        # that window's sketch starts over (the same
-                        # discipline as the kind-mismatch skip above)
-                        log.warning(
-                            "checkpoint hh state for model %r is %s "
-                            "but the model runs hh_sketch=%s; skipping "
-                            "its state", name,
-                            "invertible" if "keysum" in hh else "table",
-                            model.model.config.hh_sketch)
-                        continue
-                    if inv_cfg:
-                        import numpy as np
-
-                        from ..models.heavy_hitter import InvState
-
-                        # numpy, NOT jnp: without x64 a jnp.asarray
-                        # would silently downcast the exact u64 planes
-                        model.model.state = InvState(
-                            cms=np.asarray(hh["cms"], dtype=np.uint64),
-                            keysum=np.asarray(hh["keysum"],
-                                              dtype=np.uint64),
-                            keycheck=np.asarray(hh["keycheck"],
-                                                dtype=np.uint64),
-                        )
-                    else:
-                        model.model.state = HHState(
-                            cms=jnp.asarray(hh["cms"]),
-                            table_keys=jnp.asarray(hh["table_keys"]),
-                            table_vals=jnp.asarray(hh["table_vals"]),
-                        )
-                elif ms["kind"] == "windowed_spread":
-                    import numpy as np
-
-                    from ..models.spread import SpreadState
-
-                    # numpy, NOT jnp: spread state is host-resident by
-                    # design (u8 registers + u32 table keys — the exact
-                    # max monoid IS the canonical form)
-                    sp = ms["spread"]  # NamedTuple decoded as field dict
-                    model.model.state = SpreadState(
-                        regs=np.asarray(sp["regs"], dtype=np.uint8),
-                        table_keys=np.asarray(sp["table_keys"],
-                                              dtype=np.uint32),
-                        table_metric=np.asarray(sp["table_metric"],
-                                                dtype=np.float32),
-                    )
-                else:
-                    model.model.totals = jnp.asarray(ms["totals"])
-                model.current_slot = ms["current_slot"]
+            fam = registry.family_for_checkpoint(ms["kind"])
+            if fam is not None:
+                registry.hook(fam, "checkpoint_restore")(model, ms, name)
             elif ms["kind"] == "ddos":
                 st = ms["state"]
                 from ..models.ddos import DDoSState
@@ -933,3 +828,162 @@ class StreamWorker:
             if hasattr(self.consumer, "positions"):
                 self.consumer.positions[p] = off
         return True
+
+
+# ---- per-family checkpoint hooks (families/registry.py) -------------------
+#
+# save_*(model) -> the model's checkpoint state dict (including its
+# "kind" tag); restore_*(model, ms, name) rehydrates one model from a
+# decoded checkpoint entry, skipping LOUDLY on any shape/kind mismatch
+# (that window's state starts over — never restore the wrong layout).
+
+
+def _model_family(model):
+    """Registered family owning one live model object, else None (DDoS
+    detectors and unknown backings checkpoint outside the registry)."""
+    if isinstance(model, WindowAggregator):
+        return registry.family("wagg")
+    if isinstance(model, WindowedHeavyHitter):
+        return registry.family_for_snapshot(model.model.snapshot_kind)
+    return None
+
+
+def _kind_matches(model, ms: dict, name: str) -> bool:
+    """The checkpoint's kind tag must match the live model's backing.
+    e.g. a checkpoint from a build whose port models were sketch-backed
+    restored into a dense-backed one: restoring the wrong state shape
+    would silently lose the open window (and corrupt future snapshots);
+    skip loudly instead — that window's sketch starts over."""
+    want = getattr(getattr(model, "model", None), "snapshot_kind", None)
+    if want != ms["kind"]:
+        log.warning(
+            "checkpoint kind %r does not match model %r backing (%r); "
+            "skipping its state", ms["kind"], name, want)
+        return False
+    return True
+
+
+def save_wagg_state(model) -> dict:
+    model._drain()  # fold pending device partials first: the snapshot
+    # must cover everything the committed offsets cover
+    return {
+        "kind": "window_agg",
+        "windows": model.windows,
+        "watermark": model.watermark,
+    }
+
+
+def restore_wagg_state(model, ms: dict, name: str) -> None:
+    windows = {
+        int(slot): {k: v for k, v in store.items()}
+        for slot, store in ms["windows"].items()
+    }
+    want = model.store_key_lanes
+    bad = next((k for store in windows.values()
+                for k in store if len(k) != want), None)
+    if bad is not None:
+        # a checkpoint from a different grouping layout (e.g.
+        # pre-sampling builds without the rate lane): restoring
+        # it would mis-split key tuples at flush and emit
+        # garbage keys — skip loudly; open windows start over
+        log.warning(
+            "checkpoint window keys have %d lanes, model "
+            "%r expects %d; skipping its window state",
+            len(bad), name, want)
+    else:
+        model.windows = windows
+    model.watermark = ms["watermark"]
+
+
+def save_hh_state(model) -> dict:
+    return {
+        "kind": "windowed_hh",
+        "hh": model.model.state,
+        "current_slot": model.current_slot,
+    }
+
+
+def restore_hh_state(model, ms: dict, name: str) -> None:
+    if not _kind_matches(model, ms, name):
+        return
+    hh = ms["hh"]  # NamedTuple decoded as field dict
+    inv_cfg = getattr(model.model.config, "hh_sketch",
+                      "table") == "invertible"
+    if ("keysum" in hh) != inv_cfg:
+        # a table-family checkpoint restored into an
+        # invertible-config model (or vice versa): the
+        # state layouts do not convert — skip loudly,
+        # that window's sketch starts over (the same
+        # discipline as the kind-mismatch skip above)
+        log.warning(
+            "checkpoint hh state for model %r is %s "
+            "but the model runs hh_sketch=%s; skipping "
+            "its state", name,
+            "invertible" if "keysum" in hh else "table",
+            model.model.config.hh_sketch)
+        return
+    if inv_cfg:
+        import numpy as np
+
+        from ..models.heavy_hitter import InvState
+
+        # numpy, NOT jnp: without x64 a jnp.asarray
+        # would silently downcast the exact u64 planes
+        model.model.state = InvState(
+            cms=np.asarray(hh["cms"], dtype=np.uint64),
+            keysum=np.asarray(hh["keysum"], dtype=np.uint64),
+            keycheck=np.asarray(hh["keycheck"], dtype=np.uint64),
+        )
+    else:
+        import jax.numpy as jnp
+
+        model.model.state = HHState(
+            cms=jnp.asarray(hh["cms"]),
+            table_keys=jnp.asarray(hh["table_keys"]),
+            table_vals=jnp.asarray(hh["table_vals"]),
+        )
+    model.current_slot = ms["current_slot"]
+
+
+def save_spread_state(model) -> dict:
+    return {
+        "kind": "windowed_spread",
+        "spread": model.model.state,
+        "current_slot": model.current_slot,
+    }
+
+
+def restore_spread_state(model, ms: dict, name: str) -> None:
+    if not _kind_matches(model, ms, name):
+        return
+    import numpy as np
+
+    from ..models.spread import SpreadState
+
+    # numpy, NOT jnp: spread state is host-resident by
+    # design (u8 registers + u32 table keys — the exact
+    # max monoid IS the canonical form)
+    sp = ms["spread"]  # NamedTuple decoded as field dict
+    model.model.state = SpreadState(
+        regs=np.asarray(sp["regs"], dtype=np.uint8),
+        table_keys=np.asarray(sp["table_keys"], dtype=np.uint32),
+        table_metric=np.asarray(sp["table_metric"], dtype=np.float32),
+    )
+    model.current_slot = ms["current_slot"]
+
+
+def save_dense_state(model) -> dict:
+    return {
+        "kind": "windowed_dense",
+        "totals": model.model.totals,
+        "current_slot": model.current_slot,
+    }
+
+
+def restore_dense_state(model, ms: dict, name: str) -> None:
+    if not _kind_matches(model, ms, name):
+        return
+    import jax.numpy as jnp
+
+    model.model.totals = jnp.asarray(ms["totals"])
+    model.current_slot = ms["current_slot"]

@@ -1,0 +1,16 @@
+"""flowcensus: the SketchFamily registry (see registry.py)."""
+
+from .registry import (  # noqa: F401
+    FAMILIES,
+    NON_FAMILY_KINDS,
+    SketchFamily,
+    audit_attrs,
+    delta_planes,
+    families,
+    family,
+    family_for_checkpoint,
+    family_for_payload,
+    family_for_snapshot,
+    hook,
+    resolve,
+)

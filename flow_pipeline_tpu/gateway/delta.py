@@ -44,6 +44,7 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from ..families import registry
 from ..mesh import codec
 from ..serve.snapshot import FamilyView, FrozenCms, Snapshot
 
@@ -154,6 +155,21 @@ def _rows_equal(a: dict, b: dict) -> bool:
     return all(_arrays_equal(a[c], b[c]) for c in a)
 
 
+# Every plane layout the canonical state schema can carry: (state key,
+# planes-first?). Registered families narrow this via delta_planes.
+_ALL_PLANES = (("cms", False), ("regs", True))
+
+
+def _plane_specs(kind: str) -> tuple:
+    specs = registry.delta_planes(kind)
+    if specs or registry.family_for_payload(kind) is not None:
+        return specs
+    # unregistered kind: diff every known plane layout — the gateway
+    # must never guess a narrower schema for a family this build does
+    # not know about
+    return _ALL_PLANES
+
+
 def _cms_diff(prev: np.ndarray,
               cur: np.ndarray) -> Optional[tuple[list, list]]:
     """Per-depth-row dirty coding: (sparse, tiles), or None when the
@@ -188,7 +204,14 @@ def diff_states(prev: dict, cur: dict) -> dict:
     dicts). The family and range-table maps in the delta are COMPLETE
     (their scalar metadata is tiny and carrying the full key set lets
     apply drop removed entries without a tombstone protocol); the
-    arrays inside ship only where they changed."""
+    arrays inside ship only where they changed.
+
+    Which plane arrays a family diffs — and whether a plane is viewed
+    planes-first (spread's registers-last ``[D, W, m]`` becomes ``[m,
+    D, W]``: a bucket's m registers dirty together the way a CMS
+    bucket's planes do) — comes from the family registry's
+    ``delta_planes`` spec; unregistered kinds diff every known plane
+    layout (never guess a narrower schema)."""
     families = {}
     for name, f in cur["families"].items():
         pf = prev["families"].get(name)
@@ -199,42 +222,27 @@ def diff_states(prev: dict, cur: dict) -> dict:
         }
         if pf is None or not _rows_equal(pf["rows"], f["rows"]):
             entry["rows"] = f["rows"]
-        if f["cms"] is None:
-            if pf is None or pf["cms"] is not None:
-                entry["cms"] = None
-        elif pf is None or pf["cms"] is None:
-            entry["cms"] = f["cms"]
-        else:
-            diff = _cms_diff(pf["cms"], f["cms"])
-            if diff is None:
-                entry["cms"] = f["cms"]
+        for key, planes_first in _plane_specs(f["kind"]):
+            val = f.get(key)
+            pval = None if pf is None else pf.get(key)
+            if val is None:
+                if pf is None or pval is not None:
+                    entry[key] = None
+            elif pval is None:
+                entry[key] = val
             else:
-                sparse, tiles = diff
-                if sparse:
-                    entry["cms_sparse"] = sparse
-                if tiles:
-                    entry["cms_tiles"] = tiles
-                # neither: apply carries pf["cms"] forward untouched
-        # spread registers: the same dirty-column coding over the
-        # planes-first [m, D, W] view (byte equality on u8)
-        regs = f.get("regs")
-        pregs = None if pf is None else pf.get("regs")
-        if regs is None:
-            if pf is None or pregs is not None:
-                entry["regs"] = None
-        elif pregs is None:
-            entry["regs"] = regs
-        else:
-            diff = _cms_diff(np.moveaxis(pregs, 2, 0),
-                             np.moveaxis(regs, 2, 0))
-            if diff is None:
-                entry["regs"] = regs
-            else:
-                sparse, tiles = diff
-                if sparse:
-                    entry["regs_sparse"] = sparse
-                if tiles:
-                    entry["regs_tiles"] = tiles
+                diff = _cms_diff(
+                    np.moveaxis(pval, 2, 0) if planes_first else pval,
+                    np.moveaxis(val, 2, 0) if planes_first else val)
+                if diff is None:
+                    entry[key] = val
+                else:
+                    sparse, tiles = diff
+                    if sparse:
+                        entry[f"{key}_sparse"] = sparse
+                    if tiles:
+                        entry[f"{key}_tiles"] = tiles
+                    # neither: apply carries the base plane forward
         families[name] = entry
     ranges = {}
     for table, slots in cur["ranges"].items():
@@ -276,46 +284,42 @@ def apply_delta(prev: dict, delta: dict) -> dict:
                 raise DeltaError(
                     f"delta introduces family {name!r} without rows")
             rows = pf["rows"]
-        if "cms" in entry:
-            cms = entry["cms"]
-        elif "cms_tiles" in entry or "cms_sparse" in entry:
-            if pf is None or pf["cms"] is None:
-                raise DeltaError(
-                    f"delta patches CMS planes for {name!r} with no "
-                    "base planes")
-            cms = pf["cms"].copy()
-            for d, w0, block in entry.get("cms_tiles", ()):
-                d, w0 = int(d), int(w0)
-                cms[:, d, w0:w0 + block.shape[-1]] = block
-            for d, cols, vals in entry.get("cms_sparse", ()):
-                cms[:, int(d), np.asarray(cols, np.int64)] = vals
-        else:
-            cms = None if pf is None else pf["cms"]
-        if "regs" in entry:
-            regs = entry["regs"]
-        elif "regs_tiles" in entry or "regs_sparse" in entry:
-            base = None if pf is None else pf.get("regs")
-            if base is None:
-                raise DeltaError(
-                    f"delta patches spread registers for {name!r} with "
-                    "no base planes")
-            regs = base.copy()
-            # patch through the planes-first view — the same words,
-            # addressed the way _cms_diff coded them
-            view = np.moveaxis(regs, 2, 0)
-            for d, w0, block in entry.get("regs_tiles", ()):
-                d, w0 = int(d), int(w0)
-                view[:, d, w0:w0 + block.shape[-1]] = block
-            for d, cols, vals in entry.get("regs_sparse", ()):
-                view[:, int(d), np.asarray(cols, np.int64)] = vals
-        else:
-            regs = None if pf is None else pf.get("regs")
+        spec_keys = {k for k, _ in _plane_specs(entry["kind"])}
+        planes = {}
+        for key, planes_first in _ALL_PLANES:
+            if key in entry:
+                planes[key] = entry[key]
+            elif f"{key}_tiles" in entry or f"{key}_sparse" in entry:
+                base = None if pf is None else pf.get(key)
+                if base is None:
+                    raise DeltaError(
+                        f"delta patches {key} planes for {name!r} with "
+                        "no base planes")
+                arr = base.copy()
+                # patch through the planes-first view where the family
+                # codes that way — the same words, addressed the way
+                # _cms_diff coded them
+                view = np.moveaxis(arr, 2, 0) if planes_first else arr
+                for d, w0, block in entry.get(f"{key}_tiles", ()):
+                    d, w0 = int(d), int(w0)
+                    view[:, d, w0:w0 + block.shape[-1]] = block
+                for d, cols, vals in entry.get(f"{key}_sparse", ()):
+                    view[:, int(d), np.asarray(cols, np.int64)] = vals
+                planes[key] = arr
+            elif key in spec_keys:
+                # unshipped + undiffed but diffable for this kind:
+                # carried forward BY REFERENCE (states are immutable)
+                planes[key] = None if pf is None else pf.get(key)
+            else:
+                # a plane this kind never carries (or a kind change):
+                # never inherit another layout's words
+                planes[key] = None
         families[name] = {
             "kind": entry["kind"], "window_start": entry["window_start"],
             "depth": int(entry["depth"]),
             "key_lanes": int(entry["key_lanes"]),
             "value_cols": list(entry["value_cols"]),
-            "rows": rows, "cms": cms, "regs": regs,
+            "rows": rows, "cms": planes["cms"], "regs": planes["regs"],
         }
     ranges = {}
     for table, spec in delta["ranges"].items():

@@ -40,6 +40,7 @@ from ..engine.hostfused import (
     report_native_degradation,
 )
 from .state import HostInvState
+from ..families import registry
 from ..ingest.shard import ShardPool
 from ..obs import REGISTRY, get_logger
 from .engine import HostSketchEngine, sketch_backend_available
@@ -70,6 +71,25 @@ ROWS_COUNTER = ("host_fused_rows_total",
                 "rows through the fused native dataplane")
 GROUPS_COUNTER = ("host_fused_groups_total",
                   "groups produced by the fused native dataplane")
+
+# registry native-probe feature -> flow_pipeline_tpu.native gate; the
+# families own the (feature, symbol, revision) facts, this module owns
+# how a probe is answered on this box
+_PROBE_AVAIL = {
+    "fused": "fused_available",
+    "invsketch": "inv_available",
+    "spread": "spread_available",
+}
+
+
+def _probe_reason(kind: str, feature: str) -> str:
+    """Degradation reason for a registered family's native probe: the
+    family descriptor owns the (symbol, revision) pair, so a new probe
+    never hand-copies them into this module again."""
+    for feat, symbol, rev in registry.family(kind).native_probes:
+        if feat == feature:
+            return _degradation_reason(symbol, rev)
+    raise KeyError(f"family {kind!r} has no native probe {feature!r}")
 
 
 def _publish_stats(stage: str, stats) -> None:
@@ -117,11 +137,9 @@ class HostSketchPipeline(HostGroupPipeline):
         self._engine = HostSketchEngine(
             [w.config for _, w in self._hh], use_native=sketch_native,
             threads=threads)
-        if not self._engine.native and sketch_native != "numpy":
-            report_native_degradation(
-                "sketch", _degradation_reason("hs_cms_update", "r8"))
-        elif self._engine.native:
-            mark_native_serving("sketch")
+        self._native_ladder("sketch", self._engine.native,
+                            _degradation_reason("hs_cms_update", "r8"),
+                            sketch_native)
         # The jitted rest-step covers what the engine does not: dense
         # port scatters + the DDoS accumulate. Same module-level cache
         # as the full apply, keyed with no hh families.
@@ -150,7 +168,7 @@ class HostSketchPipeline(HostGroupPipeline):
         self._apply_stats = None
         # flowlint: unguarded -- group thread only (prepare half)
         self._group_stats = None
-        # flowspread fold knobs, resolved by _init_spread below
+        # flowspread fold knobs, resolved by _init_family_folds below
         # flowlint: unguarded -- set during construction, read on the worker thread only (fold half)
         self._spread_threads = 1
         # flowlint: unguarded -- built during construction; zeroed/accumulated on the worker thread only
@@ -167,38 +185,57 @@ class HostSketchPipeline(HostGroupPipeline):
         if _native.available():
             self._apply_stats = _native.new_stats()
             self._group_stats = _native.new_stats()
-        if self._engine.native and _native.lanes_available():
-            self._native_lanes = True
-            mark_native_serving("lanes")
-        elif self._engine.native and sketch_native != "numpy":
-            report_native_degradation(
-                "lanes", _degradation_reason("ff_build_lanes", "r19"))
+        if self._engine.native:
+            self._native_lanes = self._native_ladder(
+                "lanes", _native.lanes_available(),
+                _degradation_reason("ff_build_lanes", "r19"),
+                sketch_native)
         self._init_fused(fused, sketch_native)
-        self._init_spread(sketch_native)
+        self._init_family_folds(sketch_native)
 
-    # ---- flowspread fold (r21) ---------------------------------------------
+    # ---- per-family fold knobs ---------------------------------------------
 
-    def _init_spread(self, sketch_native: str) -> None:
-        """Resolve the spread register fold's backend knobs. The fold
-        itself is inherited (HostGroupPipeline._fold_spread →
-        hostsketch.engine.spread_apply_update, which prefers the native
-        hs_spread_update kernel); this pipeline's job is the ladder
-        discipline — a stale .so quietly serving the numpy twin under a
-        native flag must be LOUD, like every other feature."""
+    # families whose fold runs standalone on the host (outside the
+    # fused/staged hh plan) and therefore owns a threads + stats pair
+    _FOLD_FAMILIES = ("spread",)
+
+    def _native_ladder(self, feature: str, available: bool,
+                       reason: str, sketch_native: str) -> bool:
+        """One rung of the loud-degradation ladder every native feature
+        shares: serving marks the gauge, a stale .so under a native
+        flag reports the degradation (the explicit numpy opt-out stays
+        silent). Returns whether the feature serves natively."""
+        if available:
+            mark_native_serving(feature)
+            return True
+        if sketch_native != "numpy":
+            report_native_degradation(feature, reason)
+        return False
+
+    def _init_family_folds(self, sketch_native: str) -> None:
+        """Resolve every standalone family fold's backend knobs from
+        the registry's native probes. Each fold (today: spread, whose
+        inherited _fold_spread prefers the native hs_spread_update
+        kernel) gets the same triple _init_fused hand-rolls for the
+        fused pass — a thread count, a dedicated flowtrace stats
+        buffer, and the ladder discipline: a stale .so quietly serving
+        the numpy twin under a native flag must be LOUD, like every
+        other feature."""
         from .. import native
 
-        self._spread_threads = self._engine.threads
-        if not self._spread:
-            return
-        if native.spread_available():
-            mark_native_serving("spread")
-            # flowtrace buffer for the kernel's FF_STAT_SPREAD_NS slot —
-            # its own buffer (worker thread), not _apply_stats: the
-            # staged engine zeroes that one per hh chunk
-            self._spread_stats = native.new_stats()
-        elif sketch_native != "numpy":
-            report_native_degradation(
-                "spread", _degradation_reason("hs_spread_update", "r21"))
+        for kind in self._FOLD_FAMILIES:
+            setattr(self, f"_{kind}_threads", self._engine.threads)
+            if not getattr(self, f"_{kind}"):
+                continue
+            for feature, symbol, rev in registry.family(kind).native_probes:
+                avail = getattr(native, _PROBE_AVAIL[feature])()
+                if self._native_ladder(
+                        feature, avail, _degradation_reason(symbol, rev),
+                        sketch_native):
+                    # flowtrace buffer for the kernel's stats slot — its
+                    # own buffer (worker thread), not _apply_stats: the
+                    # staged engine zeroes that one per hh chunk
+                    setattr(self, f"_{kind}_stats", native.new_stats())
 
     def _fold_spread(self, ch: PreparedChunk) -> None:
         stats = self._spread_stats
@@ -287,28 +324,25 @@ class HostSketchPipeline(HostGroupPipeline):
                 "ingest.fused=on but the fused native dataplane cannot "
                 "serve: " + ("the sketch engine is not native"
                              if not self._engine.native else
-                             _degradation_reason("ff_fused_update", "r10")
+                             _probe_reason("hh", "fused")
                              if not native.fused_available() else
-                             _degradation_reason("hs_inv_update", "r16")))
+                             _probe_reason("hh", "invsketch")))
         self._fused = fused != "off" and can
         if any_inv and self._engine.native:
             # the staged engine ALSO routes invertible families through
             # hs_inv_update: a stale .so quietly serving the numpy twin
             # under a native flag must be loud (gauge + warning), and
             # the healthy 0 published explicitly like every feature
-            if native.inv_available():
-                mark_native_serving("invsketch")
-            elif sketch_native != "numpy":
-                report_native_degradation(
-                    "invsketch",
-                    _degradation_reason("hs_inv_update", "r16"))
+            self._native_ladder("invsketch", native.inv_available(),
+                                _probe_reason("hh", "invsketch"),
+                                sketch_native)
         if fused == "auto" and not can and sketch_native != "numpy":
             # production default wanted the fused plane: degrading to the
             # staged path must be loud (same contract as native_group)
             report_native_degradation(
-                "fused", _degradation_reason("ff_fused_update", "r10")
+                "fused", _probe_reason("hh", "fused")
                 if not native.fused_available()
-                else _degradation_reason("hs_inv_update", "r16")
+                else _probe_reason("hh", "invsketch")
                 if any_inv and not native.inv_available()
                 else "sketch engine is not native")
         elif self._fused:

@@ -24,6 +24,7 @@ import json
 import numpy as np
 
 from ..engine.checkpoint import _decode, _encode
+from ..families import registry
 from ..hostsketch.state import (HostHHState, frozen_cms, is_inv_state)
 
 MAGIC = b"FMSH1\n"
@@ -146,12 +147,10 @@ def spread_payload(state) -> dict:
 
 def capture_model(model) -> dict:
     """State payload for one windowed model (the object WindowedHeavyHitter
-    wraps): dispatches on the model's snapshot_kind tag."""
+    wraps): the family registry maps the model's snapshot_kind tag to
+    its payload hook and state attribute."""
     kind = getattr(model, "snapshot_kind", None)
-    if kind == "windowed_hh":
-        return hh_payload(model.state)
-    if kind == "windowed_dense":
-        return dense_payload(model.totals)
-    if kind == "windowed_spread":
-        return spread_payload(model.state)
-    raise TypeError(f"no mesh payload for model kind {kind!r}")
+    fam = registry.family_for_snapshot(kind) if kind else None
+    if fam is None or fam.payload is None or fam.state_attr is None:
+        raise TypeError(f"no mesh payload for model kind {kind!r}")
+    return registry.hook(fam, "payload")(getattr(model, fam.state_attr))

@@ -49,13 +49,13 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
+from ..families import registry
 from ..obs import REGISTRY, get_logger
 from ..obs.audit import (audit_report, publish_report,
                          register_audit_metrics)
 from ..obs.buildinfo import publish_build_info
 from ..obs.trace import TRACER
 from . import codec
-from . import merge as merge_ops
 
 log = get_logger("mesh")
 
@@ -196,9 +196,8 @@ def spec_from_models(models: dict) -> tuple[ModelSpec, ...]:
                 name, "wagg", m.config, 0, m.config.window_seconds,
                 m.config.allowed_lateness))
         elif isinstance(m, WindowedHeavyHitter):
-            snap = m.model.snapshot_kind
-            kind = {"windowed_hh": "hh",
-                    "windowed_spread": "spread"}.get(snap, "dense")
+            fam = registry.family_for_snapshot(m.model.snapshot_kind)
+            kind = fam.kind if fam is not None else "dense"
             out.append(ModelSpec(name, kind, m.config, m.k,
                                  m.window_seconds))
     return tuple(out)
@@ -1050,6 +1049,14 @@ class MeshCoordinator:
                 # as the worker's flush -> snapshot gap
                 self._journal.append("merged", {"model": name,
                                                 "slot": int(slot)})
+                # ... and fsync IMMEDIATELY: deferring this record to
+                # the next member-ack group commit would leave it
+                # sitting in the file buffer while the rows are already
+                # in the sinks — a crash in that (arbitrarily long:
+                # members may be idle) gap re-emits the window on
+                # recovery. One fsync per merged window shrinks the
+                # at-least-once gap back to the sink-write itself.
+                self._journal.sync()
             # only now is the window safe to checkpoint as merged: its
             # rows are in the sinks and (if journaling) its "merged"
             # record is appended. A merge that raises leaves the key
@@ -1188,23 +1195,17 @@ class MeshCoordinator:
         return len(rows)
 
     def _merge_one(self, spec: ModelSpec, slot: int, payloads: list) -> dict:
-        if spec.kind == "wagg":
-            from ..models.window_agg import rows_from_stores
-
-            store = merge_ops.merge_wagg(payloads)
-            return rows_from_stores(spec.config, [(slot, store)])
-        if spec.kind == "hh":
-            merged = merge_ops.merge_hh(payloads, spec.config)
+        # kind-agnostic: the family registry supplies merge + rows hooks
+        # per spec.kind; only the hh sampled-cohort audit (carried inside
+        # the merged payload) needs a side effect here
+        fam = registry.family(spec.kind)
+        merged = registry.hook(fam, "merge")(payloads, spec.config)
+        if isinstance(merged, dict):
             audit = merged.get("audit")
             if audit is not None:
                 self._audit_merged_window(spec, slot, merged, audit)
-            return merge_ops.hh_top_rows(merged, spec.config, spec.k, slot)
-        if spec.kind == "spread":
-            merged = merge_ops.merge_spread(payloads, spec.config)
-            return merge_ops.spread_top_rows(merged, spec.config, spec.k,
-                                             slot)
-        totals = merge_ops.merge_dense(payloads)
-        return merge_ops.dense_top_rows(totals, spec.config, spec.k, slot)
+        return registry.hook(fam, "top_rows")(merged, spec.config,
+                                              spec.k, slot)
 
     def _audit_merged_window(self, spec: ModelSpec, slot: int,
                              merged: dict, audit: dict) -> None:
@@ -1324,15 +1325,10 @@ class MeshCoordinator:
         from ..sink.base import rows_to_records
 
         kk = k or spec.k or spec.config.capacity
-        if spec.kind == "hh":
-            merged = merge_ops.merge_hh(payloads, spec.config)
-            rows = merge_ops.hh_top_rows(merged, spec.config, kk, slot)
-        elif spec.kind == "spread":
-            merged = merge_ops.merge_spread(payloads, spec.config)
-            rows = merge_ops.spread_top_rows(merged, spec.config, kk, slot)
-        else:
-            rows = merge_ops.dense_top_rows(
-                merge_ops.merge_dense(payloads), spec.config, kk, slot)
+        fam = registry.family(spec.kind)
+        merged = registry.hook(fam, "merge")(payloads, spec.config)
+        rows = registry.hook(fam, "top_rows")(merged, spec.config, kk,
+                                              slot)
         return {"model": spec.name, "window_start": slot,
                 "rows": rows_to_records(rows)}
 

@@ -41,9 +41,13 @@ _SENTINEL = np.uint32(0xFFFFFFFF)
 # ---- exact window aggregates ----------------------------------------------
 
 
-def merge_wagg(payloads: list[dict]) -> dict:
+def merge_wagg(payloads: list[dict], config=None) -> dict:
     """Fold wagg payloads (keys [G, L] u32, vals [G, V] u64) into one
-    window-store dict {key tuple -> uint64 vec} — per-key sums, exact."""
+    window-store dict {key tuple -> uint64 vec} — per-key sums, exact.
+
+    ``config`` is unused (the fold is shape-generic) but accepted so
+    every registered family's merge hook shares one signature
+    (families/registry.py)."""
     real = [p for p in payloads if len(p["keys"])]
     if not real:
         return {}
@@ -275,8 +279,10 @@ def spread_top_rows(merged: dict, config, k: int,
 # ---- dense accumulators ---------------------------------------------------
 
 
-def merge_dense(payloads: list[dict]) -> np.ndarray:
-    """Element-wise int64 sum of dense (lo, hi) planes."""
+def merge_dense(payloads: list[dict], config=None) -> np.ndarray:
+    """Element-wise int64 sum of dense (lo, hi) planes. ``config`` is
+    unused (the sum is shape-generic) but accepted so every registered
+    family's merge hook shares one signature (families/registry.py)."""
     out = payloads[0]["totals"].astype(np.int64).copy()
     for p in payloads[1:]:
         out += p["totals"].astype(np.int64)

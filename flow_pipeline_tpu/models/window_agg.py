@@ -442,6 +442,15 @@ class WindowAggregator:
         return rows_from_stores(self.config, self.pop_closed(force))
 
 
+def wagg_rows(store: dict, config: WindowAggConfig, k: int,
+              slot: int) -> dict[str, np.ndarray]:
+    """Emitted rows for ONE merged window store — the wagg family's
+    rows hook (families/registry.py), signature-compatible with the
+    ranked families' ``*_top_rows`` so the coordinator's merge loop is
+    kind-agnostic. ``k`` is unused: wagg emits every exact group."""
+    return rows_from_stores(config, [(slot, store)])
+
+
 def rows_from_stores(config: WindowAggConfig,
                      stores: list[tuple[int, dict]]) -> dict[str, np.ndarray]:
     """Columnar flush rows from popped (slot, store) pairs — the second

@@ -38,6 +38,7 @@ import time
 from typing import Optional
 
 from ..engine.windowed import WindowedHeavyHitter
+from ..families import registry
 from ..models.heavy_hitter import key_width
 from ..models.window_agg import WindowAggregator
 from ..obs import get_logger
@@ -46,45 +47,96 @@ from .snapshot import FamilyView, RangeLedger, Snapshot, SnapshotStore
 log = get_logger("serve")
 
 
+# ---- per-family capture hooks (families/registry.py serve_capture) --------
+#
+# Worker side: (cms, key_lanes, regs) view parts for one live windowed
+# model. Mesh side: (rows, cms, key_lanes, regs) for one merged spec, or
+# None when no contribution exists yet. Registered by name in the
+# SketchFamily descriptors so both publishers dispatch by iterating the
+# registry instead of per-kind elif ladders.
+
+
+def hh_view_parts(m: WindowedHeavyHitter):
+    import numpy as np
+
+    from ..hostsketch.state import frozen_cms
+    from .snapshot import FrozenCms
+
+    planes = m.model.state.cms
+    if not isinstance(planes, np.ndarray):
+        # device-backend jax array: hh_update DONATES its state arg,
+        # so the next batch deletes these buffers on TPU/GPU — the
+        # host copy must happen NOW, at publish. (Host-exported
+        # states are already fresh numpy and safe to hold: they are
+        # replaced, never mutated.) The expensive f32->u64 freeze
+        # stays lazy either way — first estimate reader pays it.
+        planes = np.asarray(planes)
+    return FrozenCms(lambda a=planes: frozen_cms(a)), key_width(m.config), \
+        None
+
+
+def spread_view_parts(m: WindowedHeavyHitter):
+    from ..models.spread import spread_key_width
+
+    # the update path mutates registers in place — the snapshot
+    # must freeze its own copy (the immutability contract)
+    return None, spread_key_width(m.config), m.model.state.regs.copy()
+
+
+def dense_view_parts(m: WindowedHeavyHitter):
+    return None, 1, None
+
+
+def hh_merged_view(spec, slot, payloads):
+    from ..mesh import merge as merge_ops
+    from .snapshot import FrozenCms
+
+    depth = spec.k or spec.config.capacity
+    merged = merge_ops.merge_hh(payloads, spec.config)
+    rows = merge_ops.hh_top_rows(merged, spec.config, depth, slot or 0)
+    # the merge already materialized the u64 planes
+    return rows, FrozenCms(value=merged["cms"]), key_width(spec.config), \
+        None
+
+
+def spread_merged_view(spec, slot, payloads):
+    from ..mesh import merge as merge_ops
+    from ..models.spread import spread_key_width
+
+    if not payloads:
+        return None
+    depth = spec.k or spec.config.capacity
+    merged = merge_ops.merge_spread(payloads, spec.config)
+    rows = merge_ops.spread_top_rows(merged, spec.config, depth, slot or 0)
+    return rows, None, spread_key_width(spec.config), merged["regs"]
+
+
+def dense_merged_view(spec, slot, payloads):
+    from ..mesh import merge as merge_ops
+
+    if not payloads:
+        return None
+    depth = spec.k or spec.config.capacity
+    totals = merge_ops.merge_dense(payloads)
+    rows = merge_ops.dense_top_rows(totals, spec.config, depth, slot or 0)
+    return rows, None, 1, None
+
+
 def _family_from_model(name: str, m: WindowedHeavyHitter) -> FamilyView:
     """Freeze one windowed top-K model into a read view. Caller holds
     worker.lock and has synced sketch states, so ``m.model.state`` /
     ``.totals`` are current; ``top(depth)`` is the SAME extraction the
     locked query path runs, so a snapshot-served k-row answer is the
-    locked answer's exact prefix."""
+    locked answer's exact prefix. The per-kind view parts come from the
+    family registry's serve_capture hook (unknown snapshot kinds fall
+    back to the dense shape, as before)."""
     depth = m.k
     rows = m.model.top(depth)
-    regs = None
-    if m.model.snapshot_kind == "windowed_hh":
-        import numpy as np
-
-        from ..hostsketch.state import frozen_cms
-        from .snapshot import FrozenCms
-
-        kind = "hh"
-        planes = m.model.state.cms
-        if not isinstance(planes, np.ndarray):
-            # device-backend jax array: hh_update DONATES its state arg,
-            # so the next batch deletes these buffers on TPU/GPU — the
-            # host copy must happen NOW, at publish. (Host-exported
-            # states are already fresh numpy and safe to hold: they are
-            # replaced, never mutated.) The expensive f32->u64 freeze
-            # stays lazy either way — first estimate reader pays it.
-            planes = np.asarray(planes)
-        cms = FrozenCms(lambda a=planes: frozen_cms(a))
-        lanes = key_width(m.config)
-    elif m.model.snapshot_kind == "windowed_spread":
-        from ..models.spread import spread_key_width
-
-        kind, cms = "spread", None
-        # the update path mutates registers in place — the snapshot
-        # must freeze its own copy (the immutability contract)
-        regs = m.model.state.regs.copy()
-        lanes = spread_key_width(m.config)
-    else:
-        kind, cms, lanes = "dense", None, 1
+    fam = registry.family_for_snapshot(m.model.snapshot_kind) \
+        or registry.family("dense")
+    cms, lanes, regs = registry.hook(fam, "serve_capture")(m)
     return FamilyView(
-        name=name, kind=kind,
+        name=name, kind=fam.kind,
         window_start=(int(m.current_slot)
                       if m.current_slot is not None else None),
         depth=int(len(rows["valid"])), rows=rows, key_lanes=lanes,
@@ -153,13 +205,14 @@ class WorkerServePublisher:
             elif isinstance(m, WindowAggregator):
                 watermark = max(watermark, float(m.watermark))
         self._last_gen = self.ledger.generation
-        aud = getattr(worker.fused, "audit", None)
-        audit = dict(aud.last_reports) if aud is not None else None
-        saud = getattr(worker.fused, "spread_audit", None)
-        if saud is not None and saud.last_reports:
-            # spread audit reports share the /query/audit namespace —
-            # family names are distinct model names, so a plain merge
-            audit = {**(audit or {}), **saud.last_reports}
+        audit = None
+        for _kind, attr in registry.audit_attrs():
+            shadow = getattr(worker.fused, attr, None)
+            if shadow is not None:
+                # per-family shadow reports share the /query/audit
+                # namespace — family names are distinct model names, so
+                # a plain merge
+                audit = {**(audit or {}), **shadow.last_reports}
         guard = getattr(worker, "guard", None)
         if guard is not None and guard.armed:
             # flowguard is never silent: snapshot metadata records the
@@ -218,7 +271,7 @@ class MeshServePublisher:
         rows reach the range ledger through the coordinator's sink list;
         a completed merge wakes the publisher thread."""
         self.ledger.tables |= {s.name for s in self.coordinator.specs
-                               if s.kind == "wagg"}
+                               if not registry.family(s.kind).ranked}
         self.coordinator.sinks.append(self.ledger)
         self.coordinator.serve = self
         return self
@@ -289,7 +342,6 @@ class MeshServePublisher:
         per-model) + merge + extract + swap — amortized over every
         reader until the next publish, where the pre-r14 path paid a
         fan-out per QUERY."""
-        from ..mesh import merge as merge_ops
         from ..utils.faults import FAULTS
 
         if FAULTS.active:  # flowchaos seam: a failed fan-out/publish —
@@ -300,39 +352,15 @@ class MeshServePublisher:
         coord = self.coordinator
         families = {}
         for spec in coord.specs:
-            if spec.kind == "wagg":
-                continue
+            fam = registry.family(spec.kind)
+            capture = registry.hook(fam, "serve_capture_merged")
+            if capture is None:
+                continue  # wagg: exact rows ride the range ledger
             slot, payloads = coord.open_window_payloads(spec.name)
-            depth = spec.k or spec.config.capacity
-            regs = None
-            if spec.kind == "hh":
-                from .snapshot import FrozenCms
-
-                merged = merge_ops.merge_hh(payloads, spec.config)
-                rows = merge_ops.hh_top_rows(merged, spec.config, depth,
-                                             slot or 0)
-                # the merge already materialized the u64 planes
-                cms = FrozenCms(value=merged["cms"])
-                lanes = key_width(spec.config)
-            elif spec.kind == "spread":
-                from ..models.spread import spread_key_width
-
-                merged = (merge_ops.merge_spread(payloads, spec.config)
-                          if payloads else None)
-                rows = merge_ops.spread_top_rows(
-                    merged, spec.config, depth, slot or 0) \
-                    if merged is not None else None
-                regs = merged["regs"] if merged is not None else None
-                cms, lanes = None, spread_key_width(spec.config)
-            else:
-                totals = (merge_ops.merge_dense(payloads) if payloads
-                          else None)
-                rows = merge_ops.dense_top_rows(
-                    totals, spec.config, depth, slot or 0) \
-                    if totals is not None else None
-                cms, lanes = None, 1
-            if rows is None:
+            parts = capture(spec, slot, payloads)
+            if parts is None:
                 continue
+            rows, cms, lanes, regs = parts
             families[spec.name] = FamilyView(
                 name=spec.name, kind=spec.kind, window_start=slot,
                 depth=int(len(rows["valid"])), rows=rows,

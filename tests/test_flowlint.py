@@ -1588,6 +1588,228 @@ class TestNetTimeout:
             assert "net-checked" in sf.markers, sf.rel
 
 
+_FAM_HOOKS = """
+def payload(state): return {}
+def merge(payloads, config=None): return {}
+def top_rows(merged, config, k, slot): return {}
+def capture(m): return (None, 1, None)
+def capture_merged(spec, slot, payloads): return None
+def save(model): return {}
+def restore(model, ms, name): return None
+"""
+
+_FAM_REGISTRY = """
+register(SketchFamily(
+    kind="hh",
+    snapshot_kind="windowed_hh",
+    checkpoint_kind="windowed_hh",
+    payload_kinds=("hh",),
+    merge_monoid="u64-sum",
+    ranked=True,
+    state_attr="state",
+    payload="hooks:payload",
+    merge="hooks:merge",
+    top_rows="hooks:top_rows",
+    serve_capture="hooks:capture",
+    serve_capture_merged="hooks:capture_merged",
+    checkpoint_save="hooks:save",
+    checkpoint_restore="hooks:restore",
+    flag_namespace="hh.",
+    endpoint="/query/topk",
+    parity_target="hh-parity",
+    doc_token="`hh`",
+    obs_token="hh_recall",
+))
+"""
+
+
+class TestFamilyCitizenship:
+    """family-citizenship fixture battery: the registry parser, the
+    per-surface completeness checks, the reverse kind-literal check,
+    and the suppression/skip-file behavior every other rule has."""
+
+    def _run(self, tmp_path, registry=_FAM_REGISTRY, extra=()):
+        files = {"families/registry.py": registry,
+                 "hooks.py": _FAM_HOOKS}
+        files.update(extra)
+        for rel, src in files.items():
+            path = tmp_path / rel
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(textwrap.dedent(src))
+        return run_lint(str(tmp_path), sorted(files),
+                        rules=("family-citizenship",))
+
+    def test_complete_registry_clean(self, tmp_path):
+        assert self._run(tmp_path) == []
+
+    def test_rule_skipped_without_registry_in_scope(self, tmp_path):
+        (tmp_path / "app.py").write_text('kind = x["kind"] == "mystery"\n')
+        out = run_lint(str(tmp_path), ["app.py"],
+                       rules=("family-citizenship",))
+        assert out == []
+
+    def test_missing_surface_named_exactly_once(self, tmp_path):
+        out = self._run(tmp_path, registry=_FAM_REGISTRY.replace(
+            '    merge="hooks:merge",\n', ""))
+        assert len(out) == 1
+        assert "family `hh` is missing surface `merge`" in out[0].message
+
+    def test_ranked_surfaces_only_owed_when_ranked(self, tmp_path):
+        dropped = _FAM_REGISTRY.replace(
+            '    serve_capture="hooks:capture",\n', "")
+        out = self._run(tmp_path, registry=dropped)
+        assert len(out) == 1
+        assert "missing surface `serve_capture`" in out[0].message
+        # an unranked family (exact rows, wagg-style) legitimately
+        # leaves the top-K capture surfaces unset
+        unranked = dropped.replace("    ranked=True,", "    ranked=False,") \
+            .replace('    serve_capture_merged="hooks:capture_merged",\n',
+                     "").replace('    snapshot_kind="windowed_hh",\n', "") \
+            .replace('    state_attr="state",\n', "")
+        assert self._run(tmp_path, registry=unranked) == []
+
+    def test_unresolvable_hook_flagged(self, tmp_path):
+        out = self._run(tmp_path, registry=_FAM_REGISTRY.replace(
+            "hooks:merge", "hooks:no_such_fn"))
+        assert len(out) == 1
+        assert "does not resolve" in out[0].message
+        assert "no_such_fn" in out[0].message
+
+    def test_hook_module_outside_scope_flagged(self, tmp_path):
+        out = self._run(tmp_path, registry=_FAM_REGISTRY.replace(
+            "hooks:merge", "phantom_mod:merge"))
+        assert len(out) == 1
+        assert "phantom_mod" in out[0].message
+        assert "not in the lint scope" in out[0].message
+
+    def test_computed_field_is_a_finding(self, tmp_path):
+        out = self._run(tmp_path, registry=_FAM_REGISTRY.replace(
+            'merge="hooks:merge",', 'merge="hooks:" + MERGE_FN,'))
+        assert any("must be a literal" in f.message for f in out)
+
+    def test_unregistered_kind_literal_flagged(self, tmp_path):
+        out = self._run(tmp_path, extra={"mesh/codec.py": """
+            def capture(payload):
+                if payload["kind"] == "mystery":
+                    return None
+                if payload["kind"] == "hh":
+                    return payload
+        """})
+        assert len(out) == 1
+        assert 'kind tag "mystery"' in out[0].message
+        assert out[0].path == "mesh/codec.py"
+
+    def test_snapshot_and_get_kind_forms_checked(self, tmp_path):
+        out = self._run(tmp_path, extra={"serve/publisher.py": """
+            def pick(m, payload):
+                a = m.snapshot_kind == "windowed_hh"       # registered
+                b = payload.get("kind") in ("hh", "rogue")
+                return a, b
+        """})
+        assert len(out) == 1
+        assert 'kind tag "rogue"' in out[0].message
+
+    def test_bare_kind_local_not_a_signal(self, tmp_path):
+        # journal records / delta ships reuse a local named `kind`;
+        # those tagged unions are not family dispatch
+        out = self._run(tmp_path, extra={"mesh/coordinator.py": """
+            def replay(records):
+                for kind, blob in records:
+                    if kind == "chk":
+                        return blob
+        """})
+        assert out == []
+
+    def test_non_family_kind_allowed_then_stale_flagged(self, tmp_path):
+        allow = "NON_FAMILY_KINDS = (\"ddos\",)\n" + _FAM_REGISTRY
+        out = self._run(tmp_path, registry=allow, extra={
+            "engine/worker.py": """
+                def restore(ms):
+                    if ms["kind"] == "ddos":
+                        return None
+            """})
+        assert out == []
+        # the same entry with no dispatch surface mentioning it is
+        # itself a finding (stale allowlist discipline)
+        out = self._run(tmp_path, registry=allow, extra={
+            "engine/worker.py": """
+                def restore(ms):
+                    return ms
+            """})
+        assert len(out) == 1
+        assert '"ddos" appears at no dispatch surface' in out[0].message
+
+    def test_empty_registry_flagged(self, tmp_path):
+        out = self._run(tmp_path, registry="FAMILIES = {}\n")
+        assert len(out) == 1
+        assert "registers no SketchFamily" in out[0].message
+
+    def test_suppression_with_reason_accepted(self, tmp_path):
+        out = self._run(tmp_path, registry=_FAM_REGISTRY.replace(
+            "register(SketchFamily(",
+            "register(SketchFamily(  # flowlint: disable=family-citizenship -- half-registered on purpose: fixture").replace(
+            '    merge="hooks:merge",\n', ""))
+        assert out == []
+
+    def test_skip_file_opts_registry_out(self, tmp_path):
+        out = self._run(
+            tmp_path,
+            registry="# flowlint: skip-file\n" + _FAM_REGISTRY.replace(
+                '    merge="hooks:merge",\n', ""))
+        assert out == []
+
+    def test_repo_registry_parses_with_four_families(self):
+        # the real registry must stay statically readable: the same
+        # parser the lint uses sees all four families and both
+        # NON_FAMILY_KINDS entries
+        from tools.flowlint import rules_family
+        from tools.flowlint.core import load_files
+
+        (reg,) = load_files(
+            REPO, ["flow_pipeline_tpu/families/registry.py"])
+        fams, non_family, _line, findings = \
+            rules_family._parse_registry(reg)
+        assert findings == []
+        assert [kw["kind"] for kw, _ in fams] == \
+            ["hh", "wagg", "dense", "spread"]
+        assert non_family == ["ddos", "flowguard"]
+
+
+class TestAnnotate:
+    def test_json_round_trips_to_error_lines(self, tmp_path, capsys):
+        import json
+
+        from tools.flowlint import annotate
+        from tools.flowlint.runner import main
+
+        (tmp_path / "fix.py").write_text(textwrap.dedent("""
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f():
+                return np.zeros(3)
+        """))
+        rc = main(["--root", str(tmp_path), "--json", "fix.py"])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        json_path = tmp_path / "findings.json"
+        json_path.write_text(json.dumps(doc))
+        assert annotate.main([str(json_path)]) == 0
+        lines = capsys.readouterr().out.splitlines()
+        (f,) = doc["findings"]
+        assert lines == [
+            f"::error file=fix.py,line={f['line']},"
+            f"title=flowlint uint64-discipline::{f['message']}",
+            "flowlint: 1 finding(s)",
+        ]
+
+    def test_clean_document_emits_count_only(self, capsys):
+        from tools.flowlint import annotate
+
+        assert annotate.annotations({"findings": [], "count": 0}) == \
+            ["flowlint: 0 finding(s)"]
+
+
 class TestRepoRegression:
     def test_repo_lints_clean(self):
         findings = run_lint(REPO)

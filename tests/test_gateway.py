@@ -98,6 +98,16 @@ def _run_worker(hh_sketch="table", **worker_kw):
             pub.publish(worker)
     with worker.lock:
         pub.publish(worker)
+    # the bus is drained and later tests only read worker state, so stop
+    # the pipeline threads here: leaked daemon pollers keep hitting the
+    # bus.poll fault seam and pollute FAULTS counters suite-wide
+    if worker.executor is not None:
+        worker.executor.stop()
+    if worker.flusher is not None:
+        worker.flusher.stop()
+    stop_feed = getattr(worker.consumer, "stop", None)
+    if stop_feed is not None:
+        stop_feed()
     return worker, pub
 
 

@@ -1,0 +1,46 @@
+"""flowlint --json -> GitHub per-line annotations.
+
+The CI lint job runs ``python -m tools.flowlint --json`` and feeds the
+document through this converter, so findings land as ``::error``
+workflow commands (per-line PR annotations) instead of a wall of text.
+Checked in — not inlined in ci.yml — so the round-trip is unit-tested
+(tests/test_flowlint.py) and the annotation format can't silently
+drift from what the runner emits.
+
+Usage: ``python -m tools.flowlint.annotate [findings.json]`` (reads
+stdin when the path is omitted or ``-``). Exit status is always 0 —
+gating on findings stays the runner's job, this is presentation only.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def annotations(doc: dict) -> list[str]:
+    """Workflow-command lines for one ``--json`` document: one
+    ``::error file=...,line=...`` per finding plus the count trailer
+    the log always shows."""
+    lines = [
+        f"::error file={f['file']},line={f['line']},"
+        f"title=flowlint {f['rule']}::{f['message']}"
+        for f in doc.get("findings", ())
+    ]
+    count = doc.get("count", len(doc.get("findings", ())))
+    lines.append(f"flowlint: {count} finding(s)")
+    return lines
+
+
+def main(argv: list[str]) -> int:
+    path = argv[0] if argv else "-"
+    fh = sys.stdin if path == "-" else open(path, "r", encoding="utf-8")
+    with fh:
+        doc = json.load(fh)
+    for line in annotations(doc):
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,76 @@
+"""make lint-mutation: prove the family-citizenship rule bites.
+
+A lint that cannot fail is indistinguishable from no lint, so this
+smoke seeds one mutation — the spread family's ``merge=`` registration
+line is deleted from a scratch copy of the tree (syntactically valid,
+visibly incomplete) — and asserts that ``flowlint --rule
+family-citizenship`` on the mutant exits nonzero with a finding naming
+exactly the missing surface. Exit status: 0 = the mutant was caught,
+1 = the rule is blind (or the mutation no longer applies and needs
+re-seeding against the current registry).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REGISTRY_REL = os.path.join("flow_pipeline_tpu", "families",
+                            "registry.py")
+# the seeded mutation: drop spread's merge hook registration
+MUTATION = re.compile(
+    r'^\s*merge="flow_pipeline_tpu\.mesh\.merge:merge_spread",\n',
+    re.MULTILINE)
+EXPECTED = "family `spread` is missing surface `merge`"
+
+# everything the rule reads: the package (registry + dispatch surfaces
+# + KNOWN_FLAGS) and the linter itself; root artifacts (docs, Makefile,
+# ci.yml, deploy) are deliberately left out — absent artifacts skip
+# those checks, keeping the smoke pinned to the seeded mutation
+_COPY = ("flow_pipeline_tpu", "tools")
+_IGNORE = shutil.ignore_patterns(
+    "__pycache__", "*.pyc", "*.so", "*.o", ".pytest_cache")
+
+
+def main() -> int:
+    root = os.getcwd()
+    with tempfile.TemporaryDirectory(prefix="flowlint-mutant-") as tmp:
+        for entry in _COPY:
+            shutil.copytree(os.path.join(root, entry),
+                            os.path.join(tmp, entry), ignore=_IGNORE)
+        reg_path = os.path.join(tmp, REGISTRY_REL)
+        with open(reg_path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        mutated, n = MUTATION.subn("", src)
+        if n != 1:
+            print("lint-mutation: seeded mutation did not apply "
+                  f"({n} matches for the spread merge registration) — "
+                  "re-seed it against the current registry",
+                  file=sys.stderr)
+            return 1
+        with open(reg_path, "w", encoding="utf-8") as fh:
+            fh.write(mutated)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.flowlint",
+             "--rule", "family-citizenship", "flow_pipeline_tpu"],
+            cwd=tmp, capture_output=True, text=True)
+    if proc.returncode == 0:
+        print("lint-mutation: BLIND — flowlint passed the mutant "
+              "(spread merge registration deleted)", file=sys.stderr)
+        return 1
+    if EXPECTED not in proc.stdout:
+        print("lint-mutation: flowlint failed the mutant but did not "
+              f"name the missing surface; wanted {EXPECTED!r}, got:\n"
+              f"{proc.stdout}", file=sys.stderr)
+        return 1
+    print("lint-mutation: ok — the mutant was caught "
+          f"({EXPECTED!r})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

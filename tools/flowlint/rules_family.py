@@ -1,0 +1,383 @@
+"""family-citizenship: every sketch family is a complete citizen.
+
+The SketchFamily registry (``flow_pipeline_tpu/families/registry.py``)
+is the single source of per-kind truth the dispatch layers iterate —
+but a registry only helps if NOTHING routes around it. This rule pins
+the contract from both directions, the way abi-contract pins the C
+seam:
+
+- **forward** (registration -> world): every ``register(SketchFamily(
+  ...))`` call must fill every dispatch surface — merge/payload/
+  checkpoint hooks that statically resolve (the "module:attr" target
+  module is parsed, no imports), a ``flag_namespace`` with at least one
+  ``KNOWN_FLAGS`` entry and a ``-namespace`` mention in docs/FLAGS.md,
+  a ``doc_token`` present in docs/ARCHITECTURE.md, a ``parity_target``
+  that is a real Makefile target wired into CI, an ``endpoint`` that
+  serve/server.py routes, and an ``obs_token`` visible on the Grafana/
+  alerts surface. Ranked families additionally need the top-K hooks
+  and both serve captures.
+- **reverse** (world -> registration): any string-literal kind tag
+  compared against a ``.kind`` / ``["kind"]`` / ``.get("kind")`` /
+  ``snapshot_kind`` expression inside a dispatch-surface module must
+  be registered (family kind, snapshot/checkpoint/payload kind, or a
+  ``NON_FAMILY_KINDS`` entry) — an unregistered tag is a family
+  bypassing the registry. And ``NON_FAMILY_KINDS`` entries no dispatch
+  surface mentions any more are themselves findings (stale allowlist
+  discipline).
+
+Registration parsing requires keyword literals only; a computed field
+value is itself a finding (it would blind every check below). Root
+artifacts (docs/, Makefile, ci.yml, deploy/) are only consulted when
+present under ``--root`` — fixture roots stay quiet about repo layout,
+while the real repo (which has them all) gets the full battery.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+
+from .core import Finding, SourceFile, dotted_name
+from .rules_flags import _registry as _flags_registry
+
+RULE = "family-citizenship"
+
+_REGISTRY_REL = "families/registry.py"
+
+# modules whose kind-tag literals must be registered (rel suffixes)
+DISPATCH_SURFACES = (
+    "engine/worker.py",
+    "engine/fused.py",
+    "engine/hostfused.py",
+    "hostsketch/pipeline.py",
+    "mesh/codec.py",
+    "mesh/coordinator.py",
+    "mesh/member.py",
+    "mesh/merge.py",
+    "serve/publisher.py",
+    "serve/snapshot.py",
+    "serve/server.py",
+    "gateway/delta.py",
+)
+
+# surfaces every family must fill; ranked families owe four more
+REQUIRED_FIELDS = (
+    "kind", "checkpoint_kind", "payload_kinds", "merge_monoid",
+    "payload", "merge", "top_rows", "checkpoint_save",
+    "checkpoint_restore", "flag_namespace", "endpoint", "parity_target",
+    "doc_token", "obs_token",
+)
+RANKED_FIELDS = ("snapshot_kind", "state_attr", "serve_capture",
+                 "serve_capture_merged")
+# "module:attr" fields whose target must statically resolve
+HOOK_FIELDS = ("payload", "merge", "top_rows", "serve_capture",
+               "serve_capture_merged", "checkpoint_save",
+               "checkpoint_restore", "audit_class")
+
+_HOOK_REF_RE = re.compile(r"^[\w.]+:\w+$")
+
+
+def _norm(rel: str) -> str:
+    return rel.replace("\\", "/")
+
+
+def _registry_file(files: list[SourceFile]) -> SourceFile | None:
+    for sf in files:
+        if _norm(sf.rel).endswith(_REGISTRY_REL):
+            return sf
+    return None
+
+
+def _parse_registry(sf: SourceFile):
+    """(families, non_family_kinds, nf_line, findings) from the
+    registry module's AST — ``families`` is a list of (kwargs dict,
+    registration line)."""
+    fams, non_family, nf_line = [], [], 1
+    findings: list[Finding] = []
+    if sf.tree is None:
+        return fams, non_family, nf_line, findings
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == "NON_FAMILY_KINDS":
+                    nf_line = node.lineno
+                    try:
+                        non_family = list(ast.literal_eval(node.value))
+                    except (ValueError, TypeError):
+                        findings.append(Finding(
+                            RULE, sf.rel, node.lineno,
+                            "NON_FAMILY_KINDS must be a literal tuple "
+                            "of kind tags"))
+        if not (isinstance(node, ast.Call)
+                and dotted_name(node.func) in ("register",
+                                               "registry.register")):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Call)):
+            continue
+        ctor = node.args[0]
+        if dotted_name(ctor.func) not in ("SketchFamily",
+                                          "registry.SketchFamily"):
+            continue
+        kwargs: dict = {}
+        for kw in ctor.keywords:
+            if kw.arg is None:
+                findings.append(Finding(
+                    RULE, sf.rel, ctor.lineno,
+                    "SketchFamily registration must not use **kwargs "
+                    "(the registry must be statically readable)"))
+                continue
+            try:
+                kwargs[kw.arg] = ast.literal_eval(kw.value)
+            except (ValueError, TypeError):
+                findings.append(Finding(
+                    RULE, sf.rel, kw.value.lineno,
+                    f"SketchFamily field `{kw.arg}` must be a literal "
+                    "(computed values blind the citizenship checks)"))
+        if ctor.args:
+            findings.append(Finding(
+                RULE, sf.rel, ctor.lineno,
+                "SketchFamily registration must use keyword arguments "
+                "only"))
+        fams.append((kwargs, ctor.lineno))
+    return fams, non_family, nf_line, findings
+
+
+def _top_level_names(sf: SourceFile) -> set[str]:
+    names: set[str] = set()
+    if sf.tree is None:
+        return names
+    for node in sf.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            names.add(node.target.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.add(alias.asname or alias.name.split(".")[0])
+    return names
+
+
+def _read(path: str) -> str | None:
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def _obs_text(root: str) -> str | None:
+    """Concatenated Grafana dashboards + Prometheus alert rules, or
+    None when the deploy surface is absent (fixture roots)."""
+    paths = sorted(glob.glob(
+        os.path.join(root, "deploy", "grafana", "dashboards", "*.json")))
+    alerts = os.path.join(root, "deploy", "prometheus", "alerts.yml")
+    if os.path.exists(alerts):
+        paths.append(alerts)
+    if not paths:
+        return None
+    return "\n".join(_read(p) or "" for p in paths)
+
+
+def _kindish(node: ast.AST) -> bool:
+    """Does this expression read a family kind tag?"""
+    if isinstance(node, ast.Attribute) and \
+            node.attr in ("kind", "snapshot_kind", "checkpoint_kind"):
+        return True
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        return isinstance(sl, ast.Constant) and sl.value == "kind"
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr == "get" and node.args and \
+            isinstance(node.args[0], ast.Constant) and \
+            node.args[0].value == "kind":
+        return True
+    # NOTE: a bare local named `kind` is deliberately NOT a signal —
+    # journal record kinds, delta ship kinds and other tagged unions
+    # reuse the name; family tags always travel as `.kind` attributes,
+    # ["kind"] payload entries or snapshot/checkpoint_kind locals.
+    if isinstance(node, ast.Name) and \
+            node.id in ("snapshot_kind", "checkpoint_kind"):
+        return True
+    return False
+
+
+def _kind_literals(sf: SourceFile) -> list[tuple[str, int]]:
+    """(literal, line) for every string compared against a kind
+    expression in this module — the dispatch sites the reverse check
+    polices."""
+    out: list[tuple[str, int]] = []
+    if sf.tree is None:
+        return out
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        if not any(_kindish(s) for s in sides):
+            continue
+        for s in sides:
+            if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                out.append((s.value, s.lineno))
+            elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                out.extend((e.value, e.lineno) for e in s.elts
+                           if isinstance(e, ast.Constant)
+                           and isinstance(e.value, str))
+    return out
+
+
+def check(files: list[SourceFile], root: str) -> list[Finding]:
+    findings: list[Finding] = []
+    reg = _registry_file(files)
+    if reg is None:
+        return findings  # no registry module in scope (fixture runs)
+    fams, non_family, nf_line, parse_findings = _parse_registry(reg)
+    findings.extend(parse_findings)
+    if not fams:
+        findings.append(Finding(
+            RULE, reg.rel, 1,
+            "families/registry.py registers no SketchFamily — the "
+            "dispatch layers would iterate an empty registry"))
+        return sorted(findings, key=lambda f: (f.path, f.line))
+
+    by_rel = {_norm(sf.rel): sf for sf in files}
+    known_flags, _flags_rel = _flags_registry(files)
+    flags_doc = _read(os.path.join(root, "docs", "FLAGS.md"))
+    arch_doc = _read(os.path.join(root, "docs", "ARCHITECTURE.md"))
+    makefile = _read(os.path.join(root, "Makefile"))
+    ci = _read(os.path.join(root, ".github", "workflows", "ci.yml"))
+    obs = _obs_text(root)
+    server = next((sf for sf in files
+                   if _norm(sf.rel).endswith("serve/server.py")), None)
+    server_src = "" if server is None else "\n".join(server.lines)
+
+    # ---- forward: every registered family covers every surface ----------
+    for kwargs, line in fams:
+        kind = kwargs.get("kind")
+        if not isinstance(kind, str) or not kind:
+            findings.append(Finding(
+                RULE, reg.rel, line,
+                "SketchFamily registration has no literal `kind`"))
+            continue
+        required = REQUIRED_FIELDS + (
+            RANKED_FIELDS if kwargs.get("ranked", True) else ())
+        for field in required:
+            if not kwargs.get(field):
+                findings.append(Finding(
+                    RULE, reg.rel, line,
+                    f"family `{kind}` is missing surface `{field}`"))
+        for field in HOOK_FIELDS:
+            ref = kwargs.get(field)
+            if not ref:
+                continue
+            if not isinstance(ref, str) or not _HOOK_REF_RE.match(ref):
+                findings.append(Finding(
+                    RULE, reg.rel, line,
+                    f"family `{kind}` hook `{field}` must be a "
+                    f'"module:attr" string, got {ref!r}'))
+                continue
+            mod, _, attr = ref.partition(":")
+            mod_rel = mod.replace(".", "/") + ".py"
+            target = by_rel.get(mod_rel) or next(
+                (sf for r, sf in by_rel.items() if r.endswith(mod_rel)),
+                None)
+            if target is None:
+                findings.append(Finding(
+                    RULE, reg.rel, line,
+                    f"family `{kind}` hook `{field}` targets module "
+                    f"`{mod}` which is not in the lint scope"))
+            elif attr not in _top_level_names(target):
+                findings.append(Finding(
+                    RULE, reg.rel, line,
+                    f"family `{kind}` hook `{field}` does not resolve: "
+                    f"no top-level `{attr}` in {target.rel}"))
+        ns = kwargs.get("flag_namespace")
+        if ns and known_flags and \
+                not any(fl.startswith(ns) for fl in known_flags):
+            findings.append(Finding(
+                RULE, reg.rel, line,
+                f"family `{kind}` claims flag namespace `{ns}` but "
+                "KNOWN_FLAGS registers no flag under it"))
+        if ns and flags_doc is not None and f"-{ns}" not in flags_doc:
+            findings.append(Finding(
+                RULE, reg.rel, line,
+                f"family `{kind}` flag namespace `-{ns}*` is not "
+                "documented in docs/FLAGS.md"))
+        token = kwargs.get("doc_token")
+        if token and arch_doc is not None and token not in arch_doc:
+            findings.append(Finding(
+                RULE, reg.rel, line,
+                f"family `{kind}` doc token {token} does not appear in "
+                "docs/ARCHITECTURE.md"))
+        target = kwargs.get("parity_target")
+        if target and makefile is not None:
+            if not re.search(rf"^{re.escape(target)}:", makefile,
+                             re.MULTILINE):
+                findings.append(Finding(
+                    RULE, reg.rel, line,
+                    f"family `{kind}` parity target `{target}` is not "
+                    "a Makefile target"))
+            elif ci is not None and f"make {target}" not in ci:
+                findings.append(Finding(
+                    RULE, reg.rel, line,
+                    f"family `{kind}` parity target `make {target}` is "
+                    "not wired into .github/workflows/ci.yml"))
+        endpoint = kwargs.get("endpoint")
+        if endpoint and server is not None and \
+                f'"{endpoint}"' not in server_src:
+            findings.append(Finding(
+                RULE, reg.rel, line,
+                f"family `{kind}` endpoint `{endpoint}` is not routed "
+                f"by {server.rel}"))
+        ot = kwargs.get("obs_token")
+        if ot and obs is not None and ot not in obs:
+            findings.append(Finding(
+                RULE, reg.rel, line,
+                f"family `{kind}` obs token `{ot}` appears on no "
+                "Grafana dashboard or Prometheus alert"))
+
+    # ---- reverse: dispatch-site kind literals must be registered ---------
+    vocab: set[str] = set(non_family)
+    for kwargs, _line in fams:
+        for key in ("kind", "snapshot_kind", "checkpoint_kind"):
+            val = kwargs.get(key)
+            if isinstance(val, str):
+                vocab.add(val)
+        vocab.update(v for v in (kwargs.get("payload_kinds") or ())
+                     if isinstance(v, str))
+
+    surface_files = [sf for sf in files
+                     if _norm(sf.rel).endswith(DISPATCH_SURFACES)]
+    seen_anywhere: set[str] = set()
+    for sf in surface_files:
+        if sf.tree is not None:
+            seen_anywhere.update(
+                n.value for n in ast.walk(sf.tree)
+                if isinstance(n, ast.Constant)
+                and isinstance(n.value, str))
+        for lit, lineno in _kind_literals(sf):
+            if lit not in vocab:
+                findings.append(Finding(
+                    RULE, sf.rel, lineno,
+                    f'kind tag "{lit}" dispatched here is neither a '
+                    "registered sketch family surface nor a "
+                    "NON_FAMILY_KINDS entry (families/registry.py)"))
+
+    # stale allowlist discipline: a NON_FAMILY_KINDS entry no dispatch
+    # surface mentions is dead weight that will mask the next typo
+    for tag in non_family:
+        if surface_files and tag not in seen_anywhere:
+            findings.append(Finding(
+                RULE, reg.rel, nf_line,
+                f'NON_FAMILY_KINDS entry "{tag}" appears at no '
+                "dispatch surface any more — delete it"))
+
+    return sorted(findings, key=lambda f: (f.path, f.line))

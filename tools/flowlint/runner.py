@@ -11,10 +11,12 @@ per finding) — the CI lint job turns that into per-line annotations.
 from __future__ import annotations
 
 import sys
+from concurrent.futures import ThreadPoolExecutor
 
 from . import (
     rules_abi,
     rules_dtype,
+    rules_family,
     rules_flags,
     rules_lockorder,
     rules_locks,
@@ -30,9 +32,20 @@ from .core import (
 )
 
 DEFAULT_SUBDIRS = ("flow_pipeline_tpu", "bench.py", "tests")
-ALL_RULES = ("jit-purity", "uint64-discipline", "lock-discipline",
-             "lock-order", "flag-registry", "abi-contract",
-             "net-timeout")
+# (rule name, check entrypoint) in the canonical order. Checks are pure
+# reads over the parsed SourceFiles, so run_lint fans them out on a
+# thread pool; THIS tuple's order is what keeps output deterministic.
+_RULE_CHECKS = (
+    ("jit-purity", lambda files, root: rules_purity.check(files)),
+    ("uint64-discipline", lambda files, root: rules_dtype.check(files)),
+    ("lock-discipline", lambda files, root: rules_locks.check(files)),
+    ("lock-order", lambda files, root: rules_lockorder.check(files)),
+    ("flag-registry", rules_flags.check),
+    ("abi-contract", rules_abi.check),
+    ("net-timeout", lambda files, root: rules_net.check(files)),
+    ("family-citizenship", rules_family.check),
+)
+ALL_RULES = tuple(name for name, _ in _RULE_CHECKS)
 
 
 def run_lint(root: str, rel_paths: list[str] | None = None,
@@ -54,20 +67,16 @@ def run_lint(root: str, rel_paths: list[str] | None = None,
                 Finding("parse", sf.rel, 1, sf.parse_error))
 
     selected = rules or ALL_RULES
-    if "jit-purity" in selected:
-        result.extend_filtered(by_rel, rules_purity.check(files))
-    if "uint64-discipline" in selected:
-        result.extend_filtered(by_rel, rules_dtype.check(files))
-    if "lock-discipline" in selected:
-        result.extend_filtered(by_rel, rules_locks.check(files))
-    if "lock-order" in selected:
-        result.extend_filtered(by_rel, rules_lockorder.check(files))
-    if "flag-registry" in selected:
-        result.extend_filtered(by_rel, rules_flags.check(files, root))
-    if "abi-contract" in selected:
-        result.extend_filtered(by_rel, rules_abi.check(files, root))
-    if "net-timeout" in selected:
-        result.extend_filtered(by_rel, rules_net.check(files))
+    active = [(name, fn) for name, fn in _RULE_CHECKS
+              if name in selected]
+    # the rule checks only READ the parsed files, so they fan out on a
+    # pool; folding back through extend_filtered stays on this thread
+    # and in _RULE_CHECKS order — it marks Suppression.used (shared
+    # mutable state) and the fixed order keeps runs byte-identical
+    with ThreadPoolExecutor(max_workers=max(1, len(active))) as pool:
+        futures = [pool.submit(fn, files, root) for _name, fn in active]
+        for fut in futures:
+            result.extend_filtered(by_rel, fut.result())
     # suppressions themselves must be justified + must still bite;
     # unused-reporting is only sound when every rule actually ran
     result.findings.extend(suppression_findings(
@@ -85,7 +94,8 @@ def main(argv: list[str]) -> int:
         prog="flowlint",
         description="project static analysis: jit-purity, uint64 "
                     "dtype-flow, lock annotations, lock ordering, flag "
-                    "registry, ctypes<->C ABI contract")
+                    "registry, ctypes<->C ABI contract, sketch-family "
+                    "citizenship")
     p.add_argument("paths", nargs="*",
                    help="repo-relative files/dirs (default: full scope)")
     p.add_argument("--root", default=os.getcwd(),
