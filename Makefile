@@ -7,7 +7,8 @@
 	lint-mutation native-san \
 	hostsketch-parity fused-parity fused-parity-traced mesh-parity \
 	mesh-parity-traced serve-load audit-parity invertible-parity \
-	chaos-parity gateway-parity guard-parity spread-parity
+	chaos-parity gateway-parity guard-parity spread-parity \
+	history-parity
 
 all: native
 
@@ -146,6 +147,19 @@ gateway-parity:
 # (consumed = emitted + shed) — docs/FAULT_TOLERANCE.md "flowguard".
 guard-parity:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_guard.py -v
+
+# flowhistory (history/): the durable snapshot archive's acceptance
+# gates — record-and-replay byte-parity (every live /query/* answer
+# replays bit-identically from the archive at ?version=/?at=, for
+# table/invertible/spread families and the worker AND mesh publishers,
+# crossing keyframe boundaries and surviving a retention compaction),
+# the damage gate (torn tail, corrupt keyframe, corrupt mid-chain
+# delta, eviction mid-read, crash-recovery restart — zero damaged
+# snapshots served, gaps answer 404 with nearest hints), gateway range
+# retention, and the -serve.feed_bytes budget enforcement
+# (docs/ARCHITECTURE.md "flowhistory" states the contract).
+history-parity:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_history.py -v
 
 # flowspread (models/spread.py, ops/spread.py): the distinct-count
 # family's citizenship gates, run against a FRESHLY BUILT library —

@@ -2472,6 +2472,234 @@ def bench_serve() -> None:
     }))
 
 
+HISTORY_FLOWS = 200_000      # warmup stream before the archived publishes
+HISTORY_PUBLISHES = 12       # archived trickle publishes (v2..v13)
+HISTORY_TRICKLE_FLOWS = 4096  # ~4s of modeled traffic between publishes
+HISTORY_KEYFRAME_EVERY = 4   # short cadence so the reconstruct sweep
+# covers depths 0..4 inside 13 versions (prod default is 64)
+HISTORY_PAIRS = 3            # archive-on vs archive-off A/B pairs
+HISTORY_RECON_REPS = 3       # cold reconstructs per archived version
+
+
+def bench_history() -> None:
+    """flowhistory acceptance artifact (ROADMAP item 6): what archiving
+    the delta chain COSTS and what time travel PAYS. Three claims: (1)
+    write amplification — archive bytes per publish, keyframe vs delta
+    coding split, at the append-mostly trickle cadence the codec
+    targets; (2) reconstruct latency vs chain depth — a cold reader
+    (nearest keyframe + delta replay, no state cache) per archived
+    version; (3) the archiver's dataplane-side cost — paired
+    alternating-order archive-on/off trickle legs (r11 methodology),
+    budget <2%. Replay BYTE-parity is a test gate (`make
+    history-parity`), not a benchmark statistic."""
+    import shutil
+    import tempfile
+
+    global _NATIVE
+    _NATIVE = _ensure_native()
+    from flow_pipeline_tpu.cli import (_batch_frames, _build_models,
+                                       _common_flags, _gen_flags,
+                                       _make_generator, _processor_flags)
+    from flow_pipeline_tpu.engine import StreamWorker, WorkerConfig
+    from flow_pipeline_tpu.gateway import SnapshotGateway
+    from flow_pipeline_tpu.history import (ArchiveReader, ArchiveWriter,
+                                           register_history_metrics)
+    from flow_pipeline_tpu.obs import REGISTRY
+    from flow_pipeline_tpu.serve import attach_worker
+    from flow_pipeline_tpu.transport import Consumer, InProcessBus
+    from flow_pipeline_tpu.utils.flags import FlagSet
+
+    register_history_metrics()
+    fs = _processor_flags(_gen_flags(_common_flags(FlagSet("bench"))))
+    vals = fs.parse(["-produce.profile", "zipf",
+                     "-produce.rate", "1000"])
+
+    def run_leg(archive_dir):
+        """One warm-ingest + trickle-publish leg. ``archive_dir`` set =
+        a gateway with an embedded ArchiveWriter mirrors every publish
+        (record + group commit + fsync per sync); None = the identical
+        gateway sync WITHOUT the archiver (the A/B baseline). Returns
+        (trickle flows/s, per-sync wall ms list)."""
+        bus = InProcessBus()
+        bus.create_topic("flows", 2)
+        gen = _make_generator(vals)
+        produced = 0
+        while produced < HISTORY_FLOWS:
+            bus.produce_many("flows", _batch_frames(gen.batch(16384)))
+            produced += 16384
+        worker = StreamWorker(
+            Consumer(bus, fixedlen=True), _build_models(vals), [],
+            WorkerConfig(poll_max=vals["processor.batch"],
+                         snapshot_every=0, ingest_native_group=True))
+        pub = attach_worker(worker, refresh=0.0)
+        while worker.run_once():
+            pass
+        with worker.lock:
+            pub.publish(worker)
+        writer = None
+        if archive_dir is not None:
+            writer = ArchiveWriter(archive_dir,
+                                   keyframe_every=HISTORY_KEYFRAME_EVERY)
+        gw = SnapshotGateway([pub.store], poll=60, archive=writer)
+        gw.sync_once()  # v1: the anchoring keyframe (outside the window)
+        sync_ms = []
+        t0 = time.perf_counter()
+        for _ in range(HISTORY_PUBLISHES):
+            bus.produce_many(
+                "flows", _batch_frames(gen.batch(HISTORY_TRICKLE_FLOWS)))
+            while worker.run_once():
+                pass
+            with worker.lock:
+                pub.publish(worker)
+            s0 = time.perf_counter()
+            gw.sync_once()
+            sync_ms.append(1000 * (time.perf_counter() - s0))
+        dt = time.perf_counter() - t0
+        if writer is not None:
+            writer.close()
+        rate = HISTORY_PUBLISHES * HISTORY_TRICKLE_FLOWS / dt if dt \
+            else 0.0
+        return rate, sync_ms
+
+    # ledger leg first (also the warm leg — XLA compile excluded from
+    # the A/B): counters are diffed across exactly this leg so the
+    # coding split is per-publish-attributable
+    recs0 = {k: REGISTRY.counter("history_records_total").value(kind=k)
+             for k in ("key", "delta")}
+    bytes0 = {k: REGISTRY.counter(
+        "history_record_bytes_total").value(kind=k)
+        for k in ("key", "delta")}
+    archive_dir = tempfile.mkdtemp(prefix="bench_history_")
+    try:
+        _, ledger_sync_ms = run_leg(archive_dir)
+        recs = {k: REGISTRY.counter(
+            "history_records_total").value(kind=k) - recs0[k]
+            for k in recs0}
+        rec_bytes = {k: REGISTRY.counter(
+            "history_record_bytes_total").value(kind=k) - bytes0[k]
+            for k in bytes0}
+        seg_files = sorted(f for f in os.listdir(archive_dir)
+                           if f.endswith(".fharc"))
+        archive_bytes = sum(
+            os.path.getsize(os.path.join(archive_dir, f))
+            for f in seg_files)
+        # seg-{version}.fharc — a segment STARTS at its keyframe, so
+        # depth(v) = v - newest segment start <= v
+        seg_starts = sorted(int(f[4:-6]) for f in seg_files)
+
+        # reconstruct sweep: a COLD reader per measurement (fresh scan,
+        # empty state cache) — the latency claimed is the worst case,
+        # not an LRU hit
+        reader = ArchiveReader(archive_dir)
+        versions = reader.versions()
+        by_depth: dict[int, list] = {}
+        for v in versions:
+            depth = v - max(s for s in seg_starts if s <= v)
+            for _ in range(HISTORY_RECON_REPS):
+                cold = ArchiveReader(archive_dir)
+                r0 = time.perf_counter()
+                cold.reconstruct(v)
+                by_depth.setdefault(depth, []).append(
+                    1000 * (time.perf_counter() - r0))
+        recon_ms = {str(d): round(statistics.median(ts), 3)
+                    for d, ts in sorted(by_depth.items())}
+    finally:
+        shutil.rmtree(archive_dir, ignore_errors=True)
+
+    # A/B: the archiver's cost to the gateway's publish-sync loop,
+    # paired alternating order (r11 methodology). Each pair gets a
+    # FRESH archive dir — retention must not skew later legs.
+    on_rates, off_rates, ratios = [], [], []
+    on_sync, off_sync = [], []
+    for i in range(HISTORY_PAIRS):
+        d = tempfile.mkdtemp(prefix="bench_history_ab_")
+        try:
+            if i % 2 == 0:
+                on, s_on = run_leg(d)
+                off, s_off = run_leg(None)
+            else:
+                off, s_off = run_leg(None)
+                on, s_on = run_leg(d)
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+        on_rates.append(on)
+        off_rates.append(off)
+        on_sync.extend(s_on)
+        off_sync.extend(s_off)
+        if off:
+            ratios.append(1 - on / off)
+    overhead = 100 * statistics.median(ratios) if ratios else 0.0
+    n_recs = recs["key"] + recs["delta"]
+    sync_on_med = statistics.median(on_sync) if on_sync else 0.0
+    sync_off_med = statistics.median(off_sync) if off_sync else 0.0
+    archiver_ms = sync_on_med - sync_off_med
+    # the budgeted claim: the archiver's per-publish wall against the
+    # SHIPPED 2s refresh cadence — the trickle loop compresses that
+    # cadence ~20x, so its raw on/off pct is the worst case, not the
+    # production cost
+    shipped_refresh_s = 2.0
+    overhead_shipped = 100 * archiver_ms / (1000 * shipped_refresh_s)
+
+    print(json.dumps({
+        "metric": "flowhistory archive write cost and time-travel "
+                  "reconstruct latency",
+        "unit": "pct of a gateway publish interval (shipped 2s "
+                "refresh) spent archiving",
+        "value": round(overhead_shipped, 2),
+        "overhead_budget_pct": 2.0,
+        "within_budget": overhead_shipped < 2.0,
+        "overhead_compressed_loop_pct": round(overhead, 2),
+        "overhead_pairs_pct": [round(100 * r, 2) for r in ratios],
+        "pairs": HISTORY_PAIRS,
+        "publishes": n_recs,
+        "keyframes": recs["key"],
+        "deltas": recs["delta"],
+        "keyframe_every": HISTORY_KEYFRAME_EVERY,
+        "bytes_per_keyframe": round(
+            rec_bytes["key"] / recs["key"], 1) if recs["key"] else None,
+        "bytes_per_delta": round(
+            rec_bytes["delta"] / recs["delta"], 1)
+        if recs["delta"] else None,
+        "delta_to_keyframe_bytes_ratio": round(
+            (rec_bytes["delta"] / recs["delta"])
+            / (rec_bytes["key"] / recs["key"]), 4)
+        if recs["delta"] and recs["key"] else None,
+        "archive_bytes_total": archive_bytes,
+        "segments": len(seg_files),
+        "sync_ms_archived_p50": round(sync_on_med, 3),
+        "sync_ms_plain_p50": round(sync_off_med, 3),
+        "archiver_ms_per_publish": round(archiver_ms, 3),
+        "shipped_refresh_s": shipped_refresh_s,
+        "ledger_sync_ms_p50": round(
+            statistics.median(ledger_sync_ms), 3)
+        if ledger_sync_ms else None,
+        "reconstruct_ms_by_depth": recon_ms,
+        "reconstruct_versions": len(versions),
+        "reconstruct_reps_per_version": HISTORY_RECON_REPS,
+        "flows_warmup": HISTORY_FLOWS,
+        "trickle_flows_per_publish": HISTORY_TRICKLE_FLOWS,
+        "replay_parity_gate": "make history-parity "
+                              "(tests/test_history.py — byte-identical "
+                              "replay, damage honesty)",
+        "native_decode": _NATIVE,
+        "platform": _PLATFORM,
+        "nproc": os.cpu_count(),
+        "host_note": (
+            "trickle legs compress ~4s of modeled event time per "
+            "publish into wall-clock milliseconds, so "
+            "overhead_compressed_loop_pct measures the fsync'd group "
+            "commit against an ARTIFICIALLY dense publish cadence — "
+            "the recorded worst case. The budgeted claim is the "
+            "paired per-publish archiver wall (sync_ms_archived - "
+            "sync_ms_plain, r11 alternating-order pairs) against the "
+            "shipped 2s refresh interval the gateway actually "
+            "publishes at. reconstruct_ms_by_depth is COLD (fresh "
+            "reader per call): depth 0 = keyframe hit, depth d = "
+            "keyframe + d delta applies with the unchanged gateway "
+            "codec"),
+    }))
+
+
 HH_SKETCH_PAIRS = 4
 
 
@@ -2871,6 +3099,8 @@ if __name__ == "__main__":
             bench_chaos()
         elif mode == "guard":
             bench_guard()
+        elif mode == "history":
+            bench_history()
         elif mode == "sweep":
             bench_sweep()
         elif mode == "kernels":

@@ -478,6 +478,10 @@ def _processor_flags(fs: FlagSet) -> FlagSet:
                                     "refresh cadence in seconds "
                                     "(snapshots always publish at window "
                                     "close; 0 = window-close only)")
+    fs.integer("serve.feed_bytes", 0,
+               "flowserve subscription-feed delta-chain byte budget; "
+               "subscribers further behind than the retained chain "
+               "take a full resync (0 = library default, 128 MiB)")
     return fs
 
 
@@ -633,6 +637,7 @@ def _start_serve_worker(vals, worker):
         pub.store, port, host,
         max_inflight=vals["guard.serve_queue"],
         deadline=vals["guard.serve_deadline"],
+        feed_bytes=vals["serve.feed_bytes"],
     ).set_guard(worker.guard).start()
     return server, pub.store
 
@@ -650,7 +655,8 @@ def _start_serve_mesh(vals, coordinator):
     server = ServeServer(
         pub.store, port, host,
         max_inflight=vals["guard.serve_queue"],
-        deadline=vals["guard.serve_deadline"]).start()
+        deadline=vals["guard.serve_deadline"],
+        feed_bytes=vals["serve.feed_bytes"]).start()
     return server, pub
 
 
@@ -1201,6 +1207,18 @@ def gateway_main(argv=None) -> int:
     fs.number("guard.serve_deadline", 0.1,
               "flowguard admission deadline seconds a query may wait "
               "for a compute slot before it is shed with 503")
+    fs.string("history.dir", "",
+              "flowhistory archive directory: persist the mirrored "
+              "delta chain and answer /query/range past upstream "
+              "retention plus ?at=/?version= time travel from this "
+              "replica (empty disables)")
+    fs.integer("history.keyframe", 64,
+               "flowhistory keyframe cadence: full snapshot every N "
+               "deltas (smaller = faster reconstruction, bigger "
+               "archive)")
+    fs.integer("history.retain", 1 << 30,
+               "flowhistory archive byte bound; whole oldest keyframe "
+               "segments are evicted past it")
     vals = fs.parse(argv if argv is not None else sys.argv[2:])
     set_level(vals["loglevel"])
     if not vals["gateway.upstream"]:
@@ -1212,16 +1230,33 @@ def gateway_main(argv=None) -> int:
 
     FAULTS.configure(vals["faults"])
     server = _start_metrics(vals["metrics.addr"], 8081)
+    archive = None
+    if vals["history.dir"]:
+        from .history import ArchiveWriter
+
+        archive = ArchiveWriter(vals["history.dir"],
+                                keyframe_every=vals["history.keyframe"],
+                                retain_bytes=vals["history.retain"])
     gw = SnapshotGateway(
         [u.strip() for u in vals["gateway.upstream"].split(",")
          if u.strip()],
         poll=vals["gateway.poll"],
-        adopt_restart=vals["gateway.adopt-restart"])
+        adopt_restart=vals["gateway.adopt-restart"],
+        archive=archive)
     host, port = _host_port(vals["gateway.listen"], 8084)
-    serve = ServeServer(
-        gw.store, port, host,
-        max_inflight=vals["guard.serve_queue"],
-        deadline=vals["guard.serve_deadline"]).start()
+    if archive is not None:
+        from .history import ArchiveReader, HistoryServer
+
+        serve = HistoryServer(
+            ArchiveReader(vals["history.dir"]), store=gw.store,
+            port=port, host=host,
+            max_inflight=vals["guard.serve_queue"],
+            deadline=vals["guard.serve_deadline"]).start()
+    else:
+        serve = ServeServer(
+            gw.store, port, host,
+            max_inflight=vals["guard.serve_queue"],
+            deadline=vals["guard.serve_deadline"]).start()
     gw.serve_on(serve).start()
     log.info("flowgate replica serving %s on http://%s:%d/query",
              vals["gateway.upstream"], host, serve.port)
@@ -1232,6 +1267,84 @@ def gateway_main(argv=None) -> int:
         pass
     finally:
         gw.stop()
+        serve.stop()
+        if archive is not None:
+            archive.close()
+        if server:
+            server.stop()
+    return 0
+
+
+def history_main(argv=None) -> int:
+    """flowhistory tier: subscribe to a flowserve surface (worker, mesh
+    coordinator, or gateway replica), archive the delta chain to disk
+    as keyframe segments, and serve time-travel queries —
+    ``/query/topk?at=``, ``/query/estimate?version=``, and
+    ``/query/range`` reaching past upstream retention — plus the live
+    head, mirrored like a gateway replica. See docs/ARCHITECTURE.md
+    "flowhistory"."""
+    fs = FlagSet("history")
+    fs.string("loglevel", "info", "Log level")
+    fs.string("history.upstream", "",
+              "Upstream flowserve host:port whose snapshot stream is "
+              "archived (a worker's/coordinator's -serve.addr or a "
+              "gateway's -gateway.listen)")
+    fs.string("history.listen", "127.0.0.1:8085",
+              "host:port the flowhistory tier serves /query/* and "
+              "/history/index on")
+    fs.string("history.dir", "./flowhistory",
+              "Archive directory for keyframe segments")
+    fs.integer("history.keyframe", 64,
+               "Keyframe cadence: full snapshot every N deltas "
+               "(smaller = faster reconstruction, bigger archive)")
+    fs.integer("history.retain", 1 << 30,
+               "Archive byte bound; whole oldest keyframe segments "
+               "are evicted past it")
+    fs.number("history.poll", 0.25,
+              "Subscription poll cadence in seconds")
+    fs.string("metrics.addr", "", "host:port for /metrics (empty "
+                                  "disables)")
+    fs.string("faults", "", "flowchaos deterministic fault plan",
+              env="FLOWTPU_FAULTS")
+    fs.integer("guard.serve_queue", 0,
+               "flowguard read-side admission: max concurrently "
+               "computing queries; past it + the deadline, 503 with "
+               "Retry-After (0 = unbounded)")
+    fs.number("guard.serve_deadline", 0.1,
+              "flowguard admission deadline seconds a query may wait "
+              "for a compute slot before it is shed with 503")
+    vals = fs.parse(argv if argv is not None else sys.argv[2:])
+    set_level(vals["loglevel"])
+    if not vals["history.upstream"]:
+        log.error("history needs -history.upstream host:port")
+        return 2
+    from .history import ArchiveReader, ArchiveWriter, HistoryServer
+    from .utils.faults import FAULTS
+
+    FAULTS.configure(vals["faults"])
+    server = _start_metrics(vals["metrics.addr"], 8081)
+    host, port = _host_port(vals["history.listen"], 8085)
+    serve = HistoryServer(
+        ArchiveReader(vals["history.dir"]),
+        port=port, host=host,
+        max_inflight=vals["guard.serve_queue"],
+        deadline=vals["guard.serve_deadline"]).start()
+    writer = ArchiveWriter(vals["history.dir"],
+                           keyframe_every=vals["history.keyframe"],
+                           retain_bytes=vals["history.retain"],
+                           upstream=vals["history.upstream"],
+                           poll=vals["history.poll"],
+                           store=serve.store).start()
+    log.info("flowhistory archiving %s into %s, serving on "
+             "http://%s:%d/query", vals["history.upstream"],
+             vals["history.dir"], host, serve.port)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        writer.stop()
         serve.stop()
         if server:
             server.stop()
@@ -1312,6 +1425,7 @@ _COMMANDS = {
     "lineage": lineage_main,
     "replay": replay_main,
     "gateway": gateway_main,
+    "history": history_main,
 }
 
 
@@ -1319,7 +1433,8 @@ def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if not argv or argv[0] in ("-h", "-help", "--help"):
         print("usage: flow_pipeline_tpu.cli <mocker|processor|inserter|"
-              "pipeline|collector|lineage|replay|gateway> [-flags]\n"
+              "pipeline|collector|lineage|replay|gateway|history> "
+              "[-flags]\n"
               "Run '<cmd> -help' for flags.")
         return 0 if argv else 2
     cmd = _COMMANDS.get(argv[0])
@@ -1363,6 +1478,10 @@ def replay_entry() -> None:
 
 def gateway_entry() -> None:
     sys.exit(main(["gateway"] + sys.argv[1:]))
+
+
+def history_entry() -> None:
+    sys.exit(main(["history"] + sys.argv[1:]))
 
 
 if __name__ == "__main__":

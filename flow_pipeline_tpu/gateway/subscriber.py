@@ -168,9 +168,15 @@ class SnapshotGateway:
 
     def __init__(self, upstreams, poll: float = 0.25,
                  timeout: float = 10.0, prerender: bool = True,
-                 adopt_restart: bool = False):
+                 adopt_restart: bool = False, archive=None):
         if not upstreams:
             raise ValueError("at least one upstream is required")
+        # -history.dir: a flowhistory ArchiveWriter riding the PRIMARY
+        # mirror thread — every applied transition is archived, so the
+        # replica's /query/range reaches past upstream RANGE_SLOTS and
+        # ?at=/?version= time travel answers from the same process
+        # flowlint: unguarded -- bound once at construction
+        self.archive = archive
         # -gateway.adopt-restart: swap to an upstream's post-restart
         # stream automatically (availability) instead of holding the
         # pre-restart snapshot until an operator restarts this replica
@@ -244,18 +250,28 @@ class SnapshotGateway:
                                                 upstream=up.name)
                 continue
             if t == "full":
-                up.state = tree["state"]
+                # chain continuity across a full resync is unknown, so
+                # the archive (if any) anchors a fresh keyframe
+                prev, up.state = None, tree["state"]
                 kind = "full"
             elif t == "delta":
                 if up.state is None:
                     raise DeltaGapError("delta frame with no local base")
+                prev = up.state
                 up.state = apply_delta(up.state, tree)
                 if kind != "full":
                     kind = "delta"
             else:
                 raise DeltaError(f"unknown frame kind {t!r}")
+            if self.archive is not None and up is self.upstreams[0]:
+                # archive the PRIMARY stream's transition before the
+                # publish: the archived chain is exactly what the serve
+                # surface answers from (record-and-replay parity)
+                self.archive.record(prev, up.state)
             self._m["upstream_version"].set(up.version, upstream=up.name)
         self._m["syncs"].inc(kind=kind)
+        if kind != "none" and self.archive is not None:
+            self.archive.commit()  # group commit per poll, not per frame
         if kind != "none":
             self._m["sync_bytes"].inc(len(data), kind=kind)
             snap = up.store.publish_snapshot(state_to_snapshot(up.state))

@@ -1,0 +1,22 @@
+"""flowhistory: durable snapshot archive + time-travel query surface.
+
+See :mod:`flow_pipeline_tpu.history.archive` for the durability and
+damage story, :mod:`flow_pipeline_tpu.history.server` for the read
+surface.
+"""
+
+from .archive import (HISTORY_METRICS, KEYFRAME_EVERY, RETAIN_BYTES,
+                      ArchiveReader, ArchiveWriter, HistoryGapError,
+                      register_history_metrics)
+from .server import HistoryServer
+
+__all__ = [
+    "HISTORY_METRICS",
+    "KEYFRAME_EVERY",
+    "RETAIN_BYTES",
+    "ArchiveReader",
+    "ArchiveWriter",
+    "HistoryGapError",
+    "HistoryServer",
+    "register_history_metrics",
+]

@@ -148,7 +148,7 @@ class ServeServer:
 
     def __init__(self, store: SnapshotStore, port: int = 8083,
                  host: str = "127.0.0.1", max_inflight: int = 0,
-                 deadline: float = 0.1):
+                 deadline: float = 0.1, feed_bytes: int = 0):
         from ..guard import register_guard_metrics
 
         self.store = store
@@ -156,6 +156,12 @@ class ServeServer:
             raise ValueError(
                 f"serve admission deadline must be >= 0, got {deadline}")
         self.deadline = deadline
+        if feed_bytes < 0:
+            raise ValueError(
+                f"serve feed byte budget must be >= 0, got {feed_bytes}")
+        # -serve.feed_bytes: the subscription feed's delta-chain byte
+        # budget (0 = the library default, gateway/feed.py)
+        self.feed_bytes = feed_bytes
         self._sem = (threading.BoundedSemaphore(max_inflight)
                      if max_inflight > 0 else None)
         self.m_shed = register_guard_metrics()["shed"]
@@ -310,7 +316,9 @@ class ServeServer:
         if self._feed is None:
             from ..gateway.feed import SnapshotFeed
 
-            self._feed = SnapshotFeed(self.store)
+            self._feed = SnapshotFeed(self.store) if not self.feed_bytes \
+                else SnapshotFeed(self.store,
+                                  history_bytes=self.feed_bytes)
         q = {k: v[0] for k, v in parse_qs(url.query).items()}
         kind, cur, frames = self._feed.frame_since(
             int(q.get("since", 0)))

@@ -53,10 +53,13 @@ KNOWN_FLAGS = frozenset({
     # flowtpu-replay (the dead-letter re-ingestion subcommand)
     "replay.dir", "replay.delete",
     # flowserve (serve/)
-    "serve.addr", "serve.refresh",
+    "serve.addr", "serve.refresh", "serve.feed_bytes",
     # flowgate (gateway/)
     "gateway.listen", "gateway.upstream", "gateway.poll",
     "gateway.adopt-restart",
+    # flowhistory (history/) — durable snapshot archive + time travel
+    "history.dir", "history.keyframe", "history.retain",
+    "history.upstream", "history.listen", "history.poll",
     # flowmesh (mesh/)
     "mesh.workers", "mesh.role", "mesh.coordinator", "mesh.id",
     "mesh.listen", "mesh.heartbeat", "mesh.journal",

@@ -399,6 +399,53 @@ class TestGrafana:
         assert "sketch_spread_audit_sampled_keys" in exprs
         assert "sketch_spread_audit_cohort_overflow_total" in exprs
 
+    def test_pipeline_dashboard_flowhistory_panels(self):
+        """Round-22 flowhistory panels: archive write health (record
+        rate by kind, on-disk bytes after retention, eviction rate)
+        next to the read side (reconstruction p99 latency and chain
+        depth, archive lag, gap 404s and damage skips — the honesty
+        surface)."""
+        with open(os.path.join(DEPLOY, "grafana", "dashboards",
+                               "pipeline.json")) as f:
+            dash = json.load(f)
+        panels = {p["title"]: p for p in dash["panels"]}
+        arch = panels["Flowhistory archive (record rate, bytes, "
+                      "eviction)"]
+        exprs = " ".join(t["expr"] for t in arch["targets"])
+        assert "history_records_total" in exprs
+        assert "history_record_bytes_total" in exprs
+        assert "history_archive_bytes" in exprs
+        assert "history_evicted_segments_total" in exprs
+        legends = " ".join(t["legendFormat"] for t in arch["targets"])
+        assert "{{kind}}" in legends  # key vs delta split
+        rec = panels["Flowhistory reconstruction (latency, depth, "
+                     "gaps)"]
+        exprs = " ".join(t["expr"] for t in rec["targets"])
+        assert "history_reconstruct_seconds_bucket" in exprs
+        assert "history_reconstruct_depth_bucket" in exprs
+        assert "histogram_quantile(0.99" in exprs and "by (le)" in exprs
+        assert "history_lag_versions" in exprs
+        assert "history_gap_answers_total" in exprs
+        assert "history_damage_skipped_total" in exprs
+
+    def test_mesh_topology_history_tier(self):
+        """Round-22 flowhistory compose: one archiver/time-travel
+        service subscribed to the coordinator's snapshot feed, its
+        segment archive on a durable named volume (restart:always +
+        fsync discipline = a crash recovers into a fresh keyframe
+        segment), with a real /healthz healthcheck."""
+        doc = load("compose/mesh.yml")
+        services = doc["services"]
+        svc = services["history"]
+        cmd = svc["command"]
+        assert "flowtpu-history" in cmd
+        assert "-history.upstream coordinator:8083" in cmd
+        assert "-history.dir /data/history" in cmd
+        assert "-history.listen" in cmd
+        assert svc.get("restart") == "always"
+        assert any(v.endswith(":/data") for v in svc["volumes"])
+        assert "8086/healthz" in " ".join(svc["healthcheck"]["test"])
+
     def test_mesh_topology_gateway_tier(self):
         """Round-18 flowgate compose: two stateless gateway replicas
         front the coordinator's snapshot stream (the '2 gateways over
@@ -512,6 +559,8 @@ class TestDashboardHonesty:
                   "instance",
                   # sketch-audit family label (by-clause key)
                   "family",
+                  # flowhistory record-kind label (by-clause key)
+                  "kind",
                   # binary-op/matching keywords (alert exprs)
                   "and", "or", "unless", "on", "ignoring"}
     SQL_KEYWORDS = {"select", "from", "where", "group", "by", "order",
@@ -560,6 +609,7 @@ class TestDashboardHonesty:
         from flow_pipeline_tpu.engine import Supervisor
 
         from flow_pipeline_tpu.gateway import SnapshotGateway
+        from flow_pipeline_tpu.history import register_history_metrics
         from flow_pipeline_tpu.mesh import MeshCoordinator, MeshMember
         from flow_pipeline_tpu.models.ddos import DDoSDetector
         from flow_pipeline_tpu.models.spread import SpreadModel
@@ -581,6 +631,7 @@ class TestDashboardHonesty:
         DDoSDetector()  # flow_entropy gauges (eager registration)
         SpreadModel()  # spread_top_max (eager registration)
         SpreadAudit({})  # sketch_spread_* audit families
+        register_history_metrics()  # history_* archive families
         assert _faults.FAULTS.m_injected is not None  # faults_injected
         names = set(reg._metrics) | set(REGISTRY._metrics)
         for text in (reg.render(), REGISTRY.render()):
@@ -661,6 +712,13 @@ class TestDashboardHonesty:
         assert 'model="portscan"' in by_name["PortScanDetected"]["expr"]
         ent = by_name["EntropyCollapse"]["expr"]
         assert "flow_entropy" in ent and "flow_entropy_baseline" in ent
+        # the flowhistory rules the r22 satellite names: a damaged
+        # archive segment pages (those versions are gone forever), and
+        # so does the archive lagging the live feed
+        assert "history_damage_skipped_total" in \
+            by_name["HistoryArchiveDamaged"]["expr"]
+        assert "history_lag_versions" in \
+            by_name["HistoryArchiveLagging"]["expr"]
 
     def test_alerts_wired_into_prometheus_and_compose(self):
         """The rules file must actually be evaluated: prometheus.yml

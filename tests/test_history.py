@@ -1,0 +1,904 @@
+"""flowhistory: durable snapshot archive + time-travel query surface.
+
+The acceptance gates this file carries:
+
+- **Record-and-replay parity**: during a live run, every ``/query/*``
+  answer is recorded at the version it was served; afterwards the same
+  query with ``?version=`` (and ``?at=``) against the archive must
+  answer BYTE-IDENTICAL — for table and invertible sketches, spread
+  families, and the mesh publisher (slow leg), including chains that
+  cross keyframe boundaries and survive a retention compaction.
+- **Damage gate**: torn tails, CRC-corrupted keyframes, CRC-corrupted
+  mid-chain deltas, and eviction mid-read all skip to the next intact
+  keyframe — zero damaged snapshots served, gaps answer 404 with
+  nearest-version hints, and a writer crash mid-append leaves a
+  recoverable archive.
+- **-serve.feed_bytes** (satellite): the promoted feed byte budget is
+  enforced at the configured value.
+- **Gateway range retention** (satellite): a gateway given
+  ``-history.dir`` answers ``/query/range`` for slots older than the
+  live window, bit-exact vs the rows the live path served when those
+  slots were current.
+
+The slow mesh leg runs in ``make history-parity`` / CI.
+"""
+
+import json
+import os
+import tempfile
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.engine import (StreamWorker, WindowedHeavyHitter,
+                                      WorkerConfig)
+from flow_pipeline_tpu.gateway import SnapshotGateway
+from flow_pipeline_tpu.gateway.delta import (encode_delta, snapshot_state,
+                                             state_to_snapshot)
+from flow_pipeline_tpu.gateway.feed import SnapshotFeed
+from flow_pipeline_tpu.gen import FlowGenerator, ZipfProfile
+from flow_pipeline_tpu.history import (ArchiveReader, ArchiveWriter,
+                                       HistoryGapError, HistoryServer)
+from flow_pipeline_tpu.models import (HeavyHitterConfig, WindowAggConfig,
+                                      WindowAggregator)
+from flow_pipeline_tpu.serve import ServeServer, SnapshotStore
+from flow_pipeline_tpu.serve.publisher import WorkerServePublisher
+from flow_pipeline_tpu.sink import MemorySink
+from flow_pipeline_tpu.transport import Consumer, InProcessBus, Producer
+
+T0 = 1_699_999_800  # window-aligned stream start
+
+
+def _get_raw(port, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10).read()
+
+
+def _get(port, path):
+    return json.loads(_get_raw(port, path))
+
+
+def _fetch(port, path):
+    """(status, body) — errors are answers too; a 400 the live path
+    served must replay as the same 400."""
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10)
+        return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _fill_bus(batches=8, per=500, rate=5.0, seed=91,
+              spread_fraction=0.0):
+    bus = InProcessBus()
+    bus.create_topic("flows", 1)
+    profile = ZipfProfile(n_keys=100, alpha=1.3,
+                          **({"spread_fraction": spread_fraction}
+                             if spread_fraction else {}))
+    gen = FlowGenerator(profile, seed=seed, t0=T0, rate=rate)
+    prod = Producer(bus, fixedlen=True)
+    for _ in range(batches):
+        prod.send_many(gen.batch(per).to_messages())
+    return bus
+
+
+def _models(hh_sketch="table"):
+    return {
+        "flows_5m": WindowAggregator(WindowAggConfig(batch_size=512)),
+        "top_talkers": WindowedHeavyHitter(
+            HeavyHitterConfig(batch_size=512, width=1 << 12, capacity=64,
+                              hh_sketch=hh_sketch),
+            k=10),
+    }
+
+
+def _quiesce(worker):
+    """Stop the pipeline threads once the bus is drained (leaked
+    daemon pollers pollute FAULTS counters suite-wide)."""
+    if worker.executor is not None:
+        worker.executor.stop()
+    if worker.flusher is not None:
+        worker.flusher.stop()
+    stop_feed = getattr(worker.consumer, "stop", None)
+    if stop_feed is not None:
+        stop_feed()
+
+
+# ---- synthetic canonical states (the delta-codec test shape) ---------------
+
+
+def _mk_state(version, *, width=8, bump=0):
+    """One hh family (+u64 CMS planes), one dense family, one range
+    table whose slot set slides with ``bump`` — the delta-codec test
+    state, reused so the archive inherits its edge coverage."""
+    rng = np.random.default_rng(7)
+    cms = rng.integers(0, 1000, size=(3, 2, width)).astype(np.uint64)
+    if bump:
+        cms[0, 1, bump % width] += np.uint64(bump)
+    rows = {
+        "src_addr": np.arange(4, dtype=np.uint32) + np.uint32(bump),
+        "bytes": np.asarray([9.0, 5.0, 3.0, 1.0], np.float32),
+        "valid": np.asarray([True, True, True, False]),
+    }
+    return {
+        "version": int(version), "created": 100.0 + version,
+        "watermark": float(T0 + 300 * version), "flows_seen": 10 * version,
+        "source": "worker",
+        "families": {
+            "hh": {"kind": "hh", "window_start": T0, "depth": 4,
+                   "key_lanes": 2, "value_cols": ["bytes"],
+                   "rows": rows, "cms": cms},
+            "dense": {"kind": "dense", "window_start": T0, "depth": 4,
+                      "key_lanes": 1, "value_cols": [],
+                      "rows": {"port": np.arange(4, dtype=np.uint32)},
+                      "cms": None},
+        },
+        "ranges": {"flows_5m": [
+            [T0, {"timeslot": np.asarray([T0, T0], np.int64),
+                  "bytes": np.asarray([1, 2], np.uint64)}],
+            [T0 + 300 * max(1, bump),
+             {"timeslot": np.asarray([T0 + 300], np.int64),
+              "bytes": np.asarray([3 + bump], np.uint64)}],
+        ]},
+        "audit": {"hh": {"cms_err": 0.0, "windows": version}},
+    }
+
+
+def _assert_states_equal(a, b):
+    assert a["version"] == b["version"]
+    assert a["created"] == b["created"]
+    assert a["watermark"] == b["watermark"]
+    assert a["flows_seen"] == b["flows_seen"]
+    assert set(a["families"]) == set(b["families"])
+    for name, f in a["families"].items():
+        g = b["families"][name]
+        for k in ("kind", "window_start", "depth", "key_lanes"):
+            assert f[k] == g[k], (name, k)
+        assert list(f["value_cols"]) == list(g["value_cols"])
+        assert set(f["rows"]) == set(g["rows"])
+        for c in f["rows"]:
+            x, y = np.asarray(f["rows"][c]), np.asarray(g["rows"][c])
+            assert x.dtype == y.dtype and np.array_equal(x, y), (name, c)
+        if f["cms"] is None:
+            assert g["cms"] is None
+        else:
+            assert g["cms"] is not None
+            assert f["cms"].dtype == g["cms"].dtype
+            assert np.array_equal(f["cms"], g["cms"])
+    assert set(a["ranges"]) == set(b["ranges"])
+    for t, slots in a["ranges"].items():
+        gslots = b["ranges"][t]
+        assert [int(s) for s, _ in slots] == [int(s) for s, _ in gslots]
+        for (_, rows), (_, grows) in zip(slots, gslots):
+            assert set(rows) == set(grows)
+            for c in rows:
+                assert np.array_equal(np.asarray(rows[c]),
+                                      np.asarray(grows[c]))
+    assert a["audit"] == b["audit"]
+
+
+def _archive_states(dir_, states, keyframe_every=3, **kw):
+    w = ArchiveWriter(dir_, keyframe_every=keyframe_every, **kw)
+    prev = None
+    for s in states:
+        w.record(prev, s)
+        prev = s
+    w.commit()
+    w.close()
+    return w
+
+
+def _rec_index(dir_):
+    """[(segment path, [record dicts])] — test access to the scan for
+    computing corruption offsets."""
+    r = ArchiveReader(dir_)
+    with r._lock:
+        return [(p, list(recs)) for p, recs in r._scan_locked()]
+
+
+def _flip_byte(path, off):
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+# ---- archive round trip (unit, synthetic states) ---------------------------
+
+
+class TestArchiveRoundTrip:
+    def test_every_version_reconstructs_exactly(self, tmp_path):
+        states = [_mk_state(i + 1, bump=i) for i in range(10)]
+        _archive_states(str(tmp_path), states, keyframe_every=3)
+        r = ArchiveReader(str(tmp_path))
+        assert r.versions() == list(range(1, 11))
+        # chains of up to 3 deltas: versions 2-4, 6-8, 10 replay
+        # through apply_delta; 1, 5, 9 are keyframe hits
+        for s in states:
+            _assert_states_equal(r.reconstruct(s["version"]), s)
+
+    def test_segments_rotate_on_keyframe(self, tmp_path):
+        states = [_mk_state(i + 1, bump=i) for i in range(7)]
+        _archive_states(str(tmp_path), states, keyframe_every=3)
+        segs = sorted(p for p in os.listdir(str(tmp_path))
+                      if p.endswith(".fharc"))
+        # keyframes at v1, v5 (after 3 deltas), each its own segment
+        assert segs == ["seg-%020d.fharc" % 1, "seg-%020d.fharc" % 5]
+
+    def test_restart_starts_a_new_keyframe_segment(self, tmp_path):
+        states = [_mk_state(i + 1, bump=i) for i in range(8)]
+        _archive_states(str(tmp_path), states[:5], keyframe_every=100)
+        w = ArchiveWriter(str(tmp_path), keyframe_every=100)
+        assert w.last_version == 5
+        prev = states[4]
+        for s in states[5:]:
+            w.record(prev, s)
+            prev = s
+        w.commit()
+        w.close()
+        r = ArchiveReader(str(tmp_path))
+        assert r.versions() == list(range(1, 9))
+        # the post-restart chain anchors a NEW segment at v6 even
+        # though the cadence would have allowed a delta
+        assert os.path.exists(
+            os.path.join(str(tmp_path), "seg-%020d.fharc" % 6))
+        for s in states:
+            _assert_states_equal(r.reconstruct(s["version"]), s)
+
+    def test_backwards_version_is_refused(self, tmp_path):
+        w = ArchiveWriter(str(tmp_path))
+        w.record(None, _mk_state(5))
+        assert w.record(None, _mk_state(3)) == "skip"
+        assert w.record(None, _mk_state(5)) == "skip"
+        w.commit()
+        w.close()
+        assert ArchiveReader(str(tmp_path)).versions() == [5]
+
+    def test_retention_evicts_whole_oldest_segments(self, tmp_path):
+        states = [_mk_state(i + 1, bump=i) for i in range(9)]
+        _archive_states(str(tmp_path), states, keyframe_every=2)
+        total = sum(os.path.getsize(os.path.join(str(tmp_path), p))
+                    for p in os.listdir(str(tmp_path)))
+        # re-open with a budget that forces out the oldest segment(s)
+        w = ArchiveWriter(str(tmp_path), retain_bytes=total // 2)
+        w.commit()
+        w.close()
+        r = ArchiveReader(str(tmp_path))
+        kept = r.versions()
+        assert kept and kept[-1] == 9
+        assert len(kept) < 9
+        # kept versions: still exact; evicted: honest gap with hints
+        for s in states:
+            if s["version"] in kept:
+                _assert_states_equal(r.reconstruct(s["version"]), s)
+            else:
+                with pytest.raises(HistoryGapError) as ei:
+                    r.reconstruct(s["version"])
+                assert ei.value.before is None  # whole prefix evicted
+                assert ei.value.after == kept[0]
+
+    def test_retention_never_evicts_the_last_segment(self, tmp_path):
+        states = [_mk_state(i + 1, bump=i) for i in range(4)]
+        _archive_states(str(tmp_path), states, keyframe_every=2,
+                        retain_bytes=1)  # absurd bound: 1 byte
+        r = ArchiveReader(str(tmp_path))
+        # the newest segment survives any bound
+        assert r.versions() == [4]
+
+    def test_version_at_resolves_newest_at_or_before(self, tmp_path):
+        states = [_mk_state(v) for v in (1, 2, 3)]  # created = 101..103
+        _archive_states(str(tmp_path), states)
+        r = ArchiveReader(str(tmp_path))
+        assert r.version_at(100.5) is None  # predates the archive
+        assert r.version_at(101.0) == 1
+        assert r.version_at(102.7) == 2
+        assert r.version_at(1e12) == 3
+
+    def test_slot_index_maps_slots_to_newest_holder(self, tmp_path):
+        # bump slides the second range slot: older slots stay indexed
+        # at the newest version that still held them
+        states = [_mk_state(i + 1, bump=i) for i in range(4)]
+        _archive_states(str(tmp_path), states)
+        idx = ArchiveReader(str(tmp_path)).slot_index()["flows_5m"]
+        assert idx[T0] == 4            # held by every version
+        assert idx[T0 + 300] == 2      # bump=1 (v2) held slot T0+300
+        assert idx[T0 + 900] == 4      # bump=3 (v4)
+
+
+# ---- damage gate -----------------------------------------------------------
+
+
+class TestArchiveDamage:
+    def _states(self, n=9):
+        return [_mk_state(i + 1, bump=i) for i in range(n)]
+
+    def test_torn_tail_drops_only_the_tail(self, tmp_path):
+        states = self._states()
+        _archive_states(str(tmp_path), states, keyframe_every=3)
+        segs = _rec_index(str(tmp_path))
+        last_seg = segs[-1][0]
+        os.truncate(last_seg, os.path.getsize(last_seg) - 5)
+        r = ArchiveReader(str(tmp_path))
+        assert r.versions() == list(range(1, 9))  # v9 torn away
+        for s in states[:8]:
+            _assert_states_equal(r.reconstruct(s["version"]), s)
+        with pytest.raises(HistoryGapError) as ei:
+            r.reconstruct(9)
+        assert ei.value.before == 8 and ei.value.after is None
+
+    def test_writer_crash_mid_append_is_recoverable(self, tmp_path):
+        """The journal torn-tail discipline: a crash mid-append leaves
+        a torn last record; a restarted writer never touches the torn
+        segment and anchors a fresh keyframe segment."""
+        states = self._states(6)
+        _archive_states(str(tmp_path), states[:5], keyframe_every=100)
+        segs = _rec_index(str(tmp_path))
+        os.truncate(segs[-1][0], os.path.getsize(segs[-1][0]) - 3)
+        w = ArchiveWriter(str(tmp_path), keyframe_every=100)
+        assert w.last_version == 4  # the torn v5 is not resumable
+        assert w.record(states[4], states[5]) == "key"
+        w.commit()
+        w.close()
+        r = ArchiveReader(str(tmp_path))
+        assert r.versions() == [1, 2, 3, 4, 6]
+        _assert_states_equal(r.reconstruct(6), states[5])
+        with pytest.raises(HistoryGapError) as ei:
+            r.reconstruct(5)
+        assert (ei.value.before, ei.value.after) == (4, 6)
+
+    def test_corrupt_keyframe_gaps_the_whole_segment(self, tmp_path):
+        states = self._states()
+        _archive_states(str(tmp_path), states, keyframe_every=2)
+        segs = _rec_index(str(tmp_path))
+        assert len(segs) == 3  # keyframes at 1, 4, 7
+        mid_path, mid_recs = segs[1]
+        assert mid_recs[0]["t"] == "key"
+        _flip_byte(mid_path, mid_recs[0]["off"])
+        r = ArchiveReader(str(tmp_path))
+        # the middle segment (v4-6) is unusable; neighbors still serve
+        assert r.versions() == [1, 2, 3, 7, 8, 9]
+        for v in (4, 5, 6):
+            with pytest.raises(HistoryGapError) as ei:
+                r.reconstruct(v)
+            assert (ei.value.before, ei.value.after) == (3, 7)
+        for s in states:
+            if s["version"] not in (4, 5, 6):
+                _assert_states_equal(r.reconstruct(s["version"]), s)
+
+    def test_corrupt_delta_mid_chain_gaps_the_rest(self, tmp_path):
+        states = self._states(6)
+        _archive_states(str(tmp_path), states, keyframe_every=100)
+        (path, recs), = _rec_index(str(tmp_path))
+        assert recs[3]["t"] == "dlt"  # v4
+        _flip_byte(path, recs[3]["off"])
+        r = ArchiveReader(str(tmp_path))
+        # keyframe + intact prefix serve; v4 onward is gapped (deltas
+        # past the damage have no anchor)
+        assert r.versions() == [1, 2, 3]
+        for s in states[:3]:
+            _assert_states_equal(r.reconstruct(s["version"]), s)
+        for v in (4, 5, 6):
+            with pytest.raises(HistoryGapError) as ei:
+                r.reconstruct(v)
+            assert (ei.value.before, ei.value.after) == (3, None)
+
+    def test_eviction_mid_read_answers_a_gap(self, tmp_path, monkeypatch):
+        """The file vanishing between index and read (retention racing
+        a query) must answer a gap with FRESH hints — never a crash,
+        never a partial snapshot."""
+        states = self._states()
+        _archive_states(str(tmp_path), states, keyframe_every=3)
+        r = ArchiveReader(str(tmp_path))
+        with r._lock:
+            stale = [(p, list(recs)) for p, recs in r._scan_locked()]
+        os.remove(stale[0][0])  # evict the segment holding v1-4
+        real = r._scan_locked
+        calls = {"n": 0}
+
+        def flaky_scan():
+            calls["n"] += 1
+            return stale if calls["n"] == 1 else real()
+
+        monkeypatch.setattr(r, "_scan_locked", flaky_scan)
+        with pytest.raises(HistoryGapError) as ei:
+            r.reconstruct(2)
+        assert ei.value.before is None and ei.value.after == 5
+
+    def test_damage_is_counted(self, tmp_path):
+        from flow_pipeline_tpu.history import register_history_metrics
+
+        m = register_history_metrics()
+        before = m["damage"].value()
+        states = self._states(4)
+        _archive_states(str(tmp_path), states, keyframe_every=100)
+        (path, recs), = _rec_index(str(tmp_path))
+        _flip_byte(path, recs[1]["off"])
+        ArchiveReader(str(tmp_path)).versions()
+        assert m["damage"].value() > before
+
+
+try:  # property test where hypothesis exists (repo convention)
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    @settings(max_examples=10, deadline=None)
+    @given(st.lists(st.integers(0, 1000), min_size=2, max_size=8),
+           st.integers(1, 4))
+    def test_archive_round_trip_property(bumps, keyframe_every):
+        """Any state sequence archives and reconstructs exactly at any
+        keyframe cadence — arrays bit-identical, dtypes preserved."""
+        with tempfile.TemporaryDirectory() as d:
+            states = [_mk_state(i + 1, bump=b)
+                      for i, b in enumerate(bumps)]
+            _archive_states(d, states, keyframe_every=keyframe_every)
+            r = ArchiveReader(d)
+            for s in states:
+                _assert_states_equal(r.reconstruct(s["version"]), s)
+except ImportError:  # pragma: no cover
+    pass
+
+
+# ---- record-and-replay parity (worker publisher) ---------------------------
+
+
+PARITY_PATHS = (
+    "/query/topk", "/query/topk?k=0", "/query/topk?k=5",
+    "/query/topk?model=top_talkers&k=10",
+    "/query/topk?model=flows_5m&k=3",
+    "/query/range", "/query/range?model=flows_5m",
+    "/query/audit",
+)
+
+
+def _record_and_archive(tmp_path, hh_sketch="table", keyframe_every=2,
+                        **worker_kw):
+    """Drive a worker publishing per batch; record every live answer at
+    the version it was served while a gateway with an embedded
+    ArchiveWriter mirrors the stream into the archive. Returns
+    (recorded {(version, path): bytes}, live store, gateway)."""
+    worker = StreamWorker(
+        Consumer(_fill_bus(), fixedlen=True), _models(hh_sketch),
+        [MemorySink()],
+        WorkerConfig(snapshot_every=0, poll_max=512, **worker_kw))
+    pub = WorkerServePublisher(refresh=0.0).attach(worker)
+    serve = ServeServer(pub.store, port=0).start()
+    writer = ArchiveWriter(str(tmp_path), keyframe_every=keyframe_every)
+    gw = SnapshotGateway([pub.store], poll=60, archive=writer)
+    recorded = {}
+    paths = None
+    try:
+        while True:
+            more = worker.run_once()
+            with worker.lock:
+                pub.publish(worker)
+            gw.sync_once()
+            if paths is None:
+                fam = pub.store.current.families["top_talkers"]
+                key = ",".join("7" for _ in range(fam.key_lanes))
+                paths = PARITY_PATHS + (
+                    f"/query/estimate?model=top_talkers&key={key}",)
+            version = pub.store.current.version
+            for path in paths:
+                if (version, path) not in recorded:
+                    recorded[(version, path)] = \
+                        _fetch(serve.port, path)
+            if not more:
+                break
+    finally:
+        serve.stop()
+        writer.close()
+        _quiesce(worker)
+    return recorded, pub.store, gw
+
+
+def _assert_replay_parity(tmp_path, recorded, store, gw):
+    reader = ArchiveReader(str(tmp_path))
+    archived = set(reader.versions())
+    versions = {v for v, _ in recorded}
+    assert len(versions) >= 4, "need a multi-version run"
+    assert versions <= archived, "every served version is archived"
+    hs = HistoryServer(reader, store=gw.store, port=0).start()
+    try:
+        replayed = 0
+        for (version, path), live in sorted(recorded.items()):
+            sep = "&" if "?" in path else "?"
+            got = _fetch(hs.port, f"{path}{sep}version={version}")
+            assert got == live, (version, path)
+            replayed += 1
+        assert replayed == len(recorded)
+        # ?at= resolves through created stamps to the same bytes
+        for version in sorted(versions):
+            snap = reader.snapshot(version)
+            got = _fetch(hs.port,
+                         f"/query/topk?at={snap.created!r}")
+            assert got == recorded[(version, "/query/topk")]
+    finally:
+        hs.stop()
+    return reader
+
+
+class TestRecordAndReplayParity:
+    """Acceptance: archive answers == live answers, byte for byte."""
+
+    @pytest.fixture(scope="class", params=["table", "invertible"])
+    def run(self, request, tmp_path_factory):
+        kw = {}
+        if request.param == "invertible":
+            kw = dict(sketch_backend="host", host_assist="on")
+        tmp = tmp_path_factory.mktemp(f"hist-{request.param}")
+        recorded, store, gw = _record_and_archive(
+            tmp, hh_sketch=request.param, **kw)
+        return tmp, recorded, store, gw
+
+    def test_replay_is_byte_identical(self, run):
+        tmp, recorded, store, gw = run
+        reader = _assert_replay_parity(tmp, recorded, store, gw)
+        # keyframe_every=2 guarantees reconstructions replayed deltas
+        # across keyframe boundaries, not just keyframe hits
+        assert len(reader.versions()) > 2
+
+    def test_replay_survives_compaction(self, run):
+        """Evict the oldest segment(s), then replay the survivors —
+        still byte-identical; the evicted versions answer 404 with
+        nearest-version hints."""
+        tmp, recorded, store, gw = run
+        total = sum(os.path.getsize(os.path.join(str(tmp), p))
+                    for p in os.listdir(str(tmp))
+                    if p.endswith(".fharc"))
+        w = ArchiveWriter(str(tmp), retain_bytes=int(total * 0.6))
+        w.commit()
+        w.close()
+        reader = ArchiveReader(str(tmp))
+        kept = set(reader.versions())
+        versions = {v for v, _ in recorded}
+        assert kept < versions, "compaction evicted something"
+        hs = HistoryServer(reader, store=gw.store, port=0).start()
+        try:
+            for (version, path), live in sorted(recorded.items()):
+                sep = "&" if "?" in path else "?"
+                code, raw = _fetch(hs.port,
+                                   f"{path}{sep}version={version}")
+                if version in kept:
+                    assert (code, raw) == live
+                else:
+                    assert code == 404
+                    assert json.loads(raw)["nearest_after"] == \
+                        min(kept)
+        finally:
+            hs.stop()
+
+    def test_gap_and_index_endpoints(self, run):
+        tmp, recorded, store, gw = run
+        reader = ArchiveReader(str(tmp))
+        hs = HistoryServer(reader, store=gw.store, port=0).start()
+        try:
+            newest = max(v for v, _ in recorded)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get_raw(hs.port, f"/query/topk?version={newest + 50}")
+            assert ei.value.code == 404
+            body = json.loads(ei.value.read())
+            assert body["nearest_before"] == max(reader.versions())
+            assert body["nearest_after"] is None
+            # at= predating the archive: honest 404 with the first
+            # archived version as the way forward
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get_raw(hs.port, "/query/topk?at=1.5")
+            assert ei.value.code == 404
+            assert json.loads(ei.value.read())["nearest_after"] == \
+                min(reader.versions())
+            idx = _get(hs.port, "/history/index")
+            assert idx["versions"] == len(reader.versions())
+            assert idx["newest"] == max(reader.versions())
+            assert idx["live_version"] == gw.store.current.version
+        finally:
+            hs.stop()
+
+
+class TestSpreadReplayParity:
+    """The spread-family leg of the acceptance gate."""
+
+    def test_spread_answers_replay_byte_identical(self, tmp_path):
+        from flow_pipeline_tpu.models.superspreader import (
+            SUPERSPREADER_MODEL, superspreader_config,
+            superspreader_model)
+
+        worker = StreamWorker(
+            Consumer(_fill_bus(spread_fraction=0.25, seed=7),
+                     fixedlen=True),
+            {SUPERSPREADER_MODEL: superspreader_model(
+                superspreader_config(capacity=128), k=16)},
+            [MemorySink()],
+            WorkerConfig(snapshot_every=0, poll_max=512))
+        pub = WorkerServePublisher(refresh=0.0).attach(worker)
+        serve = ServeServer(pub.store, port=0).start()
+        writer = ArchiveWriter(str(tmp_path), keyframe_every=2)
+        gw = SnapshotGateway([pub.store], poll=60, archive=writer)
+        paths = (f"/query/spread?model={SUPERSPREADER_MODEL}&k=5",
+                 f"/query/topk?model={SUPERSPREADER_MODEL}&k=8",
+                 "/query/spread")
+        recorded = {}
+        try:
+            while True:
+                more = worker.run_once()
+                with worker.lock:
+                    pub.publish(worker)
+                gw.sync_once()
+                version = pub.store.current.version
+                fam = pub.store.current.families[SUPERSPREADER_MODEL]
+                key = ",".join(
+                    str(int(x)) for x in
+                    np.atleast_1d(fam.rows["src_addr"][0]))
+                for path in paths + (
+                        f"/query/spread?model={SUPERSPREADER_MODEL}"
+                        f"&key={key}",):
+                    recorded.setdefault((version, path),
+                                        _get_raw(serve.port, path))
+                if not more:
+                    break
+        finally:
+            serve.stop()
+            writer.close()
+            _quiesce(worker)
+        reader = ArchiveReader(str(tmp_path))
+        hs = HistoryServer(reader, store=gw.store, port=0).start()
+        try:
+            assert set(v for v, _ in recorded) <= set(reader.versions())
+            for (version, path), live in sorted(recorded.items()):
+                sep = "&" if "?" in path else "?"
+                got = _get_raw(hs.port, f"{path}{sep}version={version}")
+                assert got == live, (version, path)
+        finally:
+            hs.stop()
+
+
+@pytest.mark.slow  # mesh ingest; gated by `make history-parity` / CI
+class TestMeshReplayParity:
+    """The mesh-publisher leg: the coordinator's merged snapshot
+    stream archives and replays byte-identical."""
+
+    def test_mesh_stream_replays_byte_identical(self, tmp_path):
+        from flow_pipeline_tpu.mesh import InProcessMesh, produce_sharded
+        from flow_pipeline_tpu.serve import attach_mesh
+
+        def mesh_models():
+            return {
+                "flows_5m": WindowAggregator(
+                    WindowAggConfig(batch_size=512)),
+                "top_talkers": WindowedHeavyHitter(
+                    HeavyHitterConfig(
+                        key_cols=("src_addr", "dst_addr", "src_port",
+                                  "dst_port", "proto"),
+                        batch_size=512, width=1 << 12, capacity=128),
+                    k=10),
+            }
+
+        bus = InProcessBus()
+        bus.create_topic("flows", 4)
+        gen = FlowGenerator(ZipfProfile(n_keys=200, alpha=1.3), seed=7,
+                            t0=1_700_000_000, rate=40.0)
+        done = 0
+        while done < 8000:
+            done += produce_sharded(bus, "flows", gen.batch(2048), 4)
+        mesh = InProcessMesh(
+            bus, "flows", 2, model_factory=mesh_models,
+            config=WorkerConfig(poll_max=2048, snapshot_every=0),
+            sinks=[MemorySink()])
+        pub = attach_mesh(mesh.coordinator, refresh=0.2, start=False)
+        mesh.start()
+        serve = ServeServer(pub.store, port=0).start()
+        writer = ArchiveWriter(str(tmp_path), keyframe_every=2)
+        gw = SnapshotGateway([pub.store], poll=60, archive=writer)
+        paths = ("/query/topk", "/query/topk?model=top_talkers&k=10",
+                 "/query/range?model=flows_5m", "/query/audit")
+        recorded = {}
+        try:
+            mesh.wait_idle()
+            for _ in range(4):  # several published versions
+                snap = pub.publish_now()
+                gw.sync_once()
+                for path in paths:
+                    recorded.setdefault((snap.version, path),
+                                        _fetch(serve.port, path))
+            assert snap.source == "mesh"
+        finally:
+            serve.stop()
+            writer.close()
+            mesh.finalize()
+        reader = ArchiveReader(str(tmp_path))
+        hs = HistoryServer(reader, store=gw.store, port=0).start()
+        try:
+            assert set(v for v, _ in recorded) <= set(reader.versions())
+            for (version, path), live in sorted(recorded.items()):
+                sep = "&" if "?" in path else "?"
+                got = _fetch(hs.port, f"{path}{sep}version={version}")
+                assert got == live, (version, path)
+                if got[0] == 200:
+                    assert json.loads(got[1])["version"] == version
+        finally:
+            hs.stop()
+
+
+# ---- gateway range retention (satellite) -----------------------------------
+
+
+class TestGatewayRangeRetention:
+    """A gateway with -history.dir answers /query/range for slots older
+    than the live window, bit-exact vs the rows the live path served
+    when those slots were current."""
+
+    def test_archived_slots_serve_the_recorded_rows(self, tmp_path):
+        worker = StreamWorker(
+            Consumer(_fill_bus(batches=10, per=400, rate=2.0),
+                     fixedlen=True),
+            _models(), [MemorySink()],
+            WorkerConfig(snapshot_every=0, poll_max=512))
+        # keep only the 2 newest closed slots live: older slots exist
+        # ONLY in the archive
+        pub = WorkerServePublisher(refresh=0.0, range_slots=2) \
+            .attach(worker)
+        serve = ServeServer(pub.store, port=0).start()
+        writer = ArchiveWriter(str(tmp_path), keyframe_every=4)
+        gw = SnapshotGateway([pub.store], poll=60, archive=writer)
+        recorded = {}  # slot -> the rows the live path served
+        try:
+            while True:
+                more = worker.run_once()
+                with worker.lock:
+                    pub.publish(worker)
+                gw.sync_once()
+                snap = pub.store.current
+                for slot, _ in snap.ranges.get("flows_5m", ()):
+                    if slot not in recorded:
+                        body = _get(serve.port,
+                                    f"/query/range?model=flows_5m"
+                                    f"&from={slot}&to={slot + 300}")
+                        recorded[slot] = body["rows"]
+                if not more:
+                    break
+        finally:
+            serve.stop()
+            writer.close()
+            _quiesce(worker)
+        live_slots = [s for s, _ in
+                      pub.store.current.ranges.get("flows_5m", ())]
+        old_slots = sorted(set(recorded) - set(live_slots))
+        assert old_slots, "need slots that left the live window"
+        reader = ArchiveReader(str(tmp_path))
+        hs = HistoryServer(reader, store=gw.store, port=0).start()
+        try:
+            for slot in old_slots:
+                body = _get(hs.port, f"/query/range?model=flows_5m"
+                                     f"&from={slot}&to={slot + 300}")
+                assert body["slots"] == [slot]
+                assert body["archived_slots"] == [slot]
+                assert body["rows"] == recorded[slot], slot
+            # the unbounded range answers every slot ever closed, in
+            # ascending order: archive + live seamlessly
+            body = _get(hs.port, "/query/range?model=flows_5m")
+            assert body["slots"] == sorted(recorded)
+            assert body["archived_slots"] == old_slots
+            flat = [r for s in sorted(recorded) for r in recorded[s]]
+            assert body["rows"] == flat
+        finally:
+            hs.stop()
+
+
+# ---- -serve.feed_bytes (satellite) -----------------------------------------
+
+
+class TestFeedBytesFlag:
+    def test_flag_registered_and_parsed(self):
+        from flow_pipeline_tpu.utils.flags import KNOWN_FLAGS, FlagSet
+
+        assert "serve.feed_bytes" in KNOWN_FLAGS
+        fs = FlagSet("t")
+        fs.integer("serve.feed_bytes", 0, "h")
+        assert fs.parse(["-serve.feed_bytes", "1048576"]) == \
+            {"serve.feed_bytes": 1 << 20}
+
+    def test_server_threads_the_budget_into_the_feed(self):
+        store = SnapshotStore()
+        store.publish_snapshot(state_to_snapshot(_mk_state(1)))
+        serve = ServeServer(store, port=0, feed_bytes=12345).start()
+        try:
+            _get_raw(serve.port, "/sub/snapshot?since=0")
+            assert serve._feed.history_bytes == 12345
+        finally:
+            serve.stop()
+        # 0 keeps the library default
+        from flow_pipeline_tpu.gateway.feed import FEED_HISTORY_BYTES
+
+        serve = ServeServer(store, port=0).start()
+        try:
+            _get_raw(serve.port, "/sub/snapshot?since=0")
+            assert serve._feed.history_bytes == FEED_HISTORY_BYTES
+        finally:
+            serve.stop()
+
+    def test_bound_is_enforced_at_the_configured_value(self):
+        """The budget actually bites: the retained delta bytes never
+        exceed it, and a subscriber older than the trimmed chain takes
+        a full resync."""
+        states = [_mk_state(i + 1, bump=i) for i in range(7)]
+        store = SnapshotStore()
+        store.publish_snapshot(state_to_snapshot(states[0]))
+        # a budget that holds roughly ONE delta frame
+        budget = int(len(encode_delta(snapshot_state(
+            state_to_snapshot(states[0])), states[1])) * 1.5)
+        feed = SnapshotFeed(store, history_bytes=budget)
+        feed.frame_since(0)
+        for s in states[1:]:
+            store.publish_snapshot(state_to_snapshot(s))
+            feed.frame_since(s["version"] - 1)
+            assert feed._delta_bytes_held <= budget
+        # v1 fell off the trimmed chain: full resync, not a gap
+        kind, cur, _ = feed.frame_since(1)
+        assert (kind, cur) == ("full", 7)
+        # the newest transition still ships as a delta
+        assert feed.frame_since(6)[0] == "delta"
+
+
+# ---- flags / cli wiring ----------------------------------------------------
+
+
+def test_history_flags_registered_and_parsed():
+    from flow_pipeline_tpu.utils.flags import KNOWN_FLAGS, FlagSet
+
+    assert {"history.dir", "history.keyframe", "history.retain",
+            "history.upstream", "history.listen",
+            "history.poll"} <= KNOWN_FLAGS
+    fs = FlagSet("t")
+    fs.string("history.dir", "", "h")
+    fs.integer("history.keyframe", 64, "h")
+    fs.integer("history.retain", 1 << 30, "h")
+    vals = fs.parse(["-history.dir", "/tmp/a",
+                     "-history.keyframe", "8",
+                     "-history.retain", "1000000"])
+    assert vals == {"history.dir": "/tmp/a", "history.keyframe": 8,
+                    "history.retain": 1000000}
+
+
+def test_history_subcommand_wired():
+    from flow_pipeline_tpu import cli
+
+    assert cli._COMMANDS["history"] is cli.history_main
+    assert callable(cli.history_entry)
+    # refuses to start without an upstream (exit code 2, no traceback)
+    assert cli.history_main(["-history.dir", "/tmp/x"]) == 2
+
+
+def test_history_tier_end_to_end_over_http(tmp_path):
+    """The flowhistory tier the cli wires: subscribe over real HTTP,
+    archive, serve the live head AND the past."""
+    states = [_mk_state(i + 1, bump=i) for i in range(5)]
+    store = SnapshotStore()
+    store.publish_snapshot(state_to_snapshot(states[0]))
+    upstream = ServeServer(store, port=0).start()
+    hs = HistoryServer(ArchiveReader(str(tmp_path)), port=0).start()
+    writer = ArchiveWriter(str(tmp_path), keyframe_every=2,
+                           upstream=f"127.0.0.1:{upstream.port}",
+                           store=hs.store)
+    try:
+        assert writer.sync_once() == "full"
+        for s in states[1:]:
+            store.publish_snapshot(state_to_snapshot(s))
+            assert writer.sync_once() == "delta"
+        assert writer.sync_once() == "none"
+        # live head mirrored like a gateway replica
+        assert hs.store.current.version == 5
+        assert _get(hs.port, "/query/version")["version"] == 5
+        # the past reconstructs through the same HTTP surface
+        body = _get(hs.port, "/query/topk?model=hh&version=2")
+        assert body["version"] == 2
+        # v2 was built with bump=1: src_addr = 1..4, last row invalid
+        assert [r["src_addr"] for r in body["rows"]] == [1, 2, 3]
+        assert ArchiveReader(str(tmp_path)).versions() == \
+            [1, 2, 3, 4, 5]
+    finally:
+        writer.stop()
+        hs.stop()
+        upstream.stop()
