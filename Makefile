@@ -8,7 +8,7 @@
 	hostsketch-parity fused-parity fused-parity-traced mesh-parity \
 	mesh-parity-traced serve-load audit-parity invertible-parity \
 	chaos-parity gateway-parity guard-parity spread-parity \
-	history-parity
+	history-parity crash-parity
 
 all: native
 
@@ -26,17 +26,20 @@ bench:
 
 # Static analysis (tools/flowlint): jit-purity, uint64 dtype-flow, lock
 # annotations, lock-order cycles, flag registry, ctypes<->C ABI
-# contract, sketch-family citizenship. Dependency-free (stdlib ast + a
-# tiny C declaration parser); exits nonzero on any finding.
-# docs/STATIC_ANALYSIS.md has the rules; `python -m tools.flowlint
-# --json` for machine-readable output.
+# contract, sketch-family citizenship, durable-write protocol.
+# Dependency-free (stdlib ast + a tiny C declaration parser); exits
+# nonzero on any finding. docs/STATIC_ANALYSIS.md has the rules;
+# `python -m tools.flowlint --json` for machine-readable output.
 lint:
 	python -m tools.flowlint
 
-# Seeded-mutation smoke for the lint gate itself: delete one family
-# registration surface from a scratch copy of the tree and require the
-# family-citizenship rule to fail naming exactly that surface — a lint
-# that cannot fail is indistinguishable from no lint.
+# Seeded-mutation smoke for the lint gate itself: three mutations into
+# a scratch copy of the tree (a deleted family registration surface, a
+# deleted fsync barrier inside write_bytes_durable, an RLock downgraded
+# to a self-deadlocking Lock), each of which the owning rule must fail
+# naming the defect — a lint that cannot fail is indistinguishable from
+# no lint. The durability leg is the static prong of the two-prong
+# durability gate; crash-parity below is the dynamic prong.
 lint-mutation:
 	python -m tools.flowlint.mutation_smoke
 
@@ -124,6 +127,19 @@ fused-parity-traced:
 chaos-parity:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
 		tests/test_supervisor.py -v
+
+# flowtorn (utils/fsutil.py op recorder + utils/crashsim.py): ALICE-
+# style crash-point model checking of every durable surface — the
+# coordinator journal, the dead-letter spill, the history archive, the
+# sketch checkpoint. Each scenario's recorded op log is expanded into
+# every legal crash state (durable-effects-only, torn publish, dropped
+# directory entries, torn/reordered unsynced tails) and the REAL
+# recovery code must uphold the docs/FAULT_TOLERANCE.md invariants in
+# all of them; the TestBarrierMutations half deletes one barrier kind
+# per surface (fsutil.suppressed) and requires a violation — the
+# dynamic prong of the durability gate (static prong: lint-mutation).
+crash-parity:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_crashpoints.py -v
 
 # flowgate (gateway/): the read-tier gates — every /query/* answer
 # served through a gateway replica must be BYTE-identical to the

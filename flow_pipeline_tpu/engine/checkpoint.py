@@ -8,13 +8,24 @@ the open-window device state so a restart resumes without double counting
 - ``arrays.npz``   every device/host array leaf (numpy, compressed)
 - ``meta.json``    consumer positions, window dicts, scalars, tree layout
 
-Writes are atomic (tmp dir + rename) so a crash mid-write leaves the
-previous checkpoint intact. Only numpy/json are used — no pickle, so a
-checkpoint directory is safe to share between trust domains.
+Writes follow the full durable-publish protocol via ``utils/fsutil``
+(this was the one durable surface with ZERO fsyncs before flowtorn):
+each payload is written with write→fsync→replace→dir-fsync inside a
+staging directory, the staging directory is atomically renamed over
+the target, and the containing directory is fsynced — so a crash at
+ANY point leaves the complete old checkpoint (possibly under ``.old``)
+or the complete new one, never a torn or silently-empty mix. The
+crash-point model checker (``make crash-parity``) enumerates every
+window of the save and pins exactly that. Only numpy/json are used —
+no pickle, so a checkpoint directory is safe to share between trust
+domains.
 """
 
 from __future__ import annotations
 
+# flowlint: durable-checked
+
+import io
 import json
 import os
 import shutil
@@ -22,6 +33,8 @@ import tempfile
 from typing import Any
 
 import numpy as np
+
+from ..utils import fsutil
 
 
 def _encode(obj: Any, arrays: dict[str, np.ndarray], path: str) -> Any:
@@ -81,28 +94,52 @@ def _freeze(key):
 
 
 def save_checkpoint(path: str, state: Any) -> None:
-    """Atomically write ``state`` (nested dicts/lists/NamedTuples/arrays)."""
+    """Atomically and DURABLY write ``state`` (nested dicts/lists/
+    NamedTuples/arrays). The payloads are staged (and individually
+    fsynced) in a sibling temp directory, the directory is renamed over
+    the target, and the parent directory entry is fsynced — only then
+    is the superseded ``.old`` tree deleted, so every crash window
+    leaves a complete old or complete new checkpoint on disk."""
     parent = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
     arrays: dict[str, np.ndarray] = {}
     meta = _encode(state, arrays, "r")
     tmp = tempfile.mkdtemp(prefix=".ckpt-", dir=parent)
     try:
-        np.savez_compressed(os.path.join(tmp, "arrays.npz"), **arrays)
-        with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump(meta, f)
+        # serialize in memory, publish through the one durable-write
+        # idiom (write tmp -> fsync -> replace -> dir fsync): numpy's
+        # own savez path never fsyncs
+        buf = io.BytesIO()
+        np.savez_compressed(buf, **arrays)
+        fsutil.write_bytes_durable(os.path.join(tmp, "arrays.npz"),
+                                   buf.getvalue())
+        fsutil.write_bytes_durable(os.path.join(tmp, "meta.json"),
+                                   json.dumps(meta).encode("utf-8"))
         if os.path.isdir(path):
             old = path + ".old"
             # a crash between the renames below can leave a stale .old;
             # clear it or every future snapshot fails with ENOTEMPTY
             if os.path.isdir(old):
-                shutil.rmtree(old)
-            os.rename(path, old)
-            os.rename(tmp, path)
-            shutil.rmtree(old)
+                fsutil.rmtree(old)
+            fsutil.rename(path, old)
+            fsutil.rename(tmp, path)
+            fsutil.rmtree(old)
         else:
-            os.rename(tmp, path)
+            fsutil.rename(tmp, path)
+            # a crash between the two renames of a PREVIOUS save leaves
+            # the predecessor under .old with no primary; now that a
+            # complete new checkpoint is published (rename above), the
+            # stale .old is superseded — clear it AFTER publishing so
+            # no crash window is ever left with neither tree
+            if os.path.isdir(path + ".old"):
+                fsutil.rmtree(path + ".old")
+        # directory-entry barrier: the renames above (and the .old
+        # cleanup) are durable only once the parent directory is —
+        # without this a power loss after the ack could silently revert
+        # an acked checkpoint to its predecessor
+        fsutil.fsync_dir(parent)
     except BaseException:
+        # flowlint: disable=durability-protocol -- best-effort cleanup of the unpublished staging dir on a failed save; no ack references it, resurrection after a crash is harmless garbage
         shutil.rmtree(tmp, ignore_errors=True)
         raise
 

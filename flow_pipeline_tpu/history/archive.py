@@ -55,6 +55,10 @@ from __future__ import annotations
 # flowlint: net-checked
 # (the subscription transport is gateway.subscriber._Upstream, which
 # carries an explicit per-request timeout; no other sockets here)
+# flowlint: durable-checked
+# (segment appends, rotations and evictions all go through
+# utils/fsutil: the durability-protocol rule checks the sequence, the
+# crash-point model checker replays it — docs/STATIC_ANALYSIS.md)
 
 import json
 import os
@@ -66,7 +70,7 @@ import zlib
 from typing import Optional
 
 from ..obs import REGISTRY, get_logger
-from ..utils.fsutil import fsync_dir
+from ..utils import fsutil
 from ..gateway.delta import (DeltaError, DeltaGapError, apply_delta,
                              decode_frames, encode_delta, encode_full,
                              state_to_snapshot)
@@ -312,6 +316,7 @@ class ArchiveWriter:
         rec = _HEAD.pack(len(body), zlib.crc32(body)) + body
         if keyframe:
             self._rotate_locked(version)
+        # durable: group-commit=_commit_locked -- appends are buffered by design; commit() is the fsync barrier that makes a version "archived"
         self._fh.write(rec)
         self._seg_bytes += len(rec)  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
         self._dirty = True  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
@@ -323,12 +328,13 @@ class ArchiveWriter:
 
     def _rotate_locked(self, version: int) -> None:
         if self._fh is not None:
-            self._fh.flush()
-            os.fsync(self._fh.fileno())
+            fsutil.fsync_file(self._fh)
             self._fh.close()
             self._closed.append((self._seg_path, self._seg_bytes))
         path = _segment_path(self.dir, version)
-        self._fh = open(path, "wb")  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        # durable: dir-fsync=_commit_locked -- rotation defers the directory-entry barrier to the group commit (the _rotated flag), one dir fsync per commit instead of per segment
+        self._fh = fsutil.open_durable(path, "wb")  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        # durable: group-commit=_commit_locked -- the magic header rides the same commit barrier as the keyframe record behind it
         self._fh.write(MAGIC)
         self._seg_path = path  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
         self._seg_bytes = len(MAGIC)  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
@@ -344,11 +350,10 @@ class ArchiveWriter:
 
     def _commit_locked(self) -> None:
         if self._fh is not None and self._dirty:
-            self._fh.flush()
-            os.fsync(self._fh.fileno())
+            fsutil.fsync_file(self._fh)
             self._dirty = False  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
         if self._rotated:
-            fsync_dir(self.dir)
+            fsutil.fsync_dir(self.dir)
             self._rotated = False  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
         self._evict_locked()
         self._publish_gauges_locked()
@@ -365,14 +370,14 @@ class ArchiveWriter:
         while len(self._closed) > keep and total > self.retain_bytes:
             path, size = self._closed.pop(0)
             try:
-                os.remove(path)
+                fsutil.remove(path)
             except OSError:  # pragma: no cover - already gone
                 pass
             total -= size
             evicted = True
             self._m["evicted"].inc()
         if evicted:
-            fsync_dir(self.dir)
+            fsutil.fsync_dir(self.dir)
 
     def _publish_gauges_locked(self) -> None:
         self._m["archive_bytes"].set(

@@ -43,6 +43,10 @@ from __future__ import annotations
 # threads; every mutable attribute declares its lock below. Sink writes
 # and merge math deliberately run OUTSIDE the locks — only the ready-set
 # pop and the merged-rows ledger are serialized.)
+# flowlint: durable-checked
+# (the journal call sites: every append under _lock must reach a
+# _journal.sync() barrier before the caller acks — in-method, or via
+# the annotated group-commit seam the public callers all cross)
 
 import threading
 import time
@@ -464,6 +468,7 @@ class MeshCoordinator:
             # the fence (and the carry promotion it implies) must replay
             # at this exact point in the record order, or a recovered
             # coordinator would promote an already-promoted carry twice
+            # durable: group-commit=fence -- *_locked helper: every public caller (join/leave/fence/expire/submit) calls _journal.sync() after releasing _lock, before its ack
             self._journal.append("fence", {"member": member_id})
         carry = self._carry.pop(member_id, None)
         TRACER.record("mesh_fence", now, time.time(), member=member_id,
@@ -494,6 +499,7 @@ class MeshCoordinator:
     def _rebalance_locked(self, reason: str) -> None:
         self.epoch += 1  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
         if self._journal is not None:
+            # durable: group-commit=fence -- *_locked helper: every public caller (join/leave/fence/expire) calls _journal.sync() after releasing _lock, before its ack
             self._journal.append("epoch", {"epoch": self.epoch,
                                            "reason": reason})
         live = sorted(mid for mid, m in self._members.items() if m.alive)
@@ -587,9 +593,11 @@ class MeshCoordinator:
         # from the recovered frontier: the same zombie/rejoin machinery
         # (and the same exactness argument) as a worker death.
         for member in sorted(self._carry):
+            # durable: group-commit=fence -- recovery-time records; __init__ calls _journal.sync() right after _recover_locked returns, before any member traffic (fence() names the same barrier)
             self._journal.append("fence", {"member": member})
             self._replay_fence_locked(member)
         self.epoch += 1  # flowlint: disable=lock-discipline -- *_locked helper: every caller holds _lock (the checker is per-write-site)
+        # durable: group-commit=fence -- recovery-time record; __init__ calls _journal.sync() right after _recover_locked returns, before any member traffic (fence() names the same barrier)
         self._journal.append("epoch", {"epoch": self.epoch,
                                        "reason": "recovery"})
         self._m["epoch"].set(self.epoch)

@@ -74,6 +74,10 @@ from __future__ import annotations
 # one lock guards the file handle and the dirty/lag bookkeeping. The
 # fsync in sync() runs under that lock — a deliberate group-commit
 # serialization, documented above.)
+# flowlint: durable-checked
+# (every write goes through utils/fsutil so the durability-protocol
+# rule can check the sequence and the crash-point model checker can
+# record it — docs/STATIC_ANALYSIS.md "durability-protocol")
 
 import json
 import os
@@ -84,7 +88,7 @@ import zlib
 from typing import Iterator, Optional
 
 from ..obs import get_logger
-from ..utils.fsutil import fsync_dir
+from ..utils import fsutil
 
 log = get_logger("mesh")
 
@@ -113,11 +117,12 @@ class CoordinatorJournal:
             # rather than wedging every subsequent startup on it
             log.warning("journal %s: torn file magic (%d bytes); "
                         "starting a fresh journal", self.path, size)
+            # flowlint: disable=durability-protocol -- deliberate raw truncate: nothing was ever acked against a torn-magic file, and the fresh magic below rides the full fsync+dir-fsync sequence
             os.truncate(self.path, 0)
             size = 0
         # flowlint: unguarded -- the lock itself; bound once
         self._lock = threading.Lock()
-        self._f = open(self.path, "ab")  # guarded-by: _lock
+        self._f = fsutil.open_durable(self.path, "ab")  # guarded-by: _lock
         self._dirty = 0  # records appended, not yet fsynced  # guarded-by: _lock
         self._oldest_dirty = 0.0  # wall stamp of the oldest unsynced append  # guarded-by: _lock
         self._closed = False  # guarded-by: _lock
@@ -128,8 +133,7 @@ class CoordinatorJournal:
         if size == 0:
             with self._lock:
                 self._f.write(MAGIC)
-                self._f.flush()
-                os.fsync(self._f.fileno())
+                fsutil.fsync_file(self._f)
                 self._bytes = len(MAGIC)
             if self._m.get("bytes") is not None:
                 self._m["bytes"].set(len(MAGIC))
@@ -138,7 +142,7 @@ class CoordinatorJournal:
             # power loss could otherwise drop the whole journal file
             # after acks went out, silently voiding the recovery
             # contract
-            fsync_dir(dir_)
+            fsutil.fsync_dir(dir_)
 
     # ---- write side --------------------------------------------------------
 
@@ -152,6 +156,7 @@ class CoordinatorJournal:
         with self._lock:
             if self._closed:
                 return
+            # durable: group-commit=sync -- appends are buffered by design; sync() is the fsync barrier every acking caller crosses first
             self._f.write(rec)
             self._bytes += len(rec)
             nbytes = self._bytes
@@ -174,8 +179,7 @@ class CoordinatorJournal:
         with self._lock:
             if self._closed or self._dirty == 0:
                 return
-            self._f.flush()
-            os.fsync(self._f.fileno())
+            fsutil.fsync_file(self._f)
             self._dirty = 0
         if self._m:
             self._m["unsynced"].set(0)
@@ -206,20 +210,18 @@ class CoordinatorJournal:
                 return
             # flush the old handle first: buffered appends must not
             # outlive the swap and resurface via the stale fd
-            self._f.flush()
-            os.fsync(self._f.fileno())
-            with open(tmp, "wb") as f:
+            fsutil.fsync_file(self._f)
+            with fsutil.open_durable(tmp, "wb") as f:
                 f.write(MAGIC)
                 f.write(rec)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.path)
+                fsutil.fsync_file(f)
+            fsutil.replace(tmp, self.path)
             self._f.close()
-            self._f = open(self.path, "ab")
+            self._f = fsutil.open_durable(self.path, "ab")
             self._bytes = len(MAGIC) + len(rec)
             self._dirty = 0
             nbytes = self._bytes
-        fsync_dir(self.dir)
+        fsutil.fsync_dir(self.dir)
         if self._m:
             self._m["records"].inc(kind="chk")
             self._m["unsynced"].set(0)

@@ -34,14 +34,19 @@ Metrics (registered at construction so dashboards resolve them):
 
 from __future__ import annotations
 
+# flowlint: durable-checked
+# (the dead-letter spill is a durable surface: an acked spill must
+# survive any crash — every write goes through utils/fsutil so the
+# durability-protocol rule and the crash-point model checker see it)
+
 import json
 import os
 import time
 from typing import Optional, Sequence
 
 from ..obs import REGISTRY, get_logger
+from ..utils import fsutil
 from ..utils.faults import FAULTS
-from ..utils.fsutil import fsync_dir
 from ..utils.retry import retry_call
 from .base import rows_to_records
 
@@ -174,16 +179,12 @@ class ResilientSink:
         doc = {"table": table, "records": records,
                "spilled_at": time.time(), "error": repr(exc),
                "version": 1}
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(doc, f, default=str)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        # the rename itself is only durable once the directory is —
-        # without this a power loss could drop the spilled file AFTER
-        # the worker committed past the batch
-        fsync_dir(self.deadletter_dir)
+        # the whole atomic-publish sentence in one call: write a temp,
+        # fsync it, atomically replace, fsync the directory entry — a
+        # power loss can never drop or tear a spill the worker already
+        # committed past
+        fsutil.write_bytes_durable(
+            path, json.dumps(doc, default=str).encode("utf-8"))
         self._m["dead"].inc(table=table)
         self._m["depth"].set(len(self._dlq_files()))
         log.error("sink write %s exhausted %d attempts (%s); %d rows "
@@ -235,6 +236,7 @@ def replay_deadletter(root_dir: str, sinks: Sequence,
             raise
         n_rows += len(records)
         if delete:
+            # flowlint: disable=durability-protocol -- deliberate: no dir-fsync after removing a replayed spill; a crash resurrects the file and it re-replays, which the at-least-once contract absorbs
             os.remove(path)
         log.info("replayed %d rows into %s from %s", len(records), table,
                  os.path.basename(path))

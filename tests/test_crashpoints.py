@@ -1,0 +1,373 @@
+"""flowtorn: crash-point model checking for every durable surface.
+
+Each scenario here drives REAL production code (the coordinator
+journal, the dead-letter spill, the history archive, the sketch
+checkpoint) under ``fsutil.observed``, then hands the recorded op log
+to ``utils/crashsim.explore`` — which materializes every legal crash
+state (durable-effects-only, torn publishes, dropped directory
+entries, torn/reordered unsynced writes) and runs the REAL recovery
+code over each, asserting the docs/FAULT_TOLERANCE.md invariants:
+
+- journal: every acked submission survives recovery bit-exact (or is
+  subsumed by an acked compaction checkpoint);
+- dead-letter: every acked spill replays to row equality;
+- archive: every committed version reconstructs bit-equal, and a
+  missing version is an honest HistoryGapError, never damaged data;
+- checkpoint: an acked save restores exactly; mid-save crashes restore
+  the complete predecessor.
+
+The ``TestBarrierMutations`` half is the dynamic prong of the
+``make lint-mutation`` durability gate: ``fsutil.suppressed(kind)``
+deletes one barrier kind (fsync / dir-fsync / atomic replace) from the
+protocol the way a bad refactor would, and every (surface, barrier)
+pair must produce at least one crash-state invariant violation —
+proof that each barrier in each surface is load-bearing, not
+cargo-culted. The static prong (tests/test_flowlint.py) proves the
+lint rule catches the same deletions in source form.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from flow_pipeline_tpu.engine.checkpoint import (checkpoint_exists,
+                                                 load_checkpoint,
+                                                 save_checkpoint)
+from flow_pipeline_tpu.gateway.delta import encode_full
+from flow_pipeline_tpu.history.archive import (ArchiveReader,
+                                               ArchiveWriter,
+                                               HistoryGapError)
+from flow_pipeline_tpu.mesh.journal import (JOURNAL_FILE,
+                                            CoordinatorJournal,
+                                            replay_journal)
+from flow_pipeline_tpu.sink.resilient import ResilientSink, replay_deadletter
+from flow_pipeline_tpu.utils import crashsim, fsutil
+
+T0 = 1_699_999_800
+
+
+# ---- scenario: coordinator journal -----------------------------------------
+
+_BLOBS = {"a": b"envelope-a" * 3, "b": b"envelope-b" * 5,
+          "c": b"envelope-c" * 7}
+_CHK_BLOB = b"compacted-coordinator-state"
+
+
+def _run_journal(root: str, rec: fsutil.OpRecorder) -> None:
+    """Append+ack three submissions with a compaction in the middle —
+    the full journal lifecycle (init, group commit, atomic compact)."""
+    with fsutil.observed(rec):
+        j = CoordinatorJournal(os.path.join(root, "mesh"))
+        j.append("sub", {"member": "a"}, _BLOBS["a"])
+        j.sync()
+        rec.mark("a")
+        j.append("sub", {"member": "b"}, _BLOBS["b"])
+        j.sync()
+        rec.mark("b")
+        j.compact({"epoch": 2}, _CHK_BLOB)
+        rec.mark("chk")
+        j.append("sub", {"member": "c"}, _BLOBS["c"])
+        j.sync()
+        rec.mark("c")
+        j.close()
+
+
+def _check_journal(croot: str, acked: list) -> None:
+    recs = list(replay_journal(os.path.join(croot, "mesh", JOURNAL_FILE)))
+    chk = next((blob for kind, _m, blob in recs if kind == "chk"), None)
+    subs = {m["member"]: blob for kind, m, blob in recs if kind == "sub"}
+    for label in acked:
+        if label == "chk":
+            assert chk is not None, "acked compaction checkpoint lost"
+            assert chk == _CHK_BLOB, "checkpoint blob not bit-exact"
+        elif label in ("a", "b") and chk is not None:
+            continue  # folded into the (also durable) checkpoint
+        else:
+            assert label in subs, f"acked submission {label!r} lost"
+            assert subs[label] == _BLOBS[label], \
+                f"submission {label!r} not bit-exact"
+
+
+# ---- scenario: dead-letter spill -------------------------------------------
+
+_BATCHES = {
+    "batch1": [{"src_addr": "10.0.0.1", "bytes": 100, "flows": 2}],
+    "batch2": [{"src_addr": "10.0.0.2", "bytes": 7, "flows": 1},
+               {"src_addr": "10.0.0.3", "bytes": 9, "flows": 4}],
+}
+
+
+class _DownSink:
+    def write(self, table, rows):
+        raise OSError("sink is down")
+
+
+class _CollectSink:
+    def __init__(self):
+        self.rows = set()
+
+    def write(self, table, records):
+        for r in records:
+            self.rows.add((table, tuple(sorted(r.items()))))
+
+
+def _run_dlq(root: str, rec: fsutil.OpRecorder) -> None:
+    sink = ResilientSink(_DownSink(), retries=2, backoff=0.0, jitter=0.0,
+                         deadletter_dir=os.path.join(root, "sink"),
+                         sleep=lambda _s: None)
+    with fsutil.observed(rec):
+        for label, rows in _BATCHES.items():
+            sink.write("flows", rows)  # exhausts retries, spills
+            rec.mark(label)
+
+
+def _check_dlq(croot: str, acked: list) -> None:
+    col = _CollectSink()
+    # a torn acked spill raises here — that IS the invariant violation
+    replay_deadletter(os.path.join(croot, "sink"), [col], delete=False)
+    for label in acked:
+        for r in _BATCHES[label]:
+            key = ("flows", tuple(sorted(r.items())))
+            assert key in col.rows, f"acked spill {label!r} lost {r}"
+
+
+# ---- scenario: history archive ---------------------------------------------
+
+
+def _mk_state(version: int, *, bump: int = 0) -> dict:
+    """A compact canonical gateway state (one hh family, one range
+    table) — the delta-codec shape the archive persists."""
+    rng = np.random.default_rng(7)
+    cms = rng.integers(0, 1000, size=(2, 2, 8)).astype(np.uint64)
+    if bump:
+        cms[0, 1, bump % 8] += np.uint64(bump)
+    return {
+        "version": int(version), "created": 100.0 + version,
+        "watermark": float(T0 + 300 * version),
+        "flows_seen": 10 * version, "source": "worker",
+        "families": {
+            "hh": {"kind": "hh", "window_start": T0, "depth": 4,
+                   "key_lanes": 2, "value_cols": ["bytes"],
+                   "rows": {
+                       "src_addr": np.arange(4, dtype=np.uint32)
+                       + np.uint32(bump),
+                       "bytes": np.asarray([9.0, 5.0, 3.0, 1.0],
+                                           np.float32),
+                       "valid": np.asarray([True, True, True, False]),
+                   },
+                   "cms": cms, "regs": None},
+        },
+        "ranges": {"flows_5m": [
+            [T0, {"timeslot": np.asarray([T0, T0], np.int64),
+                  "bytes": np.asarray([1, 2 + bump], np.uint64)}],
+        ]},
+        "audit": {"hh": {"cms_err": 0.0, "windows": version}},
+    }
+
+
+_STATES = {v: _mk_state(v, bump=v - 1) for v in (1, 2, 3, 4, 5)}
+
+
+def _run_archive(root: str, rec: fsutil.OpRecorder) -> None:
+    """Five versions at keyframe_every=2: two rotations, commits that
+    cover records in BOTH the rotated-away and the live segment."""
+    with fsutil.observed(rec):
+        w = ArchiveWriter(os.path.join(root, "hist"), keyframe_every=2)
+        prev = None
+        committed = []
+        for v in sorted(_STATES):
+            w.record(prev, _STATES[v])
+            prev = _STATES[v]
+            committed.append(v)
+            if v % 2 == 0 or v == max(_STATES):
+                w.commit()
+                for c in committed:
+                    rec.mark(f"v{c}")
+                committed = []
+        w.close()
+
+
+def _check_archive(croot: str, acked: list) -> None:
+    rd = ArchiveReader(os.path.join(croot, "hist"))
+    versions = set(rd.versions())
+    for label in acked:
+        v = int(label[1:])
+        assert v in versions, f"archived v{v} lost"
+        state = rd.reconstruct(v)
+        assert encode_full(state) == encode_full(_STATES[v]), \
+            f"v{v} did not reconstruct bit-equal"
+    # honesty: everything listed reconstructs, everything else is a
+    # loud gap — never a damaged snapshot
+    for v in versions:
+        rd.reconstruct(v)
+    with pytest.raises(HistoryGapError):
+        rd.reconstruct(max(versions, default=0) + 1)
+
+
+# ---- scenario: sketch checkpoint -------------------------------------------
+
+_CKPT_1 = {"step": 1, "hh": np.arange(6, dtype=np.uint64)}
+_CKPT_2 = {"step": 2, "hh": np.arange(6, dtype=np.uint64) * 3}
+
+
+def _run_checkpoint(root: str, rec: fsutil.OpRecorder) -> None:
+    path = os.path.join(root, "ckpt", "snap")
+    with fsutil.observed(rec):
+        save_checkpoint(path, _CKPT_1)
+        rec.mark("s1")
+        save_checkpoint(path, _CKPT_2)  # exercises the .old dance
+        rec.mark("s2")
+
+
+def _ckpt_equal(got: dict, want: dict) -> bool:
+    return got["step"] == want["step"] and \
+        np.array_equal(got["hh"], want["hh"])
+
+
+def _check_checkpoint(croot: str, acked: list) -> None:
+    path = os.path.join(croot, "ckpt", "snap")
+    if not acked:
+        if not checkpoint_exists(path):
+            return  # crashed before anything was published: fine
+        got = load_checkpoint(path)  # must load completely or raise
+        assert _ckpt_equal(got, _CKPT_1) or _ckpt_equal(got, _CKPT_2), \
+            "checkpoint on disk matches neither saved state"
+        return
+    got = load_checkpoint(path)
+    if "s2" in acked:
+        assert _ckpt_equal(got, _CKPT_2), \
+            "acked checkpoint s2 did not restore"
+    else:
+        # s1 acked, s2 mid-save: the complete predecessor or the
+        # complete successor — never a torn mix
+        assert _ckpt_equal(got, _CKPT_1) or _ckpt_equal(got, _CKPT_2), \
+            "acked checkpoint restored a torn state"
+
+
+_SCENARIOS = {
+    "journal": (_run_journal, _check_journal),
+    "deadletter": (_run_dlq, _check_dlq),
+    "archive": (_run_archive, _check_archive),
+    "checkpoint": (_run_checkpoint, _check_checkpoint),
+}
+
+
+def _explore(tmp_path, surface: str, **kw) -> crashsim.CrashReport:
+    run, check = _SCENARIOS[surface]
+    root = str(tmp_path)
+    rec = fsutil.OpRecorder()
+    run(root, rec)
+    assert rec.ops, "scenario recorded no durable ops"
+    return crashsim.explore(rec, root, check, **kw)
+
+
+# ---- the gate: every crash window of every surface -------------------------
+
+
+class TestCrashPoints:
+
+    @pytest.mark.parametrize("surface", sorted(_SCENARIOS))
+    def test_every_crash_state_recovers(self, tmp_path, surface):
+        report = _explore(tmp_path, surface)
+        assert report.crash_points > 10, report.render()
+        assert report.states_explored > 10, report.render()
+        assert report.ok, report.render()
+
+    def test_final_state_is_complete(self, tmp_path):
+        """The no-crash run itself satisfies every invariant (sanity:
+        the checkers are not vacuous)."""
+        for surface in sorted(_SCENARIOS):
+            run, check = _SCENARIOS[surface]
+            root = str(tmp_path / surface)
+            rec = fsutil.OpRecorder()
+            run(root, rec)
+            check(root, [m[1] for m in rec.ops if m[0] == "mark"])
+
+
+# ---- the dynamic mutation gate ---------------------------------------------
+
+
+class TestBarrierMutations:
+    """Delete one barrier kind from one surface's protocol; the model
+    checker must find a crash state that violates an invariant. A
+    mutation that nothing catches means the barrier was decorative."""
+
+    CASES = [
+        ("journal", "fsync"), ("journal", "fsync_dir"),
+        ("journal", "replace"),
+        ("deadletter", "fsync"), ("deadletter", "fsync_dir"),
+        ("deadletter", "replace"),
+        ("checkpoint", "fsync"), ("checkpoint", "fsync_dir"),
+        ("checkpoint", "replace"),
+        # the archive publishes by append+rotate, never by replace
+        ("archive", "fsync"), ("archive", "fsync_dir"),
+    ]
+
+    @pytest.mark.parametrize("surface,barrier",
+                             CASES, ids=[f"{s}-{b}" for s, b in CASES])
+    def test_dropped_barrier_is_caught(self, tmp_path, surface, barrier):
+        run, check = _SCENARIOS[surface]
+        root = str(tmp_path)
+        rec = fsutil.OpRecorder()
+        with fsutil.suppressed(barrier):
+            run(root, rec)
+        report = crashsim.explore(rec, root, check, fail_fast=True)
+        assert not report.ok, (
+            f"deleting every {barrier!r} barrier from the {surface} "
+            f"protocol produced no crash-state violation — the model "
+            f"checker lost its teeth\n{report.render()}")
+
+    def test_unknown_barrier_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown suppressible"):
+            with fsutil.suppressed("flush"):
+                pass
+
+
+# ---- satellite: checkpoint crash-mid-save specifics ------------------------
+
+
+class TestCheckpointMidSave:
+
+    def test_crash_between_renames_restores_predecessor(self, tmp_path):
+        """Simulate the exact mid-dance crash: the old checkpoint moved
+        to .old, the new one never renamed in. Load must fall back to
+        the complete predecessor."""
+        path = str(tmp_path / "snap")
+        save_checkpoint(path, _CKPT_1)
+        os.rename(path, path + ".old")  # crash window between renames
+        assert checkpoint_exists(path)
+        assert _ckpt_equal(load_checkpoint(path), _CKPT_1)
+        # and the next save self-heals the stale .old
+        save_checkpoint(path, _CKPT_2)
+        assert not os.path.isdir(path + ".old")
+        assert _ckpt_equal(load_checkpoint(path), _CKPT_2)
+
+    def test_torn_payload_rejects_loudly(self, tmp_path):
+        """A damaged arrays.npz must raise, never silently decode."""
+        path = str(tmp_path / "snap")
+        save_checkpoint(path, _CKPT_1)
+        with open(os.path.join(path, "arrays.npz"), "wb") as f:
+            f.write(b"\0\0\0\0")
+        with pytest.raises(Exception):
+            load_checkpoint(path)
+
+    def test_failed_save_keeps_previous(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "snap")
+        save_checkpoint(path, _CKPT_1)
+        real = fsutil.write_bytes_durable
+
+        def boom(p, data):
+            if p.endswith("meta.json"):
+                raise OSError("disk full")
+            real(p, data)
+
+        monkeypatch.setattr(fsutil, "write_bytes_durable", boom)
+        with pytest.raises(OSError, match="disk full"):
+            save_checkpoint(path, _CKPT_2)
+        monkeypatch.setattr(fsutil, "write_bytes_durable", real)
+        assert _ckpt_equal(load_checkpoint(path), _CKPT_1)
+        # no staging litter left behind
+        litter = [n for n in os.listdir(tmp_path)
+                  if n.startswith(".ckpt-")]
+        assert litter == []

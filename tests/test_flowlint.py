@@ -1810,6 +1810,405 @@ class TestAnnotate:
             ["flowlint: 0 finding(s)"]
 
 
+# common fixture prologue — indented to match the fixture literals so
+# textwrap.dedent in _lint sees one uniform block
+_DUR = """
+            # flowlint: durable-checked
+            from flow_pipeline_tpu.utils import fsutil
+"""
+
+
+class TestDurabilityProtocol:
+    """durability-protocol fixture battery: the per-function protocol
+    model (open/write/fsync/replace/dir-fsync ordering), the raw-op and
+    bare-open fences, the group-commit seam, and the verified
+    `# durable:` annotation grammar."""
+
+    def test_unmarked_module_not_checked(self, tmp_path):
+        out = _lint(tmp_path, """
+            def f(path):
+                with open(path, "w") as fh:
+                    fh.write("x")
+        """, rules=("durability-protocol",))
+        assert out == []
+
+    def test_bare_write_open_flagged(self, tmp_path):
+        out = _lint(tmp_path, _DUR + """
+            def f(path):
+                with open(path, "w") as fh:
+                    fh.write("x")
+        """, rules=("durability-protocol",))
+        assert len(out) == 1
+        assert "bare open" in out[0].message
+        assert "open_durable" in out[0].message
+
+    def test_nonliteral_mode_flagged_read_modes_ignored(self, tmp_path):
+        out = _lint(tmp_path, _DUR + """
+            def f(path, m):
+                a = open(path)            # default read: fine
+                b = open(path, "r")
+                c = open(path, "rb")
+                d = open(path, m)         # unclassifiable
+                return a, b, c, d
+        """, rules=("durability-protocol",))
+        assert len(out) == 1
+        assert "non-literal mode" in out[0].message
+
+    def test_raw_os_ops_flagged(self, tmp_path):
+        out = _lint(tmp_path, _DUR + """
+            import os, shutil
+
+            def f(a, b):
+                os.replace(a, b)
+                shutil.rmtree(a)
+        """, rules=("durability-protocol",))
+        msgs = " ".join(f.message for f in out)
+        assert len(out) == 2
+        assert "raw os.replace()" in msgs
+        assert "raw shutil.rmtree()" in msgs
+        assert "utils/fsutil" in msgs
+
+    def test_raw_ops_exempt_in_core_fsutil(self, tmp_path):
+        out = _lint(tmp_path, """
+            # flowlint: durable-checked
+            import os
+
+            def fsync_file(f):
+                f.flush()
+                os.fsync(f.fileno())
+        """, name="flow_pipeline_tpu/utils/fsutil.py",
+            rules=("durability-protocol",))
+        assert out == []
+
+    def test_full_publish_protocol_clean(self, tmp_path):
+        out = _lint(tmp_path, _DUR + """
+            def publish(path, data):
+                tmp = path + ".tmp"
+                with fsutil.open_durable(tmp, "wb") as f:
+                    f.write(data)
+                    fsutil.fsync_file(f)
+                fsutil.replace(tmp, path)
+                fsutil.fsync_dir(".")
+        """, rules=("durability-protocol",))
+        assert out == []
+
+    def test_write_bytes_durable_is_the_whole_sentence(self, tmp_path):
+        out = _lint(tmp_path, _DUR + """
+            def spill(path, data):
+                fsutil.write_bytes_durable(path, data)
+        """, rules=("durability-protocol",))
+        assert out == []
+
+    def test_unsynced_handle_write_flagged(self, tmp_path):
+        out = _lint(tmp_path, _DUR + """
+            def f(path):
+                fh = fsutil.open_durable(path, "ab")
+                fh.write(b"rec")
+                fh.close()
+                fsutil.fsync_dir(".")
+        """, rules=("durability-protocol",))
+        assert len(out) == 1
+        assert "no later fsutil.fsync_file(fh)" in out[0].message
+
+    def test_replace_of_unsynced_temp_flagged(self, tmp_path):
+        out = _lint(tmp_path, _DUR + """
+            def f(path):
+                tmp = path + ".tmp"
+                with fsutil.open_durable(tmp, "wb") as f:
+                    f.write(b"payload")
+                fsutil.replace(tmp, path)
+                fsutil.fsync_file(f)   # too late: after the publish
+                fsutil.fsync_dir(".")
+        """, rules=("durability-protocol",))
+        assert len(out) == 1
+        assert "never fsynced" in out[0].message
+        assert "torn" in out[0].message
+
+    def test_unpublished_staging_file_flagged(self, tmp_path):
+        out = _lint(tmp_path, _DUR + """
+            def f(path):
+                tmp = path + ".tmp"
+                with fsutil.open_durable(tmp, "wb") as f:
+                    f.write(b"x")
+                    fsutil.fsync_file(f)
+                fsutil.fsync_dir(".")
+        """, rules=("durability-protocol",))
+        assert len(out) == 1
+        assert "never" in out[0].message and "published" in out[0].message
+
+    def test_missing_dir_fsync_flagged(self, tmp_path):
+        out = _lint(tmp_path, _DUR + """
+            def f(a, b):
+                fsutil.replace(a, b)
+        """, rules=("durability-protocol",))
+        assert len(out) == 1
+        assert "no later fsutil.fsync_dir" in out[0].message
+
+    def test_unacked_seam_append_flagged(self, tmp_path):
+        out = _lint(tmp_path, _DUR + """
+            class Coord:
+                def ok(self, rec):
+                    self._j.append(rec)
+                    self._j.sync()
+
+                def bad(self, rec):
+                    self._j.append(rec)
+
+                def flush(self):
+                    self._j.sync()
+        """, rules=("durability-protocol",))
+        assert len(out) == 1
+        assert "self._j.append" in out[0].message
+        assert "not durable when the caller acks" in out[0].message
+
+    def test_plain_list_append_is_not_a_seam(self, tmp_path):
+        # .append on an attr the module never .sync()s is a list, not a
+        # buffered journal — and list-method names like .remove must
+        # never be read as fsutil name ops
+        out = _lint(tmp_path, _DUR + """
+            class Box:
+                def add(self, v):
+                    self._items.append(v)
+
+                def drop(self, v):
+                    self._items.remove(v)
+        """, rules=("durability-protocol",))
+        assert out == []
+
+    def test_group_commit_annotation_excuses_deferred_sync(self, tmp_path):
+        out = _lint(tmp_path, _DUR + """
+            class Coord:
+                def deferred(self, rec):
+                    # durable: group-commit=flush -- every public caller flushes before its ack
+                    self._j.append(rec)
+
+                def flush(self):
+                    self._j.sync()
+        """, rules=("durability-protocol",))
+        assert out == []
+
+    def test_annotation_without_reason_is_a_finding(self, tmp_path):
+        out = _lint(tmp_path, _DUR + """
+            class Coord:
+                def deferred(self, rec):
+                    # durable: group-commit=flush
+                    self._j.append(rec)
+
+                def flush(self):
+                    self._j.sync()
+        """, rules=("durability-protocol",))
+        msgs = " ".join(f.message for f in out)
+        assert "without a justification" in msgs
+        # and the unexcused append is still reported
+        assert "not durable when the caller acks" in msgs
+
+    def test_annotation_naming_barrierless_method_is_a_finding(
+            self, tmp_path):
+        # the static half of the mutation gate: delete the fsync out of
+        # the promised method and the annotation itself turns red
+        out = _lint(tmp_path, _DUR + """
+            def rotate(old, new):
+                # durable: dir-fsync=commit -- commit fsyncs the dir before any ack
+                fsutil.rename(old, new)
+
+            def commit():
+                pass
+        """, rules=("durability-protocol",))
+        msgs = " ".join(f.message for f in out)
+        assert "does not contain the promised barrier" in msgs
+
+    def test_dir_fsync_annotation_excuses_deferred_barrier(self, tmp_path):
+        out = _lint(tmp_path, _DUR + """
+            def rotate(old, new):
+                # durable: dir-fsync=commit -- commit fsyncs the dir before any ack
+                fsutil.rename(old, new)
+
+            def commit():
+                fsutil.fsync_dir(".")
+        """, rules=("durability-protocol",))
+        assert out == []
+
+    def test_suppression_with_reason_accepted(self, tmp_path):
+        out = _lint(tmp_path, _DUR + """
+            import os
+
+            def f(a, b):
+                # flowlint: disable=durability-protocol -- migration shim, deleted with r22
+                os.replace(a, b)
+        """, rules=("durability-protocol",))
+        assert out == []
+
+    def test_repo_durable_modules_are_marked(self):
+        """Every module that owns crash-critical state must stay opted
+        in — deleting a marker would silently de-fang the rule exactly
+        where it matters (same contract as the net-checked list)."""
+        from tools.flowlint.core import load_files
+
+        rels = ["flow_pipeline_tpu/mesh/journal.py",
+                "flow_pipeline_tpu/mesh/coordinator.py",
+                "flow_pipeline_tpu/sink/resilient.py",
+                "flow_pipeline_tpu/history/archive.py",
+                "flow_pipeline_tpu/engine/checkpoint.py",
+                "flow_pipeline_tpu/utils/fsutil.py"]
+        for sf in load_files(REPO, rels):
+            assert "durable-checked" in sf.markers, sf.rel
+
+
+class TestDurabilityMutationGate:
+    """The static half of the two-prong durability mutation gate:
+    deleting any single load-bearing fsync / dir-fsync / replace from a
+    durable surface must produce a durability-protocol finding when the
+    mutated module is linted standalone. (The dynamic half lives in
+    tests/test_crashpoints.py::TestBarrierMutations, where the same
+    deletions — via fsutil.suppressed — surface as crash-state
+    invariant violations.)"""
+
+    # (repo-relative module, line regex, 0-based occurrence). Barrier
+    # lines NOT listed are excluded deliberately:
+    # - journal.py compact's fsync of the OLD handle (occurrence 2 of
+    #   fsync_file(self._f)) protects only never-acked buffered appends
+    #   — not load-bearing for acked data;
+    # - archive.py's rotation-time fsync of the outgoing segment
+    #   (occurrence 0 of fsync_file(self._fh)) is an interprocedural
+    #   barrier the lexical rule cannot see; the crash-point checker
+    #   covers it (the archive scenario commits across a rotation);
+    # - coordinator.py syncs other than fence/submit are per-caller
+    #   copies of the annotated group-commit seam (deleting one leaves
+    #   other callers' barriers intact — redundancy, not protocol).
+    MUTATIONS = [
+        ("flow_pipeline_tpu/mesh/journal.py",
+         r"fsutil\.fsync_file\(self\._f\)", 0),
+        ("flow_pipeline_tpu/mesh/journal.py",
+         r"fsutil\.fsync_file\(self\._f\)", 1),
+        ("flow_pipeline_tpu/mesh/journal.py",
+         r"fsutil\.fsync_file\(f\)", 0),
+        ("flow_pipeline_tpu/mesh/journal.py",
+         r"fsutil\.fsync_dir\(dir_\)", 0),
+        ("flow_pipeline_tpu/mesh/journal.py",
+         r"fsutil\.fsync_dir\(self\.dir\)", 0),
+        ("flow_pipeline_tpu/mesh/journal.py",
+         r"fsutil\.replace\(tmp, self\.path\)", 0),
+        ("flow_pipeline_tpu/history/archive.py",
+         r"fsutil\.fsync_file\(self\._fh\)", 1),
+        ("flow_pipeline_tpu/history/archive.py",
+         r"fsutil\.fsync_dir\(self\.dir\)", 0),
+        ("flow_pipeline_tpu/history/archive.py",
+         r"fsutil\.fsync_dir\(self\.dir\)", 1),
+        ("flow_pipeline_tpu/engine/checkpoint.py",
+         r"fsutil\.fsync_dir\(parent\)", 0),
+        ("flow_pipeline_tpu/mesh/coordinator.py",
+         r"self\._journal\.sync\(\)", 3),   # fence()'s ack barrier
+        ("flow_pipeline_tpu/mesh/coordinator.py",
+         r"self\._journal\.sync\(\)", 5),   # submit()'s ack barrier
+        # the dead-letter spill is one write_bytes_durable call; its
+        # three barriers live in fsutil's own protocol sentence
+        ("flow_pipeline_tpu/utils/fsutil.py",
+         r"^        fsync_file\(f\)", 0),
+        ("flow_pipeline_tpu/utils/fsutil.py",
+         r"^    replace\(tmp, path\)", 0),
+        ("flow_pipeline_tpu/utils/fsutil.py",
+         r"^    fsync_dir\(os\.path", 0),
+    ]
+
+    @staticmethod
+    def _mutate(src: str, pattern: str, occurrence: int) -> str:
+        import re
+        lines = src.splitlines(keepends=True)
+        hits = [i for i, ln in enumerate(lines) if re.search(pattern, ln)]
+        assert len(hits) > occurrence, \
+            f"{pattern!r}: {len(hits)} hit(s), wanted > {occurrence} — " \
+            f"the mutation list is stale against the source"
+        i = hits[occurrence]
+        indent = lines[i][:len(lines[i]) - len(lines[i].lstrip())]
+        lines[i] = indent + "pass  # mutated\n"
+        return "".join(lines)
+
+    def test_unmutated_modules_lint_clean_standalone(self, tmp_path):
+        for rel in sorted({rel for rel, _p, _o in self.MUTATIONS}):
+            with open(os.path.join(REPO, rel)) as fh:
+                src = fh.read()
+            dst = tmp_path / "base" / rel
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            dst.write_text(src)
+            out = run_lint(str(tmp_path / "base"), [rel],
+                           rules=("durability-protocol",))
+            assert out == [], (rel, [f.render() for f in out])
+
+    def test_every_dropped_barrier_is_a_finding(self, tmp_path):
+        for n, (rel, pattern, occ) in enumerate(self.MUTATIONS):
+            with open(os.path.join(REPO, rel)) as fh:
+                src = fh.read()
+            root = tmp_path / str(n)
+            dst = root / rel
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            dst.write_text(self._mutate(src, pattern, occ))
+            out = run_lint(str(root), [rel],
+                           rules=("durability-protocol",))
+            dur = [f for f in out if f.rule == "durability-protocol"]
+            assert dur, (
+                f"deleting {pattern!r} occurrence {occ} from {rel} "
+                f"produced no durability-protocol finding — the static "
+                f"mutation gate lost its teeth")
+
+
+class TestAnnotateRobustness:
+    def test_output_byte_identical_across_runs(self, tmp_path, capsys):
+        import json
+
+        from tools.flowlint import annotate
+        from tools.flowlint.runner import main
+
+        (tmp_path / "fix.py").write_text(textwrap.dedent("""
+            # flowlint: uint64-exact
+            import numpy as np
+
+            def f():
+                a = np.zeros(3)
+                b = np.int64(1)
+                return a, b
+        """))
+        rc = main(["--root", str(tmp_path), "--json", "fix.py"])
+        assert rc == 1
+        json_path = tmp_path / "findings.json"
+        json_path.write_text(capsys.readouterr().out)
+        assert annotate.main([str(json_path)]) == 0
+        first = capsys.readouterr().out
+        assert annotate.main([str(json_path)]) == 0
+        second = capsys.readouterr().out
+        assert first == second
+        assert first.encode() == second.encode()
+
+    def test_missing_keys_degrade_gracefully(self):
+        # a hand-built or version-skewed document must never crash the
+        # presenter — CI would lose the real findings behind a KeyError
+        from tools.flowlint import annotate
+
+        lines = annotate.annotations({"findings": [{}]})
+        assert lines[0].startswith("::error file=<unknown>,line=1,")
+        assert lines[-1] == "flowlint: 1 finding(s)"
+
+    def test_count_falls_back_to_findings_length(self):
+        from tools.flowlint import annotate
+
+        lines = annotate.annotations(
+            {"findings": [{"file": "a.py", "line": 3, "rule": "r",
+                           "message": "m"}]})
+        assert lines[-1] == "flowlint: 1 finding(s)"
+
+
+class TestLintWallClock:
+    def test_full_repo_run_within_budget(self):
+        """make lint is a pre-commit gate: a rule that regresses the
+        full-scope run past interactive latency is a bug even when its
+        findings are right (observed ~3s on CI-class hardware; the
+        ceiling leaves 20x headroom before failing)."""
+        import time
+
+        t0 = time.monotonic()
+        run_lint(REPO)
+        assert time.monotonic() - t0 < 60.0
+
+
 class TestRepoRegression:
     def test_repo_lints_clean(self):
         findings = run_lint(REPO)

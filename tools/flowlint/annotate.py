@@ -23,8 +23,9 @@ def annotations(doc: dict) -> list[str]:
     ``::error file=...,line=...`` per finding plus the count trailer
     the log always shows."""
     lines = [
-        f"::error file={f['file']},line={f['line']},"
-        f"title=flowlint {f['rule']}::{f['message']}"
+        f"::error file={f.get('file', '<unknown>')},"
+        f"line={f.get('line', 1)},"
+        f"title=flowlint {f.get('rule', '?')}::{f.get('message', '')}"
         for f in doc.get("findings", ())
     ]
     count = doc.get("count", len(doc.get("findings", ())))

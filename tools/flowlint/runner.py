@@ -16,6 +16,7 @@ from concurrent.futures import ThreadPoolExecutor
 from . import (
     rules_abi,
     rules_dtype,
+    rules_durability,
     rules_family,
     rules_flags,
     rules_lockorder,
@@ -44,6 +45,7 @@ _RULE_CHECKS = (
     ("abi-contract", rules_abi.check),
     ("net-timeout", lambda files, root: rules_net.check(files)),
     ("family-citizenship", rules_family.check),
+    ("durability-protocol", lambda files, root: rules_durability.check(files)),
 )
 ALL_RULES = tuple(name for name, _ in _RULE_CHECKS)
 
@@ -95,7 +97,7 @@ def main(argv: list[str]) -> int:
         description="project static analysis: jit-purity, uint64 "
                     "dtype-flow, lock annotations, lock ordering, flag "
                     "registry, ctypes<->C ABI contract, sketch-family "
-                    "citizenship")
+                    "citizenship, durable-write protocol")
     p.add_argument("paths", nargs="*",
                    help="repo-relative files/dirs (default: full scope)")
     p.add_argument("--root", default=os.getcwd(),
